@@ -1,0 +1,102 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capability surface of PaddlePaddle (reference:
+/root/reference, ~v2.1) on JAX/XLA/Pallas. The public API mirrors
+``paddle.*`` so reference users can switch with an import rename:
+
+    import paddle_tpu as paddle
+
+Architecture (vs the reference):
+- eager mode = Tensor wrapper + vjp tape (framework/core.py) instead of
+  Tracer/BasicEngine C++ runtime;
+- compiled mode = jax.jit/pjit traces instead of ProgramDesc+Executor;
+- kernels = XLA + Pallas instead of the 356k-LoC operator library;
+- distribution = jax.sharding Mesh + XLA collectives instead of NCCL rings.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype,
+)
+from .framework.core import (
+    Tensor,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+)
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.core import grad  # noqa: F401  (paddle.grad)
+
+from .tensor import *  # noqa: F401,F403 — op namespace at top level (paddle.add, ...)
+from .tensor import einsum  # noqa: F401
+
+from .device import (
+    set_device, get_device, device_count, CPUPlace, CUDAPlace, TPUPlace,
+    XPUPlace, NPUPlace, CUDAPinnedPlace, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_npu, is_compiled_with_tpu,
+)
+
+from . import tensor  # noqa: F401
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import distributed  # noqa: F401
+from . import device  # noqa: F401
+from . import utils  # noqa: F401
+from . import ops  # noqa: F401
+from . import profiler  # noqa: F401
+from . import incubate  # noqa: F401
+
+from .hapi.model import Model  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .jit import to_static  # noqa: F401
+
+# dygraph-parity helpers
+from .nn import DataParallel  # noqa: F401
+
+
+def in_dynamic_mode() -> bool:
+    from .static import _static_mode
+
+    return not _static_mode[0]
+
+
+def enable_static():
+    from .static import _static_mode
+
+    _static_mode[0] = True
+
+
+def disable_static():
+    from .static import _static_mode
+
+    _static_mode[0] = False
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

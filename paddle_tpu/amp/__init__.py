@@ -1,0 +1,199 @@
+"""Automatic mixed precision.
+
+Parity: reference python/paddle/amp (auto_cast.py:21, grad_scaler.py:26) over
+imperative/amp_auto_cast.cc. TPU-native: the low-precision dtype is bfloat16
+(native MXU dtype, full fp32 range), so loss scaling is a no-op by default —
+GradScaler keeps the fp16-era API for parity and for enable=True fp16 runs.
+
+Mechanics: auto_cast flips a thread-local AMP state consulted by the layer
+forward paths (Linear/Conv/Matmul cast inputs to the amp dtype; denylist ops
+like softmax/log stay fp32) — same allow/deny structure as the reference's
+AmpOperators lists (imperative/amp_auto_cast.cc:55).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "amp_guard", "amp_state",
+           "white_list", "black_list"]
+
+# mirror of the reference's default allow/deny lists (fp16_lists.py)
+white_list = {"matmul", "matmul_v2", "conv2d", "conv1d", "conv3d", "linear", "einsum", "bmm", "mm"}
+black_list = {"softmax", "log_softmax", "cross_entropy", "exp", "log", "mean",
+              "sum", "norm", "layer_norm", "batch_norm", "softmax_with_cross_entropy"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_amp_state = _AmpState()
+
+
+def amp_state():
+    return _amp_state
+
+
+def amp_active() -> bool:
+    return _amp_state.enabled
+
+
+def amp_dtype():
+    return _amp_state.dtype
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_amp_state.enabled, _amp_state.dtype, _amp_state.level)
+    _amp_state.enabled = bool(enable)
+    _amp_state.dtype = dtypes.convert_dtype(dtype)
+    _amp_state.level = level
+    try:
+        yield
+    finally:
+        _amp_state.enabled, _amp_state.dtype, _amp_state.level = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_to_amp(x):
+    """Called by matmul-class layer paths when amp is active."""
+    if not _amp_state.enabled:
+        return x
+    if isinstance(x, Tensor) and dtypes.is_floating(x.dtype) and x.dtype != _amp_state.dtype:
+        from ..tensor.manipulation import cast
+
+        return cast(x, _amp_state.dtype)
+    return x
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the amp dtype (keep norms fp32)."""
+    from ..nn.layer.norm import LayerNorm, _BatchNormBase
+
+    def _cast_model(m):
+        if level == "O2":
+            d = dtypes.convert_dtype(dtype)
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and dtypes.is_floating(p.dtype):
+                        p._data = p._data.astype(d)
+        return m
+
+    if isinstance(models, (list, tuple)):
+        models = [_cast_model(m) for m in models]
+    else:
+        models = _cast_model(models)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Loss scaling (reference python/paddle/amp/grad_scaler.py:26 over
+    check_finite_and_unscale / update_loss_scaling ops).
+
+    With bf16 (TPU default) scaling is unnecessary; kept functional for
+    fp16-parity training runs.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad._data * inv
+            p.grad = Tensor(g)
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+        self._found_inf = found
+
+    def minimize(self, optimizer, scaled_loss):
+        from ..framework.core import backward
+
+        backward(scaled_loss)
+        self.step(optimizer)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)

@@ -1,0 +1,93 @@
+"""paddle_tpu.autograd — eager reverse-mode AD over the tape.
+
+Analog of reference paddle.autograd (python/paddle/autograd/) backed by
+imperative/basic_engine.cc; here the engine lives in framework.core.
+"""
+from __future__ import annotations
+
+from ..framework.core import Tensor, apply_op, backward, grad, no_grad, enable_grad
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "PyLayer", "PyLayerContext"]
+
+import jax
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable function.
+
+    Parity: paddle.autograd.PyLayer
+    (reference python/paddle/autograd/py_layer.py). ``forward``/``backward``
+    are staticmethods over Tensors; we bridge them onto the tape with
+    jax.custom_vjp semantics implemented manually.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework import core
+
+        ctx = PyLayerContext()
+        with core.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+
+        tensor_args = tuple(a for a in args if isinstance(a, core.Tensor))
+        needs_grad = core.is_grad_enabled() and any(
+            not t.stop_gradient or t._grad_node is not None for t in tensor_args
+        )
+        if not needs_grad:
+            return out
+
+        def vjp_fn(cts):
+            cts_t = tuple(core.Tensor(c) for c in (cts if isinstance(cts, tuple) else (cts,)))
+            with core.no_grad():
+                gin = cls.backward(ctx, *cts_t)
+            gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+            gin_arrays = []
+            gi = iter(gin)
+            for a in args:
+                if isinstance(a, core.Tensor):
+                    g = next(gi, None)
+                    gin_arrays.append(None if g is None else g._data)
+            return tuple(gin_arrays)
+
+        node = core.GradNode(
+            vjp_fn,
+            tensor_args,
+            [(o._data.shape, o._data.dtype) for o in outs],
+            multi,
+            cls.__name__,
+        )
+        for i, o in enumerate(outs):
+            o._grad_node = node
+            o._out_index = i
+            o.stop_gradient = False
+        return out

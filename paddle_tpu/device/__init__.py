@@ -1,0 +1,147 @@
+"""Device management — the Place/DeviceContext analog.
+
+Reference: paddle/fluid/platform/place.h:150 (Place variant) and
+device_context.h:818 (DeviceContextPool). On TPU the PJRT client owns
+streams and contexts, so this reduces to device selection + queries;
+the multi-device story is the jax.sharding Mesh (see paddle_tpu.distributed).
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "TPUPlace", "CPUPlace", "CUDAPlace", "XPUPlace", "NPUPlace",
+    "CUDAPinnedPlace", "is_compiled_with_cuda", "is_compiled_with_xpu",
+    "is_compiled_with_npu", "is_compiled_with_tpu", "synchronize",
+]
+
+
+class _Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"{self.device_type}:{self.device_id}"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def get_device_id(self):
+        return self.device_id
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+def _platform_of(d):
+    p = d.platform
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+class TPUPlace(_Place):
+    device_type = "tpu"
+
+
+class CPUPlace(_Place):
+    device_type = "cpu"
+
+
+class CUDAPlace(TPUPlace):
+    """Accepted for API parity; maps to the accelerator (TPU) device."""
+
+    device_type = "tpu"
+
+
+class XPUPlace(TPUPlace):
+    device_type = "tpu"
+
+
+class NPUPlace(TPUPlace):
+    device_type = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    device_type = "cpu"
+
+
+_current_device = [None]
+
+
+def _default_place():
+    d = jax.devices()[0]
+    return TPUPlace(0) if _platform_of(d) == "tpu" else CPUPlace(0)
+
+
+def set_device(device):
+    """paddle.set_device parity: 'tpu', 'tpu:0', 'cpu', 'gpu:0' (→ tpu)."""
+    if isinstance(device, _Place):
+        _current_device[0] = device
+        return device
+    name, _, idx = str(device).partition(":")
+    idx = int(idx) if idx else 0
+    if name in ("gpu", "cuda", "tpu", "xpu", "npu"):
+        place = TPUPlace(idx)
+    else:
+        place = CPUPlace(idx)
+    _current_device[0] = place
+    try:
+        jax.config.update("jax_default_device", place.jax_device)
+    except Exception:
+        pass
+    return place
+
+
+def get_device() -> str:
+    p = _current_device[0] or _default_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place():
+    return _current_device[0] or _default_place()
+
+
+def get_all_devices():
+    return [f"{_platform_of(d)}:{i}" for i, d in enumerate(jax.devices())]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_platform_of(d) == "tpu" for d in jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all dispatched work on the device completes."""
+    (jax.device_put(0) + 0).block_until_ready()

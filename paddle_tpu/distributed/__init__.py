@@ -1,0 +1,31 @@
+"""paddle_tpu.distributed (mirrors paddle.distributed).
+
+The NCCL-ring world of the reference (collective.py + fleet) rebuilt on the
+jax.sharding Mesh + XLA collectives. See SURVEY.md §2.3 / §5 for the
+correspondence table.
+"""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+    global_mesh,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, reduce, broadcast,
+    all_gather, scatter, alltoall, send, recv, barrier, wait,
+    destroy_process_group, split,
+)
+from .parallel import DataParallel  # noqa: F401
+from .sharding_utils import P, shard_constraint, named_sharding, current_mesh  # noqa: F401
+from . import fleet  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference spawn.py:394 — process-per-device launch. On TPU one
+    process drives all local devices, so spawn degenerates to a direct call
+    (multi-host uses the launcher + jax.distributed)."""
+    func(*args)
+
+
+def get_device_count():
+    import jax
+
+    return jax.device_count()

@@ -1,0 +1,228 @@
+"""Collective communication API.
+
+Parity: reference python/paddle/distributed/collective.py (all_reduce,
+broadcast, all_gather, ...) over NCCL ring communicators
+(paddle/fluid/operators/collective/, platform/collective_helper.h:68).
+
+TPU-native redesign: a "group" is a named mesh axis (or tuple of axes), not
+a ring_id. Collectives have two execution regimes:
+
+1. **Traced** (inside shard_map over the global mesh — the performance
+   path): lower directly to lax.psum/all_gather/ppermute; XLA emits ICI
+   collectives.
+2. **Eager single-process**: the world is this process; ops are identity
+   (world_size 1 per process) matching reference semantics where each
+   process holds one shard. Cross-device eager work is done by jit'ing a
+   shard_map over the group's mesh.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from . import env
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "reduce",
+    "broadcast", "all_gather", "scatter", "alltoall", "send", "recv",
+    "barrier", "split", "wait", "destroy_process_group",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator: names a mesh axis (traced) / rank list (bookkeeping)."""
+
+    def __init__(self, rank, nranks, id=0, ranks=None, axis_name=None):  # noqa: A002
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name  # mesh axis this group maps onto
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(rank={self.rank}, nranks={self.nranks}, axis={self.axis_name})"
+
+
+_default_group: List[Optional[Group]] = [None]
+_groups = {}
+_next_gid = [1]
+
+
+def _get_default_group() -> Group:
+    if _default_group[0] is None:
+        _default_group[0] = Group(env.get_rank(), max(env.get_world_size(), 1),
+                                  id=0, axis_name="data")
+        _groups[0] = _default_group[0]
+    return _default_group[0]
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _get_default_group())
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    """reference collective.py:209 — creates a ring; here: names a sub-axis."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    myrank = env.get_rank()
+    ranks = ranks if ranks is not None else list(range(env.get_world_size()))
+    g = Group(ranks.index(myrank) if myrank in ranks else -1, len(ranks),
+              id=gid, ranks=ranks, axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+def _axis_in_trace(x) -> bool:
+    """True if x is a tracer inside shard_map (axis names bound)."""
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_name(group: Optional[Group]):
+    g = group or _get_default_group()
+    return g.axis_name or "data"
+
+
+# Pure collective fns usable on arrays inside shard_map --------------------
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return jax.lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    return jax.lax.pmin(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+# Tensor-level API ---------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=True):
+    axis = _axis_name(group)
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if _axis_in_trace(arr):
+        fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+              ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
+        out = fn(arr, axis)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    # eager single process: identity (world of one per process)
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if _axis_in_trace(arr):
+        axis = _axis_name(group)
+        idx = jax.lax.axis_index(axis)
+        src_val = jax.lax.psum(jnp.where(idx == src, arr, jnp.zeros_like(arr)), axis)
+        if isinstance(tensor, Tensor):
+            tensor._data = src_val
+            return tensor
+        return src_val
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if _axis_in_trace(arr):
+        ax = _axis_name(group)
+        out = jax.lax.all_gather(arr, ax)
+        n = out.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(out[i]) for i in range(n))
+            return tensor_list
+        return out
+    if isinstance(tensor_list, list):
+        tensor_list.append(tensor.clone() if isinstance(tensor, Tensor) else Tensor(arr))
+        return tensor_list
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list is not None and len(tensor_list):
+        g = group or _get_default_group()
+        tensor.set_value(tensor_list[g.rank if g.rank >= 0 else 0])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    arrs = [t._data if isinstance(t, Tensor) else t for t in in_tensor_list]
+    if arrs and _axis_in_trace(arrs[0]):
+        ax = _axis_name(group)
+        stacked = jnp.stack(arrs)
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0, tiled=False)
+        out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+        return out_tensor_list
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if _axis_in_trace(arr):
+        ax = _axis_name(group)
+        # point-to-point on a mesh axis = ppermute to dst
+        src = jax.lax.axis_index(ax)
+        del src
+        return jax.lax.ppermute(arr, ax, [(env.get_rank(), dst)])
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor.block_until_ready()
+    return tensor
+
+
+def destroy_process_group(group=None):
+    _groups.clear()
+    _default_group[0] = None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference collective.py split — sharded layer factory; provided via
+    fleet.meta_parallel Parallel layers instead."""
+    raise NotImplementedError(
+        "use paddle_tpu.distributed.fleet.meta_parallel ColumnParallelLinear/"
+        "RowParallelLinear/VocabParallelEmbedding")

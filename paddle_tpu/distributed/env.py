@@ -1,0 +1,110 @@
+"""Process/device environment for distribution.
+
+Replaces the reference's rank/env plumbing (PaddleCloudRoleMaker env vars,
+fleet/base/role_maker.py:530). TPU-native model: ONE process drives N local
+devices (or multi-host via jax.distributed); "rank" maps to a mesh
+coordinate, not a process. For API parity we expose rank/world_size in
+terms of the data-parallel axis of the active global mesh.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+_state = {
+    "initialized": False,
+    "mesh": None,          # jax.sharding.Mesh, the global hybrid mesh
+    "topology": None,      # CommunicateTopology
+    "hcg": None,           # HybridCommunicateGroup
+    "rank": 0,
+    "world_size": 1,
+}
+
+
+def _devices():
+    return jax.devices()
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def get_rank() -> int:
+    if not _state["initialized"]:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    return _state["rank"]
+
+
+def get_world_size() -> int:
+    if not _state["initialized"]:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    return _state["world_size"]
+
+
+def set_state(**kwargs):
+    _state.update(kwargs)
+
+
+def get_state():
+    return _state
+
+
+def global_mesh():
+    return _state["mesh"]
+
+
+def init_parallel_env(mesh_shape=None, axis_names=None):
+    """paddle.distributed.init_parallel_env parity.
+
+    Reference (parallel.py:69) bootstraps NCCL rings over TCP; here we build
+    the global device mesh. Default: 1-D "data" mesh over all local devices.
+    Multi-host: call jax.distributed.initialize first (launcher does this).
+    """
+    devs = np.array(_devices())
+    if mesh_shape is None:
+        mesh_shape = (len(devs),)
+        axis_names = axis_names or ("data",)
+    mesh = jax.sharding.Mesh(devs.reshape(mesh_shape), axis_names)
+    _state.update({
+        "initialized": True,
+        "mesh": mesh,
+        "rank": jax.process_index(),
+        "world_size": max(jax.process_count(), 1),
+    })
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    """Reference python/paddle/fluid/dygraph/parallel.py ParallelEnv parity."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170")
+        return eps.split(",")

@@ -1,0 +1,25 @@
+"""paddle_tpu.distributed.fleet (mirrors paddle.distributed.fleet)."""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.fleet_base import Fleet, fleet  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .utils.recompute import recompute  # noqa: F401
+
+# module-level facade functions (reference fleet/__init__.py re-exports)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective

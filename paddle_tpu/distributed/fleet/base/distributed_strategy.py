@@ -1,0 +1,70 @@
+"""DistributedStrategy.
+
+Parity: reference python/paddle/distributed/fleet/base/distributed_strategy.py
+(proto-backed, framework/distributed_strategy.proto:176). Here a plain
+attribute bag with the same feature switches; features map to mesh axes and
+jit options instead of program rewrites.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # feature switches (reference proto fields)
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.5,
+            "use_dynamic_loss_scaling": True, "custom_white_list": [],
+            "custom_black_list": [], "use_pure_fp16": False,
+            "use_fp16_guard": True, "dtype": "bfloat16",
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {
+            "sharding_degree": 1, "stage": 1, "mp_degree": 1, "pp_degree": 1,
+            "dp_degree": 1, "gradient_merge_acc_step": 1, "offload": False,
+            "segment_broadcast_MB": 32.0,
+        }
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []}
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                             "epsilon": 0, "exclude_from_weight_decay": []}
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": -1}
+        self.semi_auto = False
+        self.auto = False
+        self.asp = False
+        self.heter_ccl_mode = False
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+        }
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.without_graph_optimization = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.find_unused_parameters = False
+        self.last_comm_group_size_MB = 1
+        # execution/build strategy placeholders
+        self.execution_strategy = None
+        self.build_strategy = None
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on})"

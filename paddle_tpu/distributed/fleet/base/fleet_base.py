@@ -1,0 +1,135 @@
+"""Fleet facade.
+
+Parity: reference python/paddle/distributed/fleet/base/fleet_base.py:103
+(Fleet.init/distributed_model/distributed_optimizer/minimize). TPU-native:
+``init`` with hybrid_configs builds ONE global jax.sharding.Mesh with axes
+["data","pipe","sharding","model"]; distributed_model/optimizer select
+wrappers that annotate shardings for pjit rather than rewriting programs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .... import nn
+from ... import env
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["Fleet", "fleet"]
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._topology: Optional[CommunicateTopology] = None
+        self._is_collective = True
+        self._mesh = None
+
+    # -- init ----------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._is_collective = is_collective
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dp = int(hc.get("dp_degree", 1))
+        mp = int(hc.get("mp_degree", 1))
+        pp = int(hc.get("pp_degree", 1))
+        sh = int(hc.get("sharding_degree", 1))
+        n_needed = dp * mp * pp * sh
+        devs = np.array(jax.devices())
+        if n_needed <= 1:
+            # pure DP over all devices
+            dp = len(devs)
+            n_needed = dp
+        if len(devs) < n_needed:
+            raise RuntimeError(
+                f"hybrid_configs needs {n_needed} devices, have {len(devs)}")
+        devs = devs[:n_needed].reshape(dp, pp, sh, mp)
+        self._mesh = jax.sharding.Mesh(devs, ("data", "pipe", "sharding", "model"))
+        self._topology = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                             (dp, pp, sh, mp))
+        self._hcg = HybridCommunicateGroup(self._topology, env.get_rank())
+        env.set_state(initialized=True, mesh=self._mesh, topology=self._topology,
+                      hcg=self._hcg, rank=env.get_rank(),
+                      world_size=self._topology.world_size())
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def get_mesh(self):
+        return self._mesh
+
+    @property
+    def worker_num(self):
+        return self._topology.world_size() if self._topology else 1
+
+    def worker_index(self):
+        return env.get_rank()
+
+    def is_first_worker(self):
+        return env.get_rank() == 0
+
+    def barrier_worker(self):
+        from ... import collective as C
+
+        C.barrier()
+
+    # -- model/optimizer wrapping --------------------------------------------
+    def distributed_model(self, model):
+        """Pick the parallel wrapper (reference fleet_base.py:883)."""
+        from ..meta_parallel.pp_layers import PipelineLayer
+        from ..meta_parallel.pipeline_parallel import PipelineParallel
+        from ..meta_parallel.tensor_parallel import TensorParallel
+        from ...parallel import DataParallel
+
+        if self._hcg is None:
+            self.init()
+        if self._hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, self._hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ..meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        if strategy is not None:
+            self._strategy = strategy
+        if self._hcg is None:
+            self.init()
+        if self._topology and self._topology.world_size() > 1:
+            return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+        return optimizer
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from ....framework.core import backward
+
+        backward(loss)
+        return None, []
+
+    # -- save/load (reference fleet_base.py:701-828) -------------------------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None, export_for_deployment=True):
+        raise NotImplementedError("use paddle_tpu.jit.save")
+
+    def save_persistables(self, executor, dirname, main_program=None, mode=0):
+        raise NotImplementedError("use paddle_tpu.save on state_dict")
+
+    # role info
+    def is_server(self):
+        return False
+
+    def is_worker(self):
+        return True
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()

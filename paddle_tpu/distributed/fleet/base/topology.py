@@ -1,0 +1,195 @@
+"""Hybrid-parallel topology.
+
+Parity: reference python/paddle/distributed/fleet/base/topology.py:36
+(CommunicateTopology) / :117 (HybridCommunicateGroup). The 4-axis cartesian
+rank mesh ["data","pipe","sharding","model"] maps 1:1 onto a
+jax.sharding.Mesh with those axis names — mesh coordinates replace ranks,
+named axes replace ring_ids.
+"""
+from __future__ import annotations
+
+import itertools
+from functools import reduce
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in dims)))
+        self._world_size = int(np.prod(dims))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on axis_name == index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(self._coord2rank[c] for c in self.coordinate if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along axis_name (reference get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for other in itertools.product(*(range(self._dims[i]) for i in other_axes)):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(other_axes, other):
+                    coord[i] = o
+                coord[axis] = v
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = dict(zip(self._parallel_names, coord))
+        tf.update(kwargs)
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:117 — carves dp/mp/pp/sharding sub-groups.
+
+    TPU-native: instead of creating NCCL rings per group, we record the axis
+    names; collectives inside compiled code reference axes directly.
+    """
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data")
+        self._mp_degree = topology.get_dim("model")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+
+        from .. import collective as C
+
+        coord = topology.get_coord(global_rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+        def mk(axis):
+            ranks_groups = topology.get_comm_list(axis)
+            my = next(g for g in ranks_groups if global_rank in g)
+            return C.Group(my.index(global_rank), len(my), id=hash(axis) % 100000,
+                           ranks=my, axis_name=axis)
+
+        self._dp_group = mk("data")
+        self._mp_group = mk("model")
+        self._pp_group = mk("pipe")
+        self._sharding_group = mk("sharding")
+
+    # parallel mode checks (reference api)
+    def get_parallel_mode(self):
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    # p2p neighbors (reference topology.py:225)
+    def get_p2p_groups(self):
+        prev = (self.get_stage_id() - 1) % self._pp_degree
+        nxt = (self.get_stage_id() + 1) % self._pp_degree
+        return prev, nxt
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)

@@ -1,0 +1,64 @@
+"""Hybrid-parallel optimizer wrapper.
+
+Parity: reference fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:173 (HybridParallelOptimizer: step =
+sharding_reduce_gradients → fused_allreduce_gradients(dp) → inner step) and
+:45 (HybridParallelClipGrad — global norm allreduced across mp+pp groups).
+
+TPU-native: grad reduction across dp/mp happens inside the compiled step
+(psum emitted by GSPMD); the eager wrapper therefore focuses on the clip
+semantics and pass-through, keeping the reference API.
+"""
+from __future__ import annotations
+
+from .....nn.clip import ClipGradByGlobalNorm
+from ....env import get_state
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
+
+
+class HybridParallelClipGrad:
+    """Global-norm clip; on TPU the norm is already global once grads are
+    reduced in the compiled step, so this reduces to ClipGradByGlobalNorm."""
+
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters, no_grad_set)
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

@@ -1,0 +1,10 @@
+from .parallel_layers.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer, SegmentLayers  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .tensor_parallel import TensorParallel, ShardingParallel, MetaParallelBase  # noqa: F401

@@ -1,0 +1,78 @@
+"""TP-aware RNG state tracking.
+
+Parity: reference fleet/meta_parallel/parallel_layers/random.py:32
+(RNGStatesTracker, model_parallel_random_seed, get_rng_state_tracker):
+dropout must DIFFER across model-parallel ranks (they hold different
+activation shards) but MATCH across data-parallel replicas.
+
+TPU-native: seeds derive jax PRNG keys; inside compiled code the "local"
+dropout key is folded with the mesh "model" axis index, which reproduces
+the per-mp-rank streams without per-process state.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework import random as grandom
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
+           "MODEL_PARALLEL_RNG"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = grandom.get_rng_state()
+        grandom.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = grandom.get_rng_state()
+            grandom.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from .... import env
+
+    hcg = env.get_state().get("hcg")
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + mp_rank * 100
+    else:
+        global_seed = pyrandom.randint(0, 100000)
+        local_seed = global_seed * 1024 + mp_rank * 100
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    grandom.seed(global_seed)

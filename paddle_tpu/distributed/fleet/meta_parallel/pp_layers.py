@@ -1,0 +1,202 @@
+"""Pipeline layer decomposition.
+
+Parity: reference fleet/meta_parallel/parallel_layers/pp_layers.py:132
+(PipelineLayer, LayerDesc, SharedLayerDesc, SegmentLayers).
+
+TPU-native: one process owns every stage (devices are mesh columns, not
+processes), so PipelineLayer keeps the full layer list plus the
+stage-segmentation metadata. Schedulers consume that metadata:
+- PipelineParallel.train_batch: microbatch accumulation (exact semantics);
+- paddle_tpu.parallel.pipeline: shard_map + ppermute schedule that places
+  stage s's weights on mesh "pipe" coordinate s for true pipelined
+  execution of uniform stages.
+"""
+from __future__ import annotations
+
+import math
+import re
+from functools import partial
+from typing import Callable, List, Optional, Union
+
+from ....nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "SegmentLayers"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("layer_func must be a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference pp_layers.py:63 — uniform / param-weighted segmentation."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment by layer class name occurrences
+            name = self.method.split(":", 1)[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                cls = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                if re.search(name, cls.__name__):
+                    weights[i] = 1
+            actual = sum(weights)
+            assert actual >= self.num_parts, (
+                f"only {actual} layers match {name}, need >= {self.num_parts}")
+            return self.segment_by_weights(weights)
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+    def segment_by_weights(self, weights):
+        total = sum(weights)
+        per = total / self.num_parts
+        result = [0] * (self.num_parts + 1)
+        acc, part = 0, 1
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= per * part and part < self.num_parts:
+                result[part] = i + 1
+                part += 1
+        result[self.num_parts] = len(weights)
+        return result
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_offload=False,
+                 recompute_partition=False):
+        super().__init__()
+        from ... import env
+
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topo = topology
+        hcg = env.get_state().get("hcg")
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._stage_id = hcg.get_stage_id() if hcg else 0
+
+        seg = SegmentLayers(self._layers_desc, num_parts=num_stages, method=seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # build ALL layers (single process owns the full model on TPU);
+        # record stage boundaries for the schedulers
+        self._shared_layers = {}
+        self.run_function: List = []
+        self._stage_of_layer = []
+        for stage in range(num_stages):
+            for i in range(self.segment_parts[stage], self.segment_parts[stage + 1]):
+                desc = self._layers_desc[i]
+                layer = self._build_one(desc, i)
+                self.run_function.append(layer)
+                self._stage_of_layer.append(stage)
+
+    def _build_one(self, desc, idx):
+        if isinstance(desc, SharedLayerDesc):
+            if desc.layer_name not in self._shared_layers:
+                built = desc.build_layer()
+                self._shared_layers[desc.layer_name] = built
+                self.add_sublayer(f"shared_{desc.layer_name}", built)
+            layer = self._shared_layers[desc.layer_name]
+            if desc.forward_func is not None:
+                return partial(desc.forward_func, layer)
+            return layer
+        if isinstance(desc, LayerDesc):
+            built = desc.build_layer()
+            self.add_sublayer(str(idx), built)
+            return built
+        if isinstance(desc, Layer):
+            self.add_sublayer(str(idx), desc)
+            return desc
+        if callable(desc):
+            return desc
+        raise TypeError(f"bad layer desc {desc}")
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage_id):
+        return [f for f, s in zip(self.run_function, self._stage_of_layer) if s == stage_id]
+
+    def forward(self, input):  # noqa: A002
+        from ..utils.recompute import recompute
+
+        x = input
+        for i, fn in enumerate(self.run_function):
+            if self._recompute_interval > 0 and i % self._recompute_interval == 0 and not isinstance(x, tuple):
+                x = recompute(fn, x)
+            else:
+                x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x
+
+    def save_state_dict(self, path):
+        """Per-stage sharded checkpoint dirs (reference pp_layers.py:381)."""
+        import os
+
+        from ....framework.io import save
+
+        os.makedirs(path, exist_ok=True)
+        for stage in range(self._num_stages):
+            sd = {}
+            for i, (fn, s) in enumerate(zip(self.run_function, self._stage_of_layer)):
+                if s != stage or not isinstance(fn, Layer):
+                    continue
+                for k, v in fn.state_dict().items():
+                    sd[f"layer_{i}.{k}"] = v
+            save(sd, os.path.join(path, f"stage_{stage}.pdparams"))
+
+    def load_state_dict_from(self, path):
+        import os
+
+        from ....framework.io import load
+
+        for stage in range(self._num_stages):
+            f = os.path.join(path, f"stage_{stage}.pdparams")
+            if not os.path.exists(f):
+                continue
+            sd = load(f)
+            for i, (fn, s) in enumerate(zip(self.run_function, self._stage_of_layer)):
+                if s != stage or not isinstance(fn, Layer):
+                    continue
+                prefix = f"layer_{i}."
+                sub = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+                if sub:
+                    fn.set_state_dict(sub)

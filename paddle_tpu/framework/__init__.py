@@ -1,0 +1,14 @@
+from . import dtype
+from .core import (
+    Tensor,
+    Parameter,
+    apply_op,
+    backward,
+    grad,
+    no_grad,
+    enable_grad,
+    is_grad_enabled,
+    set_grad_enabled,
+    to_tensor,
+)
+from .random import seed, get_rng_state, set_rng_state

@@ -1,0 +1,605 @@
+"""Eager Tensor, op dispatch, and the autograd tape.
+
+This is the TPU-native analog of the reference's dygraph runtime:
+
+- ``Tracer::TraceOp`` (/root/reference/paddle/fluid/imperative/tracer.cc:146)
+  becomes :func:`apply_op` — every eager op funnels through it. Instead of
+  building per-op ``GradOpNode``s from a grad-op registry, we call
+  ``jax.vjp`` on the op's pure function: the returned closure *is* the grad
+  node (it holds the residuals the reference would stash in the grad op's
+  inputs).
+- ``BasicEngine::Execute`` (/root/reference/paddle/fluid/imperative/
+  basic_engine.cc:379) becomes :func:`backward` — a reverse-topological walk
+  over :class:`GradNode` with cotangent accumulation (the reference's
+  ``GradientAccumulator``).
+- The ``core.ops`` generated fast path
+  (/root/reference/paddle/fluid/pybind/op_function_generator.cc) is replaced
+  by op-level ``jax.jit`` caching keyed on (fn, static attrs) — XLA's trace
+  cache plays the role of the reference's ``PreparedOp`` kernel cache
+  (/root/reference/paddle/fluid/imperative/prepared_operator.cc:92).
+
+Inside a ``jax.jit``/``jax.grad`` trace (our "static"/functional mode) the
+tape is bypassed: differentiation is handled by JAX's own machinery, so
+:func:`apply_op` just calls the pure function on the tracers.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "apply_op",
+    "backward",
+    "grad",
+    "no_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "to_tensor",
+]
+
+
+# --------------------------------------------------------------------------
+# grad mode
+# --------------------------------------------------------------------------
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(enabled: bool):
+    _grad_state.enabled = bool(enabled)
+
+
+class no_grad:
+    """Context manager & decorator disabling the autograd tape.
+
+    Parity: ``paddle.no_grad`` (reference python/paddle/fluid/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+# --------------------------------------------------------------------------
+# Tensor
+# --------------------------------------------------------------------------
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    """Eager tensor: a named wrapper over ``jax.Array``.
+
+    Mirrors the user surface of ``paddle.Tensor`` (reference VarBase,
+    /root/reference/paddle/fluid/imperative/layer.cc). ``stop_gradient``
+    defaults to True like the reference; ``Parameter`` flips it.
+    """
+
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "sharding",  # optional jax.sharding.PartitionSpec for pjit placement
+        "__weakref__",
+    )
+
+    # let Tensor win in  np_array * tensor  and similar
+    __array_priority__ = 100
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not _is_tracer(data):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node: Optional[GradNode] = None
+        self._out_index: int = 0
+        self.name = name
+        self.persistable = False
+        self.sharding = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        devs = getattr(self._data, "devices", None)
+        if devs is None:
+            return "tracer"
+        try:
+            return next(iter(self._data.devices()))
+        except Exception:
+            return "unknown"
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from .. import tensor as T
+
+        return T.transpose(self, list(range(self.ndim))[::-1])
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return np.asarray(self._data).item(*args)
+        return np.asarray(self._data).item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        from .. import tensor as T
+
+        return T.clone(self)
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    def astype(self, dtype):
+        from .. import tensor as T
+
+        return T.cast(self, dtype)
+
+    cast = astype
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        backward(self, grad_tensor, retain_graph=retain_graph)
+
+    # value mutation (optimizers, state loading)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}"
+            )
+        self._data = value
+
+    def copy_(self, other, *a):
+        self.set_value(other)
+        return self
+
+    def fill_(self, v):
+        self._data = jnp.full_like(self._data, v)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # -- repr ---------------------------------------------------------------
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"Tensor(shape={self.shape}, dtype={self.dtype.name}, <traced>)"
+        sg = self.stop_gradient
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={sg},\n       {np.asarray(self._data)})"
+        )
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(np.asarray(self._data).item(), spec)
+        return repr(self)
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached in paddle_tpu/tensor/__init__.py
+    # (to avoid a circular import with the op modules).
+
+    def __jax_array__(self):
+        # lets jnp.* consume Tensor directly (loses tape; used in no-grad
+        # utility code only).
+        return self._data
+
+
+class Parameter(Tensor):
+    """Trainable tensor: ``stop_gradient=False``, persistable.
+
+    Parity: reference Parameter (python/paddle/fluid/framework.py:5932).
+    """
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    del place  # device placement is managed by jax; kept for API parity
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, (list, tuple)) and any(
+        isinstance(x, Tensor) for x in jax.tree_util.tree_leaves(data)
+    ):
+        arr = jnp.asarray(
+            jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, Tensor) else x, data
+            )
+        )
+    else:
+        arr = data
+    d = dtypes.convert_dtype(dtype)
+    if not isinstance(arr, jax.Array) and not _is_tracer(arr):
+        np_arr = np.asarray(arr)
+        if d is None and np_arr.dtype == np.float64:
+            d = dtypes.default_float_dtype()  # match paddle: python floats -> fp32
+        arr = jnp.asarray(np_arr, dtype=d)
+    elif d is not None and arr.dtype != d:
+        arr = arr.astype(d)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` closes over the op's residuals — the analog of a reference
+    ``GradOpNode`` + its saved inputs
+    (/root/reference/paddle/fluid/imperative/op_base.h).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_out", "name")
+
+    def __init__(self, vjp_fn, inputs, out_avals, multi_out, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # tuple[Tensor | None] (None for non-diff args)
+        self.out_avals = out_avals  # [(shape, dtype)]
+        self.multi_out = multi_out
+        self.name = name
+
+
+_jit_cache: dict = {}
+# Eager op-level jit: the analog of the reference's PreparedOp kernel cache.
+EAGER_JIT = True
+
+
+def _jitted(fn, attrs):
+    try:
+        key = (fn, tuple(sorted(attrs.items())))
+        hash(key)
+    except TypeError:
+        return None
+    j = _jit_cache.get(key)
+    if j is None:
+        j = jax.jit(functools.partial(fn, **attrs))
+        _jit_cache[key] = j
+    return j
+
+
+def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
+    """Run pure function ``fn(*arrays, **attrs)`` on Tensor/array args.
+
+    Records a GradNode when grad is enabled, we are not inside a jax trace,
+    and at least one input requires grad. Returns Tensor (or tuple of
+    Tensors mirroring fn's output structure).
+    """
+    arrays = tuple(_unwrap(a) for a in args)
+    tracing = any(_is_tracer(a) for a in arrays)
+    input_tensors = tuple(a if isinstance(a, Tensor) else None for a in args)
+    needs_grad = (
+        not tracing
+        and _grad_state.enabled
+        and any(
+            t is not None and (not t.stop_gradient or t._grad_node is not None)
+            for t in input_tensors
+        )
+    )
+
+    if needs_grad:
+        f = functools.partial(fn, **attrs) if attrs else fn
+        out, vjp_fn = jax.vjp(f, *arrays)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        node = GradNode(
+            vjp_fn,
+            input_tensors,
+            [(o.shape, o.dtype) for o in outs],
+            multi,
+            op_name or getattr(fn, "__name__", "op"),
+        )
+        result = []
+        for i, o in enumerate(outs):
+            t = Tensor(o, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+            result.append(t)
+        return tuple(result) if multi else result[0]
+
+    if tracing:
+        out = fn(*arrays, **attrs)
+    else:
+        j = _jitted(fn, attrs) if EAGER_JIT else None
+        out = j(*arrays) if j is not None else fn(*arrays, **attrs)
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+# --------------------------------------------------------------------------
+# backward engine
+# --------------------------------------------------------------------------
+
+def _topo_order(root: GradNode):
+    order, seen = [], set()
+    stack = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t is not None and t._grad_node is not None and id(t._grad_node) not in seen:
+                stack.append((t._grad_node, False))
+    return order  # leaves-first; reverse for backward
+
+
+def _is_float0(ct) -> bool:
+    return getattr(ct, "dtype", None) == jax.dtypes.float0
+
+
+def backward(tensor: Tensor, grad_tensor=None, retain_graph: bool = False):
+    """Reverse-mode walk of the tape (BasicEngine::Execute analog)."""
+    if tensor._grad_node is None:
+        if not tensor.stop_gradient:
+            g = (
+                _unwrap(grad_tensor)
+                if grad_tensor is not None
+                else jnp.ones_like(tensor._data)
+            )
+            _accum_leaf(tensor, g)
+        return
+
+    if grad_tensor is None:
+        seed_ct = jnp.ones_like(tensor._data)
+    else:
+        seed_ct = jnp.asarray(_unwrap(grad_tensor), dtype=tensor._data.dtype)
+
+    node_cts: dict = {}  # id(node) -> list of cotangents per output
+    root = tensor._grad_node
+    node_cts[id(root)] = [None] * len(root.out_avals)
+    node_cts[id(root)][tensor._out_index] = seed_ct
+
+    order = _topo_order(root)
+    for node in reversed(order):
+        cts = node_cts.get(id(node))
+        if cts is None:
+            continue
+        full = [
+            c if c is not None else jnp.zeros(sh, dt)
+            for c, (sh, dt) in zip(cts, node.out_avals)
+        ]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "pass retain_graph=True."
+            )
+        in_cts = node.vjp_fn(tuple(full) if node.multi_out else full[0])
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, ct in zip(node.inputs, in_cts):
+            if t is None or _is_float0(ct) or ct is None:
+                continue
+            if t._grad_node is not None:
+                slot = node_cts.setdefault(
+                    id(t._grad_node), [None] * len(t._grad_node.out_avals)
+                )
+                i = t._out_index
+                slot[i] = ct if slot[i] is None else slot[i] + ct
+            elif not t.stop_gradient:
+                _accum_leaf(t, ct)
+        node_cts.pop(id(node), None)
+
+
+def _accum_leaf(t: Tensor, ct):
+    if t.grad is None:
+        t.grad = Tensor(ct)
+    else:
+        t.grad = Tensor(t.grad._data + ct)
+    for hook in _leaf_hooks.get(id(t), ()):
+        hook(t)
+
+
+# grad-accumulation hooks keyed by tensor id (DDP reducer uses these)
+_leaf_hooks: dict = {}
+
+
+def register_grad_hook(t: Tensor, hook):
+    _leaf_hooks.setdefault(id(t), []).append(hook)
+    return lambda: _leaf_hooks.get(id(t), []).remove(hook)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """paddle.grad parity (partial_grad_engine analog).
+
+    Computes grads of outputs wrt inputs without writing ``.grad``.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported in eager mode; "
+            "use the functional API (paddle_tpu.jit) with jax.grad composition."
+        )
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    # Save/restore .grad of leaves so paddle.grad stays side-effect free.
+    saved = {}
+
+    def collect(t):
+        saved[id(t)] = (t, t.grad)
+        t.grad = None
+
+    seen_nodes = set()
+    for o in outs:
+        if o._grad_node is None:
+            continue
+        for node in _topo_order(o._grad_node):
+            if id(node) in seen_nodes:
+                continue
+            seen_nodes.add(id(node))
+            for t in node.inputs:
+                if t is not None and t._grad_node is None and not t.stop_gradient:
+                    if id(t) not in saved:
+                        collect(t)
+    for t in ins:
+        if id(t) not in saved:
+            collect(t)
+
+    try:
+        for o, go in zip(outs, grad_outputs):
+            backward(o, go, retain_graph=True if retain_graph else True)
+        results = []
+        for t in ins:
+            g = t.grad
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True."
+                )
+            results.append(g)
+    finally:
+        if not retain_graph:
+            for o in outs:
+                if o._grad_node is not None:
+                    for node in _topo_order(o._grad_node):
+                        node.vjp_fn = None
+        for t, old in saved.values():
+            t.grad = old
+    return results

@@ -1,0 +1,86 @@
+"""Dtype system.
+
+TPU-native analog of the reference's VarType dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:117) and the
+python-side conversion helpers
+(/root/reference/python/paddle/fluid/data_feeder.py convert_dtype).
+
+We map Paddle dtype names onto jax/numpy dtypes. bfloat16 is first-class
+(it is the native TPU matmul dtype) rather than an afterthought.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+bool = jnp.bool_  # noqa: A001 - mirrors paddle.bool
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity."""
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return np.dtype(_DEFAULT_DTYPE[0]).name
+
+
+def default_float_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(dtype):
+    """Normalize any user-supplied dtype spec to a numpy/jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype {dtype!r}")
+        return jnp.dtype(_NAME_TO_DTYPE[dtype])
+    # Accept numpy dtypes, jnp scalar types, python types
+    try:
+        return jnp.dtype(dtype)
+    except TypeError:
+        raise ValueError(f"Cannot convert {dtype!r} to a dtype")
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)

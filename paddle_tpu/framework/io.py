@@ -1,0 +1,59 @@
+"""paddle.save / paddle.load parity.
+
+Reference: python/paddle/framework/io.py:553 (save) / :769 (load) — pickled
+state_dicts. We store numpy-converted pytrees via pickle; Tensors round-trip
+as Tensors. For large sharded checkpoints use paddle_tpu.distributed.checkpoint
+(orbax-backed async sharded save — the AutoCheckpoint/HDFS analog).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor
+
+
+class _TensorPickle:
+    """Pickle wrapper marking arrays that should be restored as Tensors."""
+
+    def __init__(self, array):
+        self.array = array
+
+
+def _to_savable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPickle(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _to_savable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [ _to_savable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_savable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPickle):
+        return obj.array if return_numpy else Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_savable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_savable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_savable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_savable(obj, return_numpy)

@@ -1,0 +1,66 @@
+"""Global stateful RNG over JAX's functional PRNG.
+
+The reference keeps per-device seeded generator state
+(/root/reference/paddle/fluid/framework/generator.h:118) plus a
+tensor-parallel-aware RNG-state tracker
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:32).
+
+TPU-native design: one global `jax.random.key` threaded through a split
+counter. `paddle_tpu.seed(n)` resets it. Inside a jit trace the stateful
+path would bake the key into the compiled program, so traced code should
+use `rng_key()` explicitly (our functional layers thread keys); the
+eager path splits the global key on every draw.
+
+The TP-aware `RNGStatesTracker` lives in
+paddle_tpu.distributed.fleet.meta_parallel.random and reuses this module.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class _GlobalRNG:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.seed(seed)
+
+    def seed(self, s: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(s)
+            self._key = jax.random.key(int(s))
+
+    def next_key(self):
+        """Split the global key; returns a fresh subkey (eager use)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        with self._lock:
+            self._key = key
+
+
+_global_rng = _GlobalRNG(0)
+
+
+def seed(s: int):
+    """paddle.seed parity: seed the global generator."""
+    _global_rng.seed(s)
+    return _global_rng
+
+
+def next_key():
+    return _global_rng.next_key()
+
+
+def get_rng_state():
+    return _global_rng.get_state()
+
+
+def set_rng_state(state):
+    _global_rng.set_state(state)

@@ -1,0 +1,220 @@
+"""hapi.Model — high-level train/eval/predict.
+
+Parity: reference python/paddle/hapi/model.py:876 (Model.fit:1521,
+evaluate:1752, predict:1855). The reference keeps dual adapters
+(StaticGraphAdapter/DynamicGraphAdapter); here there is one adapter with two
+speeds: eager per-batch (debuggable) and a jit'd TrainStep (default) that
+compiles forward+backward+update into one XLA program.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.core import Tensor, backward
+from ..io import DataLoader
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from . import callbacks as cbks_mod
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._metrics = []
+        self._optimizer = None
+        self.stop_training = False
+        self._train_step = None
+        self._use_jit = True
+
+    # -- configuration -------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                jit_compile=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be Metric instances, got {m}")
+        self._use_jit = jit_compile
+        self._train_step = None
+
+    # -- core steps ----------------------------------------------------------
+    def _build_train_step(self):
+        from ..jit import TrainStep
+
+        loss_layer = self._loss
+
+        def loss_fn(run_model, *batch):
+            # convention: last element is the label
+            *ins, label = batch
+            out = run_model(*ins)
+            return loss_layer(out, label)
+
+        return TrainStep(self.network, loss_fn, self._optimizer)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        if self._use_jit and update and len(labels) == 1:
+            if self._train_step is None:
+                self._train_step = self._build_train_step()
+            loss = self._train_step(*inputs, labels[0])
+            from ..optimizer.lr import LRScheduler
+
+            if isinstance(self._optimizer._learning_rate, LRScheduler):
+                pass  # stepped by LRScheduler callback
+            return [float(loss.numpy())]
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels)
+        backward(loss)
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*inputs)
+        metrics = []
+        if self._loss is not None and labels:
+            loss = self._loss(outputs, *labels)
+            metrics.append(float(loss.numpy()))
+        for metric in self._metrics:
+            corr = metric.compute(outputs, *labels)
+            metric.update(corr)
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        out = self.network(*inputs)
+        if isinstance(out, (list, tuple)):
+            return [o.numpy() for o in out]
+        return [out.numpy()]
+
+    # -- loops ---------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._make_loader(train_data, batch_size, shuffle)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=["loss"] + [n for m in self._metrics for n in _to_list(m.name())])
+        self.stop_training = False
+        cbks.on_train_begin({})
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step, {})
+                *ins, label = batch if isinstance(batch, (list, tuple)) else (batch,)
+                losses = self.train_batch(ins, [label])
+                logs = {"loss": losses[0]}
+                cbks.on_train_batch_end(step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs if steps else {})
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
+                              callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end({})
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        if isinstance(callbacks, cbks_mod.CallbackList):
+            cbks = callbacks
+        else:
+            cbks = cbks_mod.config_callbacks(callbacks, model=self, verbose=verbose,
+                                             mode="eval")
+        cbks.on_eval_begin({})
+        losses = []
+        for step, batch in enumerate(loader):
+            *ins, label = batch if isinstance(batch, (list, tuple)) else (batch,)
+            m = self.eval_batch(ins, [label])
+            if m:
+                losses.append(m[0])
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for metric in self._metrics:
+            res = metric.accumulate()
+            names = _to_list(metric.name())
+            vals = _to_list(res)
+            for n, v in zip(names, vals):
+                logs[n] = v
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins = batch if isinstance(batch, (list, tuple)) else (batch,)
+            outputs.append(self.predict_batch(list(ins)))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save
+
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load
+
+        sd = load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtype)

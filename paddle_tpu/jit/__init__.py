@@ -1,0 +1,274 @@
+"""paddle_tpu.jit — eager→compiled bridge.
+
+This is the TPU-native replacement for BOTH reference worlds:
+- ``paddle.jit.to_static`` (dygraph_to_static ProgramTranslator,
+  reference python/paddle/fluid/dygraph/dygraph_to_static/) — here there is
+  no AST rewriting: jax traces the eager code directly, so ``to_static`` is
+  "functionalize + jax.jit".
+- the static Program+Executor pipeline — a traced function IS the program.
+
+Key primitives:
+- ``state(layer)`` → (params, buffers) dicts of raw jax arrays.
+- ``functional_call(layer, params, buffers, *args)`` → (out, new_buffers):
+  runs ``layer.forward`` with the given arrays bound in place of its
+  Parameters/buffers. Buffer mutation (BatchNorm running stats) is captured
+  and returned instead of leaking tracers.
+- ``TrainStep(model, loss_fn, optimizer)`` → one fused XLA program per
+  (shape-set): forward + backward + optimizer update, the analog of the
+  reference executor running the whole ProgramDesc in one go.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Parameter, Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["state", "functional_call", "to_static", "TrainStep", "not_to_static",
+           "InputSpec", "save", "load"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def state(layer: Layer):
+    params = {k: p._data for k, p in layer.named_parameters()}
+    buffers = {k: b._data for k, b in layer.named_buffers() if b is not None}
+    return params, buffers
+
+
+def _named_state_tensors(layer: Layer):
+    out = {}
+    for k, p in layer.named_parameters():
+        out[k] = p
+    for k, b in layer.named_buffers():
+        if b is not None:
+            out[k] = b
+    return out
+
+
+def functional_call(layer: Layer, params: Dict[str, Any], buffers: Dict[str, Any],
+                    *args, training: Optional[bool] = None, **kwargs):
+    """Run layer.forward with arrays bound into its Parameters/buffers.
+
+    Thread-unsafe by design (same as the reference's global tracer state);
+    call within one trace at a time.
+    """
+    tensors = _named_state_tensors(layer)
+    saved = {}
+    saved_training = None
+    try:
+        for name, arr in {**params, **buffers}.items():
+            t = tensors.get(name)
+            if t is None:
+                raise KeyError(f"no parameter/buffer named {name}")
+            saved[name] = t._data
+            t._data = arr if not isinstance(arr, Tensor) else arr._data
+        if training is not None:
+            saved_training = [(l, l.training) for l in layer.sublayers(include_self=True)]
+            for l, _ in saved_training:
+                l.training = training
+        out = layer(*args, **kwargs)
+        new_buffers = {name: tensors[name]._data for name in buffers}
+        return out, new_buffers
+    finally:
+        for name, arr in saved.items():
+            tensors[name]._data = arr
+        if saved_training:
+            for l, was in saved_training:
+                l.training = was
+
+
+def _tree_tensor_to_array(x):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def _tree_array_to_tensor(x):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if isinstance(v, (jax.Array,)) or hasattr(v, "dtype") else v, x)
+
+
+class StaticFunction:
+    """Result of to_static: jit-compiled callable with .forward parity."""
+
+    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None):
+        self._input_spec = input_spec
+        if isinstance(fn_or_layer, Layer):
+            self._layer = fn_or_layer
+            self._fn = None
+        else:
+            self._layer = None
+            self._fn = fn_or_layer
+        self._compiled = None
+
+    def _make_compiled(self):
+        if self._layer is not None:
+            layer = self._layer
+
+            def pure(params, buffers, training, args, kwargs):
+                out, new_buf = functional_call(layer, params, buffers, *args,
+                                               training=training, **kwargs)
+                return _tree_tensor_to_array(out), new_buf
+
+            self._compiled = jax.jit(pure, static_argnums=(2,))
+        else:
+            fn = self._fn
+
+            def pure_fn(args, kwargs):
+                args = _tree_array_to_tensor(args)
+                kwargs = _tree_array_to_tensor(kwargs)
+                return _tree_tensor_to_array(fn(*args, **kwargs))
+
+            self._compiled = jax.jit(pure_fn)
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._make_compiled()
+        arr_args = _tree_tensor_to_array(args)
+        arr_kwargs = _tree_tensor_to_array(kwargs)
+        if self._layer is not None:
+            params, buffers = state(self._layer)
+            out, new_buf = self._compiled(params, buffers, self._layer.training,
+                                          arr_args, arr_kwargs)
+            # write back mutated buffers eagerly
+            tensors = _named_state_tensors(self._layer)
+            for name, arr in new_buf.items():
+                tensors[name]._data = arr
+            return _tree_array_to_tensor(out)
+        return _tree_array_to_tensor(self._compiled(arr_args, arr_kwargs))
+
+    # Layer-protocol passthrough
+    def __getattr__(self, item):
+        if self._layer is not None:
+            return getattr(self._layer, item)
+        return getattr(self._fn, item)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """paddle.jit.to_static parity (decorator or call)."""
+    if function is None:
+        return functools.partial(to_static, input_spec=input_spec,
+                                 build_strategy=build_strategy)
+    return StaticFunction(function, input_spec, build_strategy)
+
+
+def not_to_static(fn):
+    return fn
+
+
+class TrainStep:
+    """Fused forward+backward+update as one compiled XLA program.
+
+    ``step(*batch)`` runs the whole training step on device and writes the
+    updated params/slots back into the eager model. This is the performance
+    path — the analog of ParallelExecutor running the rewritten program
+    (reference executor.py:998) — while plain eager backward mirrors dygraph.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 donate: bool = True, grad_postprocess: Optional[Callable] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.grad_postprocess = grad_postprocess
+        self._param_names = [k for k, _ in model.named_parameters()]
+        self._params = {k: p for k, p in model.named_parameters()}
+        # materialize slots eagerly in deterministic order
+        self._slot_values = {}
+        for k in self._param_names:
+            p = self._params[k]
+            self._slot_values[k] = list(self.optimizer._get_slots(p))
+        self._hyper = {k: tuple(sorted(self.optimizer._hyper(self._params[k]).items()))
+                       for k in self._param_names}
+        self._compiled = None
+
+    def _build(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        param_names = self._param_names
+        hyper = self._hyper
+        pure_update = type(opt)._pure_update
+        grad_post = self.grad_postprocess
+
+        # loss_fn contract: loss_fn(run_model, *batch_tensors) -> loss Tensor,
+        # where run_model(*model_inputs) executes the params-bound model.
+        def step_impl(params, slots, buffers, lr, batch):
+            def loss_of(params):
+                args = _tree_array_to_tensor(batch)
+                captured = dict(buffers)
+
+                def run_model(*xs, **kw):
+                    out, new_buf = functional_call(model, params, captured, *xs,
+                                                   training=True, **kw)
+                    captured.update(new_buf)
+                    return out
+
+                loss = loss_fn(run_model, *args)
+                return (loss._data if isinstance(loss, Tensor) else loss), captured
+
+            (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            if grad_post is not None:
+                grads = grad_post(grads)
+            new_params = {}
+            new_slots = {}
+            for k in param_names:
+                h = dict(hyper[k])
+                out = pure_update(params[k], grads[k].astype(params[k].dtype),
+                                  jnp.asarray(lr, jnp.float32), *slots[k], **h)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                new_params[k] = out[0]
+                new_slots[k] = list(out[1:])
+            return new_params, new_slots, new_buffers, loss
+
+        self._compiled = jax.jit(step_impl, donate_argnums=(0, 1))
+
+    def __call__(self, *batch):
+        if self._compiled is None:
+            self._build()
+        params = {k: self._params[k]._data for k in self._param_names}
+        buffers = {k: b._data for k, b in self.model.named_buffers() if b is not None}
+        lr = self.optimizer.get_lr()
+        arr_batch = _tree_tensor_to_array(batch)
+        new_params, new_slots, new_buffers, loss = self._compiled(
+            params, self._slot_values, buffers, lr, arr_batch)
+        for k in self._param_names:
+            self._params[k]._data = new_params[k]
+            self._slot_values[k] = new_slots[k]
+            self.optimizer._set_slots(self._params[k], new_slots[k])
+        tensors = _named_state_tensors(self.model)
+        for name, arr in new_buffers.items():
+            tensors[name]._data = arr
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: persist state_dict + spec (compiled-module
+    export to StableHLO is provided by paddle_tpu.static.serialize)."""
+    from ..framework.io import save as _save
+
+    if isinstance(layer, StaticFunction):
+        layer = layer._layer
+    _save(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+
+    return _load(path + ".pdparams")

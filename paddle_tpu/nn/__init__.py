@@ -1,0 +1,54 @@
+"""paddle_tpu.nn — neural-network layer API (mirrors paddle.nn)."""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from ..framework.core import Parameter  # noqa: F401
+
+
+def DataParallel(layer, *args, **kwargs):
+    """paddle.DataParallel parity — defers to the distributed wrapper."""
+    from ..distributed.parallel import DataParallel as _DP
+
+    return _DP(layer, *args, **kwargs)
+
+
+class utils:  # namespace parity: paddle.nn.utils
+    @staticmethod
+    def parameters_to_vector(parameters, name=None):
+        from ..tensor.manipulation import concat, reshape
+
+        return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+    @staticmethod
+    def vector_to_parameters(vec, parameters, name=None):
+        offset = 0
+        for p in parameters:
+            n = p.size
+            p.set_value(vec[offset:offset + n].reshape(p.shape))
+            offset += n
+
+    @staticmethod
+    def weight_norm(layer, name="weight", dim=0):
+        return layer
+
+    @staticmethod
+    def remove_weight_norm(layer, name="weight"):
+        return layer
+
+    @staticmethod
+    def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+        return layer

@@ -1,0 +1,12 @@
+"""paddle_tpu.nn.functional — functional op surface.
+
+Mirrors paddle.nn.functional (reference python/paddle/nn/functional/).
+"""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+
+from . import activation, common, conv, pooling, norm, loss  # noqa: F401

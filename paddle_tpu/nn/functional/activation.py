@@ -1,0 +1,242 @@
+"""Activation functionals (reference python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = [
+    "relu", "relu6", "gelu", "sigmoid", "tanh", "softmax", "log_softmax",
+    "leaky_relu", "elu", "selu", "celu", "silu", "swish", "mish",
+    "hardshrink", "hardsigmoid", "hardswish", "hardtanh", "softplus",
+    "softshrink", "softsign", "tanhshrink", "thresholded_relu", "maxout",
+    "prelu", "rrelu", "glu", "gumbel_softmax", "log_sigmoid",
+]
+
+
+def _mk(fn, name):
+    def op(x, name=None):
+        return apply_op(fn, x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _mk(jax.nn.relu, "relu")
+sigmoid = _mk(jax.nn.sigmoid, "sigmoid")
+tanh = _mk(jnp.tanh, "tanh")
+silu = _mk(jax.nn.silu, "silu")
+softsign = _mk(jax.nn.soft_sign, "softsign")
+log_sigmoid = _mk(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def _relu6(x):
+    return jnp.minimum(jnp.maximum(x, 0), 6.0)
+
+
+relu6 = _mk(_relu6, "relu6")
+
+
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(_gelu, x, approximate=bool(approximate))
+
+
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...tensor.manipulation import cast
+
+        x = cast(x, dtype)
+    return apply_op(_softmax, x, axis=int(axis))
+
+
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...tensor.manipulation import cast
+
+        x = cast(x, dtype)
+    return apply_op(_log_softmax, x, axis=int(axis))
+
+
+def _leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(_leaky_relu, x, negative_slope=float(negative_slope))
+
+
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(_elu, x, alpha=float(alpha))
+
+
+def _selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(_selu, x, scale=float(scale), alpha=float(alpha))
+
+
+def _celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(_celu, x, alpha=float(alpha))
+
+
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+swish = _mk(_swish, "swish")
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+mish = _mk(_mish, "mish")
+
+
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(_hardshrink, x, threshold=float(threshold))
+
+
+def _hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(_hardsigmoid, x, slope=float(slope), offset=float(offset))
+
+
+def _hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+hardswish = _mk(_hardswish, "hardswish")
+
+
+def _hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply_op(_hardtanh, x, min=float(min), max=float(max))
+
+
+def _softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(_softplus, x, beta=float(beta), threshold=float(threshold))
+
+
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(_softshrink, x, threshold=float(threshold))
+
+
+def _tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+tanhshrink = _mk(_tanhshrink, "tanhshrink")
+
+
+def _thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(_thresholded_relu, x, threshold=float(threshold))
+
+
+def _maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis: axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply_op(_maxout, x, groups=int(groups), axis=int(axis))
+
+
+def _prelu(x, weight):
+    if weight.size > 1:
+        shape = [1] * x.ndim
+        shape[1] = weight.size
+        weight = weight.reshape(shape)
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return apply_op(_prelu, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    from ...framework import random as grandom
+
+    if training:
+        xa = x._data if isinstance(x, Tensor) else x
+        slope = jax.random.uniform(grandom.next_key(), xa.shape, minval=lower, maxval=upper)
+        return apply_op(_rrelu_apply, x, Tensor(slope))
+    return leaky_relu(x, (lower + upper) / 2)
+
+
+def _rrelu_apply(x, slope):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def _glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(_glu, x, axis=int(axis))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as grandom
+
+    xa = x._data if isinstance(x, Tensor) else x
+    g = jax.random.gumbel(grandom.next_key(), xa.shape, dtype=xa.dtype)
+    return apply_op(_gumbel_softmax, x, Tensor(g), temperature=float(temperature), hard=bool(hard), axis=int(axis))
+
+
+def _gumbel_softmax(x, g, temperature=1.0, hard=False, axis=-1):
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0, axis=axis, inplace=False)
+        # straight-through: hard value forward, soft gradient backward
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y

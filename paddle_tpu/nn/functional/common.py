@@ -1,0 +1,303 @@
+"""Common functionals: linear, dropout, embedding, interpolate, one_hot...
+
+Reference surface: python/paddle/nn/functional/common.py, input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as grandom
+from ...framework.core import Tensor, apply_op
+from ...tensor.manipulation import pad  # noqa: F401  (re-export, paddle.nn.functional.pad)
+from ...tensor.creation import one_hot  # noqa: F401
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "interpolate", "upsample", "one_hot", "pad", "unfold",
+    "fold", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+    "normalize", "label_smooth", "class_center_sample", "bilinear",
+]
+
+
+def _linear(x, w, b=None):
+    y = jnp.matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return apply_op(_linear, x, weight)
+    return apply_op(_linear, x, weight, bias)
+
+
+def _dropout_train(x, mask, p, mode):
+    if mode == "upscale_in_train":
+        return x * mask / (1.0 - p)
+    return x * mask
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(_scale_by, x, factor=1.0 - p)
+        return x
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if axis is None:
+        mshape = xa.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        mshape = tuple(s if i in axes else 1 for i, s in enumerate(xa.shape))
+    keep = jax.random.bernoulli(grandom.next_key(), 1.0 - p, mshape).astype(xa.dtype)
+    return apply_op(_dropout_train, x, Tensor(jnp.broadcast_to(keep, xa.shape)), p=float(p), mode=mode)
+
+
+def _scale_by(x, factor):
+    return x * factor
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    keep = jax.random.bernoulli(grandom.next_key(), 1.0 - p, xa.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return apply_op(_alpha_dropout_apply, x, Tensor(keep), alpha_p=alpha_p, a=a, b=b)
+
+
+def _alpha_dropout_apply(x, keep, alpha_p, a, b):
+    return (jnp.where(keep, x, alpha_p) * a + b).astype(x.dtype)
+
+
+def _embedding(weight, ids, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return apply_op(_embedding, weight, x, padding_idx=padding_idx)
+
+
+def _interp_size(x, size, scale_factor, n_spatial):
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+        return tuple(size)
+    if isinstance(scale_factor, (int, float)):
+        scale_factor = [scale_factor] * n_spatial
+    return tuple(int(np.floor(s * f)) for s, f in zip(x.shape[2:], scale_factor))
+
+
+def _interpolate(x, out_size, mode, align_corners):
+    # channels-first: resize spatial dims only
+    n_spatial = x.ndim - 2
+    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+              "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if not align_corners:
+        target = x.shape[:2] + out_size
+        return jax.image.resize(x, target, method=method)
+    # align_corners: build index grid
+    idx = []
+    for i, o in enumerate(out_size):
+        s = x.shape[2 + i]
+        if o == 1:
+            idx.append(jnp.zeros((1,)))
+        else:
+            idx.append(jnp.linspace(0.0, s - 1.0, o))
+    if method == "nearest":
+        gather = [jnp.round(g).astype(jnp.int32) for g in idx]
+        out = x
+        for d, g in enumerate(gather):
+            out = jnp.take(out, g, axis=2 + d)
+        return out
+    # linear interp with corner alignment per spatial dim
+    out = x
+    for d, g in enumerate(idx):
+        lo = jnp.floor(g).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, x.shape[2 + d] - 1)
+        w = (g - lo).astype(x.dtype)
+        a = jnp.take(out, lo, axis=2 + d)
+        b = jnp.take(out, hi, axis=2 + d)
+        shape = [1] * out.ndim
+        shape[2 + d] = g.shape[0]
+        w = w.reshape(shape)
+        out = a * (1 - w) + b * w
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    if data_format not in ("NCHW", "NCL", "NCDHW"):
+        raise NotImplementedError("channels-last interpolate not supported yet")
+    n_spatial = x.ndim - 2
+    out_size = _interp_size(x, size, scale_factor, n_spatial)
+    return apply_op(_interpolate, x, out_size=out_size, mode=mode, align_corners=bool(align_corners))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def _unfold(x, k, strides, pads, dils):
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])))
+    kh, kw = k
+    oh = (x.shape[2] - (dils[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (x.shape[3] - (dils[1] * (kw - 1) + 1)) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=strides,
+        padding="VALID", rhs_dilation=dils,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    if isinstance(paddings, int):
+        p = (paddings,) * 4
+    elif len(paddings) == 2:
+        p = (paddings[0], paddings[0], paddings[1], paddings[1])
+    else:
+        p = tuple(paddings)
+    return apply_op(_unfold, x, k=k, strides=s, pads=p, dils=d)
+
+
+def _fold(x, output_sizes, k, strides, pads, dils):
+    n, ckk, L = x.shape
+    kh, kw = k
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    ph = oh + pads[0] + pads[1]
+    pw = ow + pads[2] + pads[3]
+    nh = (ph - (dils[0] * (kh - 1) + 1)) // strides[0] + 1
+    nw = (pw - (dils[1] * (kw - 1) + 1)) // strides[1] + 1
+    x = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dils[0]
+            wj = j * dils[1]
+            out = out.at[:, :, hi:hi + nh * strides[0]:strides[0], wj:wj + nw * strides[1]:strides[1]].add(x[:, :, i, j])
+    return out[:, :, pads[0]:ph - pads[1], pads[2]:pw - pads[3]]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    o = _pair(output_sizes)
+    if isinstance(paddings, int):
+        p = (paddings,) * 4
+    elif len(paddings) == 2:
+        p = (paddings[0], paddings[0], paddings[1], paddings[1])
+    else:
+        p = tuple(paddings)
+    return apply_op(_fold, x, output_sizes=o, k=k, strides=s, pads=p, dils=d)
+
+
+def _cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply_op(_cosine_similarity, x1, x2, axis=int(axis), eps=float(eps))
+
+
+def _pixel_shuffle(x, factor):
+    n, c, h, w = x.shape
+    r = factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply_op(_pixel_shuffle, x, factor=int(upscale_factor))
+
+
+def _pixel_unshuffle(x, factor):
+    n, c, h, w = x.shape
+    r = factor
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply_op(_pixel_unshuffle, x, factor=int(downscale_factor))
+
+
+def _normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply_op(_normalize, x, p=float(p), axis=int(axis), epsilon=float(epsilon))
+
+
+def _label_smooth(label, epsilon=0.1):
+    k = label.shape[-1]
+    return label * (1.0 - epsilon) + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return apply_op(_label_smooth_prior, label, prior_dist, epsilon=float(epsilon))
+    return apply_op(_label_smooth, label, epsilon=float(epsilon))
+
+
+def _label_smooth_prior(label, prior, epsilon=0.1):
+    return label * (1.0 - epsilon) + epsilon * prior
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-style sampling not yet ported")
+
+
+def _bilinear(x1, x2, w, b=None):
+    # w: [out, in1, in2]
+    y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is None:
+        return apply_op(_bilinear, x1, x2, weight)
+    return apply_op(_bilinear, x1, x2, weight, bias)

@@ -1,0 +1,184 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference surface: python/paddle/nn/functional/conv.py (which dispatches to
+the cudnn conv ops, operators/conv_op.cc). On TPU, XLA tiles convs onto the
+MXU directly; NCHW layouts are kept for API parity (XLA transposes as
+needed — the perf-critical layout rewrite happens in XLA's layout pass).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n, strides=None):
+    """Returns ('EXPLICIT', ((lo,hi),...)) or ('SAME'/'VALID', None)."""
+    if isinstance(padding, str):
+        return padding.upper(), None
+    if isinstance(padding, (int, np.integer)):
+        return "EXPLICIT", tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return "EXPLICIT", tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return "EXPLICIT", tuple((int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n))
+    # paddle's [[0,0],[0,0],[ph0,ph1],[pw0,pw1]] form
+    if len(padding) == n + 2:
+        spatial = padding[2:]
+        return "EXPLICIT", tuple((int(p[0]), int(p[1])) for p in spatial)
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, w, b, strides, padding_kind, pads, dils, groups, n_spatial):
+    dn_map = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}
+    dn = dn_map[n_spatial]
+    pad = pads if padding_kind == "EXPLICIT" else padding_kind
+    y = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dils,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * n_spatial)
+    return y
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, n):
+    strides = _ntuple(stride, n)
+    dils = _ntuple(dilation, n)
+    kind, pads = _norm_padding(padding, n)
+    args = (x, weight) if bias is None else (x, weight, bias)
+    if bias is None:
+        return apply_op(_conv_nobias, x, weight, strides=strides, padding_kind=kind,
+                        pads=pads, dils=dils, groups=int(groups), n_spatial=n)
+    return apply_op(_conv_bias, x, weight, bias, strides=strides, padding_kind=kind,
+                    pads=pads, dils=dils, groups=int(groups), n_spatial=n)
+
+
+def _conv_nobias(x, w, strides, padding_kind, pads, dils, groups, n_spatial):
+    return _conv(x, w, None, strides, padding_kind, pads, dils, groups, n_spatial)
+
+
+def _conv_bias(x, w, b, strides, padding_kind, pads, dils, groups, n_spatial):
+    return _conv(x, w, b, strides, padding_kind, pads, dils, groups, n_spatial)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3)
+
+
+def _conv_transpose(x, w, b, strides, pads, output_padding, dils, groups, n_spatial):
+    # weight layout paddle: [in, out//groups, *k]; lax transpose conv via
+    # conv_general_dilated with lhs_dilation = strides.
+    dn_map = {1: ("NCH", "IOH", "NCH"), 2: ("NCHW", "IOHW", "NCHW"), 3: ("NCDHW", "IODHW", "NCDHW")}
+    dn = dn_map[n_spatial]
+    k = w.shape[2:]
+    # effective kernel
+    eff_k = tuple(dils[i] * (k[i] - 1) + 1 for i in range(n_spatial))
+    if isinstance(pads, str):
+        if pads == "SAME":
+            pad = tuple(
+                (min(eff_k[i] - 1, (eff_k[i] - 1 + 1) // 2),) * 2 for i in range(n_spatial)
+            )
+            pad = tuple((eff_k[i] - 1 - p[0], eff_k[i] - 1 - p[1] + output_padding[i]) for i, p in enumerate(pad))
+        else:  # VALID
+            pad = tuple((eff_k[i] - 1, eff_k[i] - 1 + output_padding[i]) for i in range(n_spatial))
+    else:
+        pad = tuple(
+            (eff_k[i] - 1 - pads[i][0], eff_k[i] - 1 - pads[i][1] + output_padding[i])
+            for i in range(n_spatial)
+        )
+    if groups > 1:
+        # split into groups; lax feature_group_count path needs OIHW-style
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        ys = []
+        for xg, wg in zip(xs, ws):
+            ys.append(
+                jax.lax.conv_general_dilated(
+                    xg, jnp.flip(wg, axis=tuple(range(2, 2 + n_spatial))),
+                    window_strides=(1,) * n_spatial,
+                    padding=pad,
+                    lhs_dilation=strides,
+                    rhs_dilation=dils,
+                    dimension_numbers=dn,
+                )
+            )
+        y = jnp.concatenate(ys, axis=1)
+    else:
+        y = jax.lax.conv_general_dilated(
+            x, jnp.flip(w, axis=tuple(range(2, 2 + n_spatial))),
+            window_strides=(1,) * n_spatial,
+            padding=pad,
+            lhs_dilation=strides,
+            rhs_dilation=dils,
+            dimension_numbers=dn,
+        )
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * n_spatial)
+    return y
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, n):
+    strides = _ntuple(stride, n)
+    dils = _ntuple(dilation, n)
+    out_pad = _ntuple(output_padding, n)
+    kind, pads = _norm_padding(padding, n)
+    pad_arg = kind if kind in ("SAME", "VALID") else pads
+    if bias is None:
+        return apply_op(_ct_nobias, x, weight, strides=strides, pads=pad_arg,
+                        output_padding=out_pad, dils=dils, groups=int(groups), n_spatial=n)
+    return apply_op(_ct_bias, x, weight, bias, strides=strides, pads=pad_arg,
+                    output_padding=out_pad, dils=dils, groups=int(groups), n_spatial=n)
+
+
+def _ct_nobias(x, w, strides, pads, output_padding, dils, groups, n_spatial):
+    return _conv_transpose(x, w, None, strides, pads, output_padding, dils, groups, n_spatial)
+
+
+def _ct_bias(x, w, b, strides, pads, output_padding, dils, groups, n_spatial):
+    return _conv_transpose(x, w, b, strides, pads, output_padding, dils, groups, n_spatial)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 1)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 3)

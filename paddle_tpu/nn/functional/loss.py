@@ -1,0 +1,400 @@
+"""Loss functionals (reference python/paddle/nn/functional/loss.py,
+operators/math/cross_entropy.cu, softmax_with_cross_entropy_op.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
+    "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss", "triplet_margin_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _cross_entropy(x, label, soft_label, use_softmax, ignore_index, reduction, axis, ls_weight=None):
+    if use_softmax:
+        logp = jax.nn.log_softmax(x, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(x, 1e-30))
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+    else:
+        lab = label
+        if lab.ndim == x.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis)
+        ignored = lab == ignore_index
+        safe_lab = jnp.where(ignored, 0, lab)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe_lab, axis), axis=axis)
+        loss = jnp.squeeze(loss, axis)
+        mask = jnp.logical_not(ignored).astype(loss.dtype)
+        loss = loss * mask
+        if ls_weight is None and reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+    if ls_weight is not None:
+        # per-class weights
+        if soft_label:
+            w = jnp.sum(label * ls_weight, axis=axis)
+        else:
+            lab = label
+            if lab.ndim == x.ndim and lab.shape[axis] == 1:
+                lab = jnp.squeeze(lab, axis)
+            ignored = lab == ignore_index
+            w = jnp.take(ls_weight, jnp.where(ignored, 0, lab))
+            w = w * jnp.logical_not(ignored).astype(w.dtype)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    ii = int(ignore_index) if not soft_label else -100
+    if weight is not None:
+        return apply_op(_ce_weighted, input, label, weight, soft_label=bool(soft_label),
+                        use_softmax=bool(use_softmax), ignore_index=ii,
+                        reduction=reduction, axis=int(axis))
+    return apply_op(_ce_plain, input, label, soft_label=bool(soft_label),
+                    use_softmax=bool(use_softmax), ignore_index=ii,
+                    reduction=reduction, axis=int(axis))
+
+
+def _ce_plain(x, label, soft_label, use_softmax, ignore_index, reduction, axis):
+    return _cross_entropy(x, label, soft_label, use_softmax, ignore_index, reduction, axis)
+
+
+def _ce_weighted(x, label, w, soft_label, use_softmax, ignore_index, reduction, axis):
+    return _cross_entropy(x, label, soft_label, use_softmax, ignore_index, reduction, axis, ls_weight=w)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _sm
+
+    from ...tensor.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _sm(logits, axis=axis)
+    return loss
+
+
+def _nll(x, label, reduction, ignore_index):
+    loss = -jnp.take_along_axis(x, label[..., None] if x.ndim == label.ndim + 1 else label, axis=-1 if x.ndim == label.ndim + 1 else 1)
+    loss = jnp.squeeze(loss, -1 if x.ndim == label.ndim + 1 else 1)
+    if ignore_index >= 0:
+        mask = (label != ignore_index).astype(loss.dtype)
+        loss = loss * mask
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    # input is log-probabilities [N, C, ...]
+    if input.ndim > 2:
+        # move class dim last
+        from ...tensor.manipulation import moveaxis
+
+        input = moveaxis(input, 1, -1)  # noqa: A001
+    if weight is not None:
+        return apply_op(_nll_weighted, input, label, weight, reduction=reduction, ignore_index=int(ignore_index))
+    return apply_op(_nll_plain, input, label, reduction=reduction, ignore_index=int(ignore_index))
+
+
+def _nll_plain(x, label, reduction, ignore_index):
+    loss = -jnp.take_along_axis(x, label[..., None], axis=-1)[..., 0]
+    if ignore_index >= 0:
+        mask = (label != ignore_index).astype(loss.dtype)
+        loss = loss * mask
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(loss, reduction)
+
+
+def _nll_weighted(x, label, w, reduction, ignore_index):
+    loss = -jnp.take_along_axis(x, label[..., None], axis=-1)[..., 0]
+    wt = jnp.take(w, label)
+    if ignore_index >= 0:
+        wt = wt * (label != ignore_index).astype(loss.dtype)
+    loss = loss * wt
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def _mse(x, y, reduction):
+    return _reduce(jnp.square(x - y), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op(_mse, input, label, reduction=reduction)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply_op(_sq_err, input, label)
+
+
+def _sq_err(x, y):
+    return jnp.square(x - y)
+
+
+def _l1(x, y, reduction):
+    return _reduce(jnp.abs(x - y), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op(_l1, input, label, reduction=reduction)
+
+
+def _smooth_l1(x, y, reduction, delta):
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    # paddle's smooth_l1_loss uses delta-scaled huber; default delta=1.0
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    return apply_op(_smooth_l1, input, label, reduction=reduction, delta=float(delta))
+
+
+def _bce(x, y, reduction):
+    eps = 1e-12
+    loss = -(y * jnp.log(jnp.maximum(x, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    if weight is not None:
+        return apply_op(_bce_w, input, label, weight, reduction=reduction)
+    return apply_op(_bce, input, label, reduction=reduction)
+
+
+def _bce_w(x, y, w, reduction):
+    eps = 1e-12
+    loss = -w * (y * jnp.log(jnp.maximum(x, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+    return _reduce(loss, reduction)
+
+
+def _bce_logits(x, y, reduction):
+    loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    if pos_weight is not None:
+        return apply_op(_bce_logits_pw, logit, label, pos_weight, reduction=reduction)
+    if weight is not None:
+        return apply_op(_bce_logits_w, logit, label, weight, reduction=reduction)
+    return apply_op(_bce_logits, logit, label, reduction=reduction)
+
+
+def _bce_logits_w(x, y, w, reduction):
+    loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return _reduce(w * loss, reduction)
+
+
+def _bce_logits_pw(x, y, pw, reduction):
+    log_sig = jax.nn.log_sigmoid(x)
+    log_sig_neg = jax.nn.log_sigmoid(-x)
+    loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+    return _reduce(loss, reduction)
+
+
+def _kl_div(x, y, reduction):
+    loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op(_kl_div, input, label, reduction=reduction)
+
+
+def _margin_ranking(x, y, label, margin, reduction):
+    loss = jnp.maximum(0.0, -label * (x - y) + margin)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    return apply_op(_margin_ranking, input, other, label, margin=float(margin), reduction=reduction)
+
+
+def _hinge_embedding(x, y, margin, reduction):
+    loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    return apply_op(_hinge_embedding, input, label, margin=float(margin), reduction=reduction)
+
+
+def _cosine_embedding(x1, x2, y, margin, reduction):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12
+    )
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(_cosine_embedding, input1, input2, label, margin=float(margin), reduction=reduction)
+
+
+def _log_loss(x, label, epsilon):
+    return -label * jnp.log(x + epsilon) - (1 - label) * jnp.log(1 - x + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return apply_op(_log_loss, input, label, epsilon=float(epsilon))
+
+
+def _sigmoid_focal(x, label, normalizer, alpha, gamma, reduction):
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    if normalizer is not None:
+        return apply_op(_sigmoid_focal_norm, logit, label, normalizer,
+                        alpha=float(alpha), gamma=float(gamma), reduction=reduction)
+    return apply_op(_sigmoid_focal, logit, label, normalizer=None,
+                    alpha=float(alpha), gamma=float(gamma), reduction=reduction)
+
+
+def _sigmoid_focal_norm(x, label, normalizer, alpha, gamma, reduction):
+    return _sigmoid_focal(x, label, normalizer, alpha, gamma, reduction)
+
+
+def _dice(x, label, epsilon):
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label, axis=reduce_dims)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    from ...tensor.creation import one_hot
+
+    if label.shape[-1] == 1:
+        from ...tensor.manipulation import squeeze
+
+        label = squeeze(label, [-1])
+    label = one_hot(label, input.shape[-1])
+    return apply_op(_dice, input, label, epsilon=float(epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from ...tensor import matmul, mean, sum as tsum
+
+    reg = (mean(tsum(anchor * anchor, -1)) + mean(tsum(positive * positive, -1))) * l2_reg * 0.25
+    sim = matmul(anchor, positive, transpose_y=True)
+    from ...tensor.creation import one_hot as oh
+
+    lab = labels
+    n = anchor.shape[0]
+    labt = (lab.reshape([-1, 1]) == lab.reshape([1, -1])).astype("float32")
+    labt = labt / labt.sum(axis=1, keepdim=True)
+    ce = cross_entropy(sim, labt, soft_label=True)
+    return ce + reg
+
+
+def _ctc_loss_impl(log_probs, labels, input_lengths, label_lengths, blank, reduction):
+    # log_probs: [T, N, C]; standard CTC forward (log-space DP over lax.scan)
+    T, N, C = log_probs.shape
+    L = labels.shape[1]
+    # extended label seq with blanks: length 2L+1
+    ext = jnp.full((N, 2 * L + 1), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    S = 2 * L + 1
+    neg_inf = -1e30
+
+    # allowed transitions: alpha[s] from alpha[s], alpha[s-1], alpha[s-2] (if ext[s]!=blank and ext[s]!=ext[s-2])
+    same = jnp.concatenate([jnp.full((N, 2), True), ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = jnp.logical_and(ext != blank, jnp.logical_not(same))
+
+    def emit(t_lp, s_idx):
+        return jnp.take_along_axis(t_lp, s_idx, axis=1)
+
+    alpha0 = jnp.full((N, S), neg_inf)
+    lp0 = log_probs[0]
+    alpha0 = alpha0.at[:, 0].set(lp0[jnp.arange(N), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(L > 0, lp0[jnp.arange(N), ext[:, 1]], neg_inf))
+
+    def step(alpha, lp):
+        a_prev1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_prev2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_prev2 = jnp.where(can_skip, a_prev2, neg_inf)
+        m = jnp.maximum(jnp.maximum(alpha, a_prev1), a_prev2)
+        m_safe = jnp.maximum(m, neg_inf)
+        summed = (
+            jnp.exp(alpha - m_safe) + jnp.exp(a_prev1 - m_safe) + jnp.exp(a_prev2 - m_safe)
+        )
+        new_alpha = m_safe + jnp.log(jnp.maximum(summed, 1e-37))
+        e = jnp.take_along_axis(lp, ext, axis=1)
+        new_alpha = new_alpha + e
+        return new_alpha, new_alpha
+
+    alpha_T, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, N, S]
+    # pick alpha at t = input_length-1, s in {2*label_len, 2*label_len-1}
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    aT = all_alphas[t_idx, jnp.arange(N)]  # [N, S]
+    s1 = jnp.clip(2 * label_lengths, 0, S - 1)
+    s2 = jnp.clip(2 * label_lengths - 1, 0, S - 1)
+    a1 = jnp.take_along_axis(aT, s1[:, None], axis=1)[:, 0]
+    a2 = jnp.take_along_axis(aT, s2[:, None], axis=1)[:, 0]
+    m = jnp.maximum(a1, a2)
+    ll = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m))
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1).astype(loss.dtype))
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean"):
+    return apply_op(_ctc_loss_impl, log_probs, labels, input_lengths, label_lengths,
+                    blank=int(blank), reduction=reduction)
+
+
+def _triplet_margin(a, p, n, margin, p_norm, eps, swap, reduction):
+    def d(x, y):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(x - y) + eps, p_norm), axis=-1), 1.0 / p_norm)
+
+    dp = d(a, p)
+    dn = d(a, n)
+    if swap:
+        dn = jnp.minimum(dn, d(p, n))
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    return apply_op(_triplet_margin, input, positive, negative, margin=float(margin),
+                    p_norm=float(p), eps=float(epsilon), swap=bool(swap), reduction=reduction)

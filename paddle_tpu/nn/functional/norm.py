@@ -1,0 +1,216 @@
+"""Normalization functionals (reference python/paddle/nn/functional/norm.py,
+operators/layer_norm_op.cu, batch_norm_op.cu). XLA fuses the reductions and
+scale/shift elementwise work into a couple of kernels on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm"]
+
+
+def _channel_shape(ndim, c, data_format):
+    shape = [1] * ndim
+    axis = 1 if data_format.startswith("NC") or ndim <= 2 else ndim - 1
+    shape[axis] = c
+    return tuple(shape), axis
+
+
+def _bn_infer(x, mean, var, weight, bias, epsilon, axis):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    y = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+def _bn_train(x, weight, bias, epsilon, axis):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    y = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Functional batch norm.
+
+    In training mode, updates running stats in-place on the provided
+    Tensors (mirroring the reference's in-place mean/var outputs,
+    operators/batch_norm_op.cc). Updates are stop-gradient.
+    """
+    axis = 1 if data_format.startswith("NC") or x.ndim <= 2 else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if training and not use_global_stats:
+        args = [x]
+        if weight is not None:
+            args.append(weight)
+        if bias is not None:
+            args.append(bias)
+        if weight is not None and bias is not None:
+            y, mean, var = apply_op(_bn_train3, x, weight, bias, epsilon=float(epsilon), axis=axis)
+        elif weight is None and bias is None:
+            y, mean, var = apply_op(_bn_train1, x, epsilon=float(epsilon), axis=axis)
+        else:
+            raise ValueError("batch_norm: weight/bias must both be set or both None")
+        if running_mean is not None:
+            m = momentum
+            new_mean = running_mean._data * m + jax.lax.stop_gradient(mean._data) * (1 - m)
+            new_var = running_var._data * m + jax.lax.stop_gradient(var._data) * (1 - m)
+            running_mean._data = new_mean
+            running_var._data = new_var
+        return y
+    if weight is not None and bias is not None:
+        return apply_op(_bn_infer5, x, running_mean, running_var, weight, bias,
+                        epsilon=float(epsilon), axis=axis)
+    return apply_op(_bn_infer3, x, running_mean, running_var, epsilon=float(epsilon), axis=axis)
+
+
+def _bn_train3(x, w, b, epsilon, axis):
+    return _bn_train(x, w, b, epsilon, axis)
+
+
+def _bn_train1(x, epsilon, axis):
+    return _bn_train(x, None, None, epsilon, axis)
+
+
+def _bn_infer5(x, mean, var, w, b, epsilon, axis):
+    return _bn_infer(x, mean, var, w, b, epsilon, axis)
+
+
+def _bn_infer3(x, mean, var, epsilon, axis):
+    return _bn_infer(x, mean, var, None, None, epsilon, axis)
+
+
+def _layer_norm(x, w, b, norm_ndim, epsilon):
+    axes = tuple(range(x.ndim - norm_ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    norm_ndim = len(tuple(normalized_shape))
+    if weight is not None and bias is not None:
+        return apply_op(_ln3, x, weight, bias, norm_ndim=norm_ndim, epsilon=float(epsilon))
+    if weight is not None:
+        return apply_op(_ln2w, x, weight, norm_ndim=norm_ndim, epsilon=float(epsilon))
+    if bias is not None:
+        return apply_op(_ln2b, x, bias, norm_ndim=norm_ndim, epsilon=float(epsilon))
+    return apply_op(_ln1, x, norm_ndim=norm_ndim, epsilon=float(epsilon))
+
+
+def _ln3(x, w, b, norm_ndim, epsilon):
+    return _layer_norm(x, w, b, norm_ndim, epsilon)
+
+
+def _ln2w(x, w, norm_ndim, epsilon):
+    return _layer_norm(x, w, None, norm_ndim, epsilon)
+
+
+def _ln2b(x, b, norm_ndim, epsilon):
+    return _layer_norm(x, None, b, norm_ndim, epsilon)
+
+
+def _ln1(x, norm_ndim, epsilon):
+    return _layer_norm(x, None, None, norm_ndim, epsilon)
+
+
+def _instance_norm(x, w, b, epsilon):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if w is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        y = y * w.reshape(shape)
+    if b is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        y = y + b.reshape(shape)
+    return y
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    if weight is not None and bias is not None:
+        return apply_op(_in3, x, weight, bias, epsilon=float(eps))
+    return apply_op(_in1, x, epsilon=float(eps))
+
+
+def _in3(x, w, b, epsilon):
+    return _instance_norm(x, w, b, epsilon)
+
+
+def _in1(x, epsilon):
+    return _instance_norm(x, None, None, epsilon)
+
+
+def _group_norm(x, w, b, groups, epsilon):
+    n = x.shape[0]
+    c = x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, c) + (1,) * len(spatial)
+    if w is not None:
+        y = y * w.reshape(shape)
+    if b is not None:
+        y = y + b.reshape(shape)
+    return y
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    if weight is not None and bias is not None:
+        return apply_op(_gn3, x, weight, bias, groups=int(num_groups), epsilon=float(epsilon))
+    return apply_op(_gn1, x, groups=int(num_groups), epsilon=float(epsilon))
+
+
+def _gn3(x, w, b, groups, epsilon):
+    return _group_norm(x, w, b, groups, epsilon)
+
+
+def _gn1(x, groups, epsilon):
+    return _group_norm(x, None, None, groups, epsilon)
+
+
+def _lrn(x, size, alpha, beta, k):
+    # across-channel LRN on NCHW
+    sq = jnp.square(x)
+    half = size // 2
+    pad = jnp.pad(sq, ((0, 0), (half, size - 1 - half)) + ((0, 0),) * (x.ndim - 2))
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + jax.lax.slice_in_dim(pad, i, i + x.shape[1], axis=1)
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    return apply_op(_lrn, x, size=int(size), alpha=float(alpha), beta=float(beta), k=float(k))

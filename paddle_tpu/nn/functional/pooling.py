@@ -1,0 +1,237 @@
+"""Pooling functionals via lax.reduce_window.
+
+Reference surface: python/paddle/nn/functional/pooling.py (pool2d op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool_pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n:
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n))
+    raise ValueError(f"bad pool padding {padding}")
+
+
+def _max_pool(x, ksize, strides, pads, ceil_mode, n):
+    window = (1, 1) + ksize
+    ws = (1, 1) + strides
+    if isinstance(pads, str):
+        padding = pads
+    else:
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p[0], p[1] + (strides[i] - 1 if ceil_mode else 0)) for i, p in enumerate(pads)
+        )
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, init, jax.lax.max, window, ws, padding)
+
+
+def _avg_pool(x, ksize, strides, pads, ceil_mode, exclusive, n):
+    window = (1, 1) + ksize
+    ws = (1, 1) + strides
+    if isinstance(pads, str):
+        padding = pads
+        counts_needed = padding == "SAME"
+    else:
+        extra = tuple((p[0], p[1] + (strides[i] - 1 if ceil_mode else 0)) for i, p in enumerate(pads))
+        padding = ((0, 0), (0, 0)) + extra
+        counts_needed = any(p[0] or p[1] for p in extra)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, ws, padding)
+    if counts_needed and exclusive:
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, ws, padding)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    k = _ntuple(kernel_size, 2)
+    s = _ntuple(stride if stride is not None else kernel_size, 2)
+    p = _pool_pads(padding, 2)
+    out = apply_op(_max_pool, x, ksize=k, strides=s, pads=p, ceil_mode=bool(ceil_mode), n=2)
+    if return_mask:
+        idx = _max_pool_indices(x, k, s, p)
+        return out, idx
+    return out
+
+
+def _max_pool_indices(x, k, s, p):
+    # indices over flattened H*W, paddle-style; eager helper (not hot path)
+    xa = x._data if isinstance(x, Tensor) else x
+    n_, c_, h, w = xa.shape
+    pad = ((0, 0), (0, 0)) + tuple(p) if not isinstance(p, str) else p
+    lin = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    lin = jnp.broadcast_to(lin, xa.shape)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    init = (-jnp.inf, jnp.float32(-1))
+    vals, idxs = jax.lax.reduce_window(
+        (xa.astype(jnp.float32), lin), init, sel, (1, 1) + k, (1, 1) + s, pad
+    )
+    return Tensor(idxs.astype(jnp.int64))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    from ...tensor.manipulation import squeeze, unsqueeze
+
+    x4 = unsqueeze(x, 2)
+    k = (1,) + _ntuple(kernel_size, 1)
+    s = (1,) + _ntuple(stride if stride is not None else kernel_size, 1)
+    if isinstance(padding, str):
+        p = padding.upper()
+    else:
+        p1 = _pool_pads(padding, 1)
+        p = ((0, 0),) + p1
+    out = apply_op(_max_pool, x4, ksize=k, strides=s, pads=p, ceil_mode=bool(ceil_mode), n=2)
+    return squeeze(out, [2])
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    k = _ntuple(kernel_size, 3)
+    s = _ntuple(stride if stride is not None else kernel_size, 3)
+    p = _pool_pads(padding, 3)
+    return apply_op(_max_pool, x, ksize=k, strides=s, pads=p, ceil_mode=bool(ceil_mode), n=3)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    k = _ntuple(kernel_size, 2)
+    s = _ntuple(stride if stride is not None else kernel_size, 2)
+    p = _pool_pads(padding, 2)
+    if divisor_override:
+        out = apply_op(_avg_pool_divisor, x, ksize=k, strides=s, pads=p,
+                       ceil_mode=bool(ceil_mode), divisor=float(divisor_override))
+        return out
+    return apply_op(_avg_pool, x, ksize=k, strides=s, pads=p, ceil_mode=bool(ceil_mode),
+                    exclusive=bool(exclusive), n=2)
+
+
+def _avg_pool_divisor(x, ksize, strides, pads, ceil_mode, divisor):
+    window = (1, 1) + ksize
+    ws = (1, 1) + strides
+    padding = pads if isinstance(pads, str) else ((0, 0), (0, 0)) + tuple(pads)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, ws, padding)
+    return s / divisor
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    from ...tensor.manipulation import squeeze, unsqueeze
+
+    x4 = unsqueeze(x, 2)
+    k = (1,) + _ntuple(kernel_size, 1)
+    s = (1,) + _ntuple(stride if stride is not None else kernel_size, 1)
+    if isinstance(padding, str):
+        p = padding.upper()
+    else:
+        p = ((0, 0),) + _pool_pads(padding, 1)
+    out = apply_op(_avg_pool, x4, ksize=k, strides=s, pads=p, ceil_mode=bool(ceil_mode),
+                   exclusive=bool(exclusive), n=2)
+    return squeeze(out, [2])
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    k = _ntuple(kernel_size, 3)
+    s = _ntuple(stride if stride is not None else kernel_size, 3)
+    p = _pool_pads(padding, 3)
+    return apply_op(_avg_pool, x, ksize=k, strides=s, pads=p, ceil_mode=bool(ceil_mode),
+                    exclusive=bool(exclusive), n=3)
+
+
+def _adaptive_starts_ends(in_size, out_size):
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, out_sizes, op):
+    n_spatial = len(out_sizes)
+    spatial = x.shape[2:]
+    out = x
+    for d in range(n_spatial):
+        in_s = spatial[d]
+        o = out_sizes[d]
+        if in_s == o:
+            continue
+        if in_s % o == 0:
+            # uniform window: reshape-reduce (fast path)
+            k = in_s // o
+            shape = out.shape[:2 + d] + (o, k) + out.shape[2 + d + 1:]
+            r = out.reshape(shape)
+            out = jnp.max(r, axis=2 + d + 1) if op == "max" else jnp.mean(r, axis=2 + d + 1)
+        else:
+            starts, ends = _adaptive_starts_ends(in_s, o)
+            slices = []
+            for s0, e0 in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s0, e0, axis=2 + d)
+                red = jnp.max(seg, axis=2 + d, keepdims=True) if op == "max" else jnp.mean(seg, axis=2 + d, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=2 + d)
+    return out
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    o = _ntuple(output_size, 2)
+    o = tuple(x.shape[2 + i] if v is None else v for i, v in enumerate(o))
+    return apply_op(_adaptive_pool, x, out_sizes=o, op="avg")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    o = _ntuple(output_size, 2)
+    o = tuple(x.shape[2 + i] if v is None else v for i, v in enumerate(o))
+    return apply_op(_adaptive_pool, x, out_sizes=o, op="max")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    o = _ntuple(output_size, 1)
+    return apply_op(_adaptive_pool, x, out_sizes=o, op="avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    o = _ntuple(output_size, 1)
+    return apply_op(_adaptive_pool, x, out_sizes=o, op="max")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    o = _ntuple(output_size, 3)
+    o = tuple(x.shape[2 + i] if v is None else v for i, v in enumerate(o))
+    return apply_op(_adaptive_pool, x, out_sizes=o, op="avg")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    o = _ntuple(output_size, 3)
+    o = tuple(x.shape[2 + i] if v is None else v for i, v in enumerate(o))
+    return apply_op(_adaptive_pool, x, out_sizes=o, op="max")

@@ -1,0 +1,374 @@
+"""Recurrent layers over lax.scan.
+
+Parity: reference python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU + cells,
+RNN/BiRNN wrappers); cell semantics match the reference golden model
+(python/paddle/fluid/tests/unittests/rnn/rnn_numpy.py:34-185). The reference
+runs cudnn fused kernels (operators/rnn_op.cu); here the time loop is a
+lax.scan that XLA unrolls onto the MXU per step.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        from ...tensor.creation import full
+
+        batch = batch_ref.shape[batch_dim_idx]
+        if shape is None:
+            shape = (self.hidden_size,)
+        return full([batch] + list(shape)[-1:], init_value, dtype or "float32")
+
+
+def _uniform_std(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = "tanh" if self.activation == "tanh" else "relu"
+        h = apply_op(_simple_rnn_step, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, act=act)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _simple_rnn_step(x, h, wih, whh, bih, bhh, act):
+    z = x @ wih.T + bih + h @ whh.T + bhh
+    return jnp.tanh(z) if act == "tanh" else jax.nn.relu(z)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size]), zeros([b, self.hidden_size]))
+        h, c = states
+        nh, nc = apply_op(_lstm_step, inputs, h, c, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh)
+        return nh, (nh, nc)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+def _lstm_step(x, h, c, wih, whh, bih, bhh):
+    gates = x @ wih.T + bih + h @ whh.T + bhh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    nc = f * c + i * jnp.tanh(g)
+    nh = o * jnp.tanh(nc)
+    return nh, nc
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply_op(_gru_step, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _gru_step(x, h, wih, whh, bih, bhh):
+    xg = x @ wih.T + bih
+    hg = h @ whh.T + bhh
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)
+    return (h - c) * z + c
+
+
+# ---------------------------------------------------------------------------
+# scan-based sequence drivers
+# ---------------------------------------------------------------------------
+
+def _scan_rnn(step_fn, x, init_state, weights, reverse=False, mask=None):
+    """x: [T, B, I] (time-major inside); returns (outputs [T,B,H], final_state)."""
+
+    def body(state, xt):
+        if mask is not None:
+            xt, mt = xt
+        new_state = step_fn(xt, state, *weights)
+        if mask is not None:
+            if isinstance(state, tuple):
+                new_state = tuple(jnp.where(mt[:, None], ns, s) for ns, s in zip(new_state, state))
+            else:
+                new_state = jnp.where(mt[:, None], new_state, state)
+        out = new_state[0] if isinstance(new_state, tuple) else new_state
+        return new_state, out
+
+    xs = (x, mask) if mask is not None else x
+    final, outs = jax.lax.scan(body, init_state, xs, reverse=reverse)
+    if reverse:
+        pass  # scan(reverse=True) already emits outputs aligned to input order
+    return outs, final
+
+
+def _run_rnn_layer(x, h0, weights, mode, time_major, reverse=False, mask=None):
+    """Pure function run for one direction of one layer."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+        if mask is not None:
+            mask = jnp.swapaxes(mask, 0, 1)
+    if mode == "LSTM":
+        step = lambda xt, st, *w: _lstm_step(xt, st[0], st[1], *w)  # noqa: E731
+        outs, final = _scan_rnn(step, x, h0, weights, reverse, mask)
+    elif mode == "GRU":
+        outs, final = _scan_rnn(_gru_step, x, h0, weights, reverse, mask)
+    elif mode == "RNN_TANH":
+        step = lambda xt, st, *w: _simple_rnn_step(xt, st, *w, act="tanh")  # noqa: E731
+        outs, final = _scan_rnn(step, x, h0, weights, reverse, mask)
+    else:
+        step = lambda xt, st, *w: _simple_rnn_step(xt, st, *w, act="relu")  # noqa: E731
+        outs, final = _scan_rnn(step, x, h0, weights, reverse, mask)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, final
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence runner (reference rnn.py RNN class)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        mode = ("LSTM" if isinstance(self.cell, LSTMCell)
+                else "GRU" if isinstance(self.cell, GRUCell)
+                else "RNN_TANH" if getattr(self.cell, "activation", "tanh") == "tanh"
+                else "RNN_RELU")
+        b_idx = 1 if self.time_major else 0
+        batch = inputs.shape[b_idx]
+        if initial_states is None:
+            from ...tensor.creation import zeros
+
+            if mode == "LSTM":
+                initial_states = (zeros([batch, self.cell.hidden_size]),
+                                  zeros([batch, self.cell.hidden_size]))
+            else:
+                initial_states = zeros([batch, self.cell.hidden_size])
+        weights = (self.cell.weight_ih, self.cell.weight_hh, self.cell.bias_ih, self.cell.bias_hh)
+        mask = None
+        if sequence_length is not None:
+            T = inputs.shape[0 if self.time_major else 1]
+            mask = _make_mask(sequence_length, T, self.time_major)
+        if mode == "LSTM":
+            outs, h, c = apply_op(
+                _rnn_layer_lstm, inputs, initial_states[0], initial_states[1], *weights,
+                time_major=self.time_major, reverse=self.is_reverse)
+            return outs, (h, c)
+        outs, h = apply_op(
+            _rnn_layer_single, inputs, initial_states, *weights,
+            mode=mode, time_major=self.time_major, reverse=self.is_reverse)
+        return outs, h
+
+
+def _make_mask(sequence_length, T, time_major):
+    sl = sequence_length._data if isinstance(sequence_length, Tensor) else jnp.asarray(sequence_length)
+    m = jnp.arange(T)[None, :] < sl[:, None]
+    return Tensor(m if not time_major else m.T)
+
+
+def _rnn_layer_lstm(x, h0, c0, wih, whh, bih, bhh, time_major, reverse):
+    outs, (h, c) = _run_rnn_layer(x, (h0, c0), (wih, whh, bih, bhh), "LSTM", time_major, reverse)
+    return outs, h, c
+
+
+def _rnn_layer_single(x, h0, wih, whh, bih, bhh, mode, time_major, reverse):
+    outs, h = _run_rnn_layer(x, h0, (wih, whh, bih, bhh), mode, time_major, reverse)
+    return outs, h
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+
+        if initial_states is None:
+            s_fw = s_bw = None
+        else:
+            s_fw, s_bw = initial_states
+        o_fw, f_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        o_bw, f_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return concat([o_fw, o_bw], axis=-1), (f_fw, f_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction {direction}")
+        k = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        init = _uniform_std(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"{layer}" + ("_reverse" if d else "")
+                wih = self.create_parameter([k * hidden_size, in_size], weight_ih_attr, default_initializer=init)
+                whh = self.create_parameter([k * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+                bih = self.create_parameter([k * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+                bhh = self.create_parameter([k * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_l{sfx}", wih)
+                self.add_parameter(f"weight_hh_l{sfx}", whh)
+                self.add_parameter(f"bias_ih_l{sfx}", bih)
+                self.add_parameter(f"bias_hh_l{sfx}", bhh)
+                self._all_weights.append((wih, whh, bih, bhh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.creation import zeros
+        from ...tensor.manipulation import concat, stack
+
+        D = self.num_directions
+        L = self.num_layers
+        b_idx = 1 if self.time_major else 0
+        batch = inputs.shape[b_idx]
+        is_lstm = self.mode == "LSTM"
+        if initial_states is None:
+            if is_lstm:
+                h0 = zeros([L * D, batch, self.hidden_size])
+                c0 = zeros([L * D, batch, self.hidden_size])
+                initial_states = (h0, c0)
+            else:
+                initial_states = zeros([L * D, batch, self.hidden_size])
+        x = inputs
+        final_h, final_c = [], []
+        mask = None
+        if sequence_length is not None:
+            T = inputs.shape[0 if self.time_major else 1]
+            mask = _make_mask(sequence_length, T, self.time_major)
+        for layer in range(L):
+            outs_dir = []
+            for d in range(D):
+                idx = layer * D + d
+                weights = self._all_weights[idx]
+                if is_lstm:
+                    h0_ld = initial_states[0][idx]
+                    c0_ld = initial_states[1][idx]
+                    outs, h, c = apply_op(
+                        _rnn_layer_lstm, x, h0_ld, c0_ld, *weights,
+                        time_major=self.time_major, reverse=bool(d))
+                    final_h.append(h)
+                    final_c.append(c)
+                else:
+                    h0_ld = initial_states[idx]
+                    outs, h = apply_op(
+                        _rnn_layer_single, x, h0_ld, *weights,
+                        mode=self.mode, time_major=self.time_major, reverse=bool(d))
+                    final_h.append(h)
+                outs_dir.append(outs)
+            x = outs_dir[0] if D == 1 else concat(outs_dir, axis=-1)
+            if self.dropout and layer < L - 1:
+                from .. import functional as F
+
+                x = F.dropout(x, self.dropout, training=self.training)
+        if is_lstm:
+            return x, (stack(final_h, 0), stack(final_c, 0))
+        return x, stack(final_h, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

@@ -1,0 +1,10 @@
+"""paddle_tpu.ops — Pallas TPU kernels and fused ops.
+
+The analog of the reference's operators/fused/ (fused_transformer_op.cu,
+fmha_ref.h) and the fusion_group runtime codegen — except on TPU, XLA
+already fuses elementwise chains, so hand-written kernels are reserved for
+the cases XLA can't do: flash attention (online softmax tiling) and
+ring attention (overlapping ICI permutes with compute).
+"""
+from .flash_attention import flash_attention  # noqa: F401
+from .fused import fused_multi_head_attention, fused_feedforward  # noqa: F401

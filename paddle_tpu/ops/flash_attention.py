@@ -1,0 +1,128 @@
+"""Flash attention — Pallas TPU kernel.
+
+TPU-native answer to the reference's fused attention
+(operators/fused/fused_transformer_op.cu, fmha_ref.h): instead of a cuda
+fMHA, a Pallas kernel that tiles Q into VMEM blocks and computes softmax(QK^T)V
+per block, so the [S, S] score matrix never hits HBM. The backward pass
+recomputes attention inside jax.checkpoint (rematerialization is cheaper
+than saving scores on TPU — HBM bandwidth is the bottleneck).
+
+Layout: [batch, heads, seq, head_dim] (matches MultiHeadAttention internals).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_INTERPRET_CACHE = {}
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _attention_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), dtype=bool), k=klen - qlen)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    k = k_ref[0].astype(jnp.float32)  # [S, d]
+    v = v_ref[0].astype(jnp.float32)  # [S, d]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # [block_q, S]
+    if causal:
+        seq = k.shape[0]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "interpret"))
+def _flash_forward(q, k, v, causal=False, scale=None, block_q=128, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, sq)
+    if sq % bq != 0:
+        return _attention_reference(q, k, v, causal, scale)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    grid = (b * h, sq // bq)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal, block_q=bq)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=128):
+    """Array-level entry (used inside jit traces / functional code)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    use_pallas = _on_tpu() and d in (64, 128, 256) and q.shape[-2] >= 128
+    if use_pallas:
+        # checkpoint: recompute attention in backward instead of saving P
+        fwd = jax.checkpoint(
+            functools.partial(_flash_forward, causal=causal, scale=scale,
+                              block_q=block_q, interpret=False))
+        return fwd(q, k, v)
+    return _attention_reference(q, k, v, causal, scale)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True, name=None):
+    """Tensor-level API, paddle.incubate.nn.functional.fused-attention-like.
+
+    query/key/value: [batch, num_heads, seq, head_dim] Tensors.
+    """
+    from ..framework.core import Tensor, apply_op
+
+    if return_softmax:
+        raise NotImplementedError("flash_attention does not materialize softmax")
+    out = apply_op(_flash_entry, query, key, value, causal=bool(causal))
+    if dropout and training:
+        from ..nn import functional as F
+
+        out = F.dropout(out, dropout, training=training)
+    return out
+
+
+def _flash_entry(q, k, v, causal):
+    return flash_attention_arrays(q, k, v, causal=causal)

@@ -1,0 +1,73 @@
+"""Fused transformer ops.
+
+Reference surface: paddle.incubate.nn.functional fused_multi_head_attention /
+fused_feedforward (operators/fused/fused_attention_op.cu,
+fused_feedforward_op.cc). On TPU these are compositions that XLA fuses into
+a handful of kernels; attention itself uses the Pallas flash kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from .flash_attention import flash_attention_arrays
+
+
+def _fused_mha(x, qkv_w, qkv_b, out_w, out_b, ln_w, ln_b, num_heads,
+               pre_ln, causal, eps):
+    b, s, d = x.shape
+    h = num_heads
+    hd = d // h
+    residual = x
+    if pre_ln:
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        x = (x - mu) * jax.lax.rsqrt(var + eps) * ln_w + ln_b
+    qkv = x @ qkv_w + qkv_b  # [b, s, 3d]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+
+    o = flash_attention_arrays(heads(q), heads(k), heads(v), causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+    o = o @ out_w + out_b
+    y = residual + o
+    if not pre_ln:
+        mu = jnp.mean(y, -1, keepdims=True)
+        var = jnp.var(y, -1, keepdims=True)
+        y = (y - mu) * jax.lax.rsqrt(var + eps) * ln_w + ln_b
+    return y
+
+
+def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+                               ln_scale, ln_bias, num_heads, pre_layer_norm=False,
+                               causal=False, epsilon=1e-5, name=None):
+    return apply_op(_fused_mha, x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+                    ln_scale, ln_bias, num_heads=int(num_heads),
+                    pre_ln=bool(pre_layer_norm), causal=bool(causal), eps=float(epsilon))
+
+
+def _fused_ffn(x, w1, b1, w2, b2, ln_w, ln_b, pre_ln, act, eps):
+    residual = x
+    if pre_ln:
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        x = (x - mu) * jax.lax.rsqrt(var + eps) * ln_w + ln_b
+    hdn = x @ w1 + b1
+    hdn = jax.nn.gelu(hdn) if act == "gelu" else jax.nn.relu(hdn)
+    y = residual + (hdn @ w2 + b2)
+    if not pre_ln:
+        mu = jnp.mean(y, -1, keepdims=True)
+        var = jnp.var(y, -1, keepdims=True)
+        y = (y - mu) * jax.lax.rsqrt(var + eps) * ln_w + ln_b
+    return y
+
+
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight, linear2_bias,
+                      ln_scale, ln_bias, pre_layer_norm=False, activation="relu",
+                      epsilon=1e-5, name=None):
+    return apply_op(_fused_ffn, x, linear1_weight, linear1_bias, linear2_weight,
+                    linear2_bias, ln_scale, ln_bias, pre_ln=bool(pre_layer_norm),
+                    act=activation, eps=float(epsilon))

@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (mirrors paddle.optimizer)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb, LarsMomentum,
+)

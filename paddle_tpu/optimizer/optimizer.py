@@ -1,0 +1,439 @@
+"""Optimizers.
+
+Parity surface: reference python/paddle/optimizer/ (v2 API) over
+operators/optimizers/*.cc kernels. Each optimizer defines a *pure*
+per-parameter update ``_pure_update(p, g, lr, slots...) -> (new_p, slots...)``;
+the eager ``step()`` runs it jit-cached per parameter shape, and the
+functional training path (paddle_tpu.jit.TrainStep) tree-maps the same
+function inside one compiled XLA program — the analog of the reference
+running one fused optimizer kernel per parameter
+(e.g. operators/optimizers/adam_op.cu).
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor, backward
+from ..regularizer import L1Decay, L2Decay
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "LarsMomentum",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._name = name
+        if isinstance(weight_decay, float):
+            self._weight_decay = L2Decay(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+        # slot store: name -> {id(param): jnp array}
+        self._accumulators: dict = {}
+        self._aux_state: dict = {}
+        self._jit_cache: dict = {}
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- slots --------------------------------------------------------------
+    def _slot_names(self):
+        return []
+
+    def _init_slot(self, name, p):
+        return jnp.zeros_like(p._data)
+
+    def _get_slots(self, p):
+        out = []
+        for name in self._slot_names():
+            store = self._accumulators.setdefault(name, {})
+            if id(p) not in store:
+                store[id(p)] = self._init_slot(name, p)
+            out.append(store[id(p)])
+        return out
+
+    def _set_slots(self, p, values):
+        for name, v in zip(self._slot_names(), values):
+            self._accumulators[name][id(p)] = v
+
+    # -- update -------------------------------------------------------------
+    @staticmethod
+    def _pure_update(p, g, lr, *slots, **hyper):
+        raise NotImplementedError
+
+    def _hyper(self, p):
+        """Per-call static hyperparams (dict)."""
+        return {}
+
+    def _regularized_grad(self, p, g):
+        reg = p.regularizer if p.regularizer is not None else self._weight_decay
+        if isinstance(reg, L2Decay) and not self._decoupled_wd():
+            return g + reg.coeff * p._data
+        if isinstance(reg, L1Decay):
+            return g + reg.coeff * jnp.sign(p._data)
+        return g
+
+    def _decoupled_wd(self):
+        return False
+
+    def step(self):
+        params_grads = []
+        for p in self._parameter_list or []:
+            if p.grad is None or not getattr(p, "trainable", True):
+                continue
+            params_grads.append((p, p.grad))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            garr = g._data if isinstance(g, Tensor) else g
+            garr = self._regularized_grad(p, garr.astype(p._data.dtype))
+            slots = self._get_slots(p)
+            hyper = self._hyper(p)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            fn = self._jitted_update(tuple(sorted(hyper.items())))
+            out = fn(p._data, garr, jnp.asarray(plr, dtype=jnp.float32), *slots)
+            new_p, new_slots = out[0], out[1:]
+            p._data = new_p
+            self._set_slots(p, new_slots)
+        self._post_step()
+
+    def _post_step(self):
+        pass
+
+    def _jitted_update(self, hyper_items):
+        key = hyper_items
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            hyper = dict(hyper_items)
+            cls_update = type(self)._pure_update
+
+            def run(p, g, lr, *slots):
+                out = cls_update(p, g, lr, *slots, **hyper)
+                return out if isinstance(out, tuple) else (out,)
+
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        return fn
+
+    # -- API parity ----------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        backward(loss)
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        state = {}
+        for name, store in self._accumulators.items():
+            for p in self._parameter_list or []:
+                if id(p) in store:
+                    pname = p.name or f"param_{id(p)}"
+                    state[f"{pname}.{name}"] = Tensor(store[id(p)])
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        for k, v in self._aux_state.items():
+            state[k] = v
+        return state
+
+    def set_state_dict(self, state_dict):
+        for name in self._slot_names():
+            store = self._accumulators.setdefault(name, {})
+            for p in self._parameter_list or []:
+                pname = p.name or f"param_{id(p)}"
+                key = f"{pname}.{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    store[id(p)] = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    @staticmethod
+    def _pure_update(p, g, lr):
+        return (p - lr.astype(p.dtype) * g,)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False, rescale_grad=1.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _slot_names(self):
+        return ["velocity"]
+
+    def _hyper(self, p):
+        return {"mu": self._momentum, "nesterov": self._use_nesterov}
+
+    @staticmethod
+    def _pure_update(p, g, lr, v, mu, nesterov):
+        lr = lr.astype(p.dtype)
+        nv = mu * v + g
+        if nesterov:
+            np_ = p - (g + mu * nv) * lr
+        else:
+            np_ = p - nv * lr
+        return np_, nv
+
+
+class LarsMomentum(Momentum):
+    """LARS (reference operators/optimizers/lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None, grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _hyper(self, p):
+        return {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                "lars_wd": self._lars_wd, "eps": self._epsilon}
+
+    @staticmethod
+    def _pure_update(p, g, lr, v, mu, lars_coeff, lars_wd, eps):
+        lr = lr.astype(p.dtype)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = lr * lars_coeff * p_norm / (eps + g_norm + lars_wd * p_norm + 1e-12)
+        nv = mu * v + local_lr * (g + lars_wd * p)
+        return p - nv, nv
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = float(beta1 if not isinstance(beta1, Tensor) else beta1.item())
+        self._beta2 = float(beta2 if not isinstance(beta2, Tensor) else beta2.item())
+        self._epsilon = float(epsilon)
+
+    def _slot_names(self):
+        return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def _init_slot(self, name, p):
+        if name == "beta1_pow":
+            return jnp.asarray(self._beta1, dtype=jnp.float32)
+        if name == "beta2_pow":
+            return jnp.asarray(self._beta2, dtype=jnp.float32)
+        return jnp.zeros_like(p._data)
+
+    def _hyper(self, p):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon}
+
+    @staticmethod
+    def _pure_update(p, g, lr, m1, m2, b1p, b2p, b1, b2, eps):
+        lr = lr.astype(jnp.float32)
+        nm1 = b1 * m1 + (1 - b1) * g
+        nm2 = b2 * m2 + (1 - b2) * jnp.square(g)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        np_ = p - (lr_t * nm1 / (jnp.sqrt(nm2) + eps)).astype(p.dtype)
+        return np_, nm1, nm2, b1p * b1, b2p * b2
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled_wd(self):
+        return True
+
+    def _hyper(self, p):
+        coeff = self._coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            coeff = 0.0
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon, "coeff": coeff}
+
+    @staticmethod
+    def _pure_update(p, g, lr, m1, m2, b1p, b2p, b1, b2, eps, coeff):
+        lr = lr.astype(jnp.float32)
+        p = p * (1.0 - lr * coeff).astype(p.dtype)
+        return Adam._pure_update(p, g, lr, m1, m2, b1p, b2p, b1, b2, eps)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _slot_names(self):
+        return ["moment", "inf_norm", "beta1_pow"]
+
+    def _init_slot(self, name, p):
+        if name == "beta1_pow":
+            return jnp.asarray(self._beta1, dtype=jnp.float32)
+        return jnp.zeros_like(p._data)
+
+    def _hyper(self, p):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon}
+
+    @staticmethod
+    def _pure_update(p, g, lr, m, inf, b1p, b1, b2, eps):
+        lr = lr.astype(jnp.float32)
+        nm = b1 * m + (1 - b1) * g
+        ninf = jnp.maximum(b2 * inf, jnp.abs(g))
+        np_ = p - ((lr / (1 - b1p)) * nm / (ninf + eps)).astype(p.dtype)
+        return np_, nm, ninf, b1p * b1
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = float(epsilon)
+        self._init_acc = float(initial_accumulator_value)
+
+    def _slot_names(self):
+        return ["moment"]
+
+    def _init_slot(self, name, p):
+        return jnp.full_like(p._data, self._init_acc)
+
+    def _hyper(self, p):
+        return {"eps": self._epsilon}
+
+    @staticmethod
+    def _pure_update(p, g, lr, m, eps):
+        lr = lr.astype(p.dtype)
+        nm = m + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(nm) + eps), nm
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = float(epsilon), float(rho)
+
+    def _slot_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _hyper(self, p):
+        return {"eps": self._epsilon, "rho": self._rho}
+
+    @staticmethod
+    def _pure_update(p, g, lr, asg, asu, eps, rho):
+        lr = lr.astype(p.dtype)
+        nasg = rho * asg + (1 - rho) * jnp.square(g)
+        update = -jnp.sqrt(asu + eps) / jnp.sqrt(nasg + eps) * g
+        nasu = rho * asu + (1 - rho) * jnp.square(update)
+        return p + lr * update, nasg, nasu
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), bool(centered)
+
+    def _slot_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _hyper(self, p):
+        return {"rho": self._rho, "eps": self._epsilon, "mom": self._momentum,
+                "centered": self._centered}
+
+    @staticmethod
+    def _pure_update(p, g, lr, ms, mg, mo, rho, eps, mom, centered):
+        lr = lr.astype(p.dtype)
+        nms = rho * ms + (1 - rho) * jnp.square(g)
+        if centered:
+            nmg = rho * mg + (1 - rho) * g
+            denom = nms - jnp.square(nmg) + eps
+        else:
+            nmg = mg
+            denom = nms + eps
+        nmo = mom * mo + lr * g / jnp.sqrt(denom)
+        return p - nmo, nms, nmg, nmo
+
+
+class Lamb(Optimizer):
+    """LAMB (reference python/paddle/optimizer/lamb.py, lamb_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+        self._lamb_wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _slot_names(self):
+        return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def _init_slot(self, name, p):
+        if name == "beta1_pow":
+            return jnp.asarray(self._beta1, dtype=jnp.float32)
+        if name == "beta2_pow":
+            return jnp.asarray(self._beta2, dtype=jnp.float32)
+        return jnp.zeros_like(p._data)
+
+    def _hyper(self, p):
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon, "wd": wd}
+
+    @staticmethod
+    def _pure_update(p, g, lr, m1, m2, b1p, b2p, b1, b2, eps, wd):
+        lr = lr.astype(jnp.float32)
+        nm1 = b1 * m1 + (1 - b1) * g
+        nm2 = b2 * m2 + (1 - b2) * jnp.square(g)
+        mhat = nm1 / (1 - b1p)
+        vhat = nm2 / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - (lr * trust * r).astype(p.dtype), nm1, nm2, b1p * b1, b2p * b2

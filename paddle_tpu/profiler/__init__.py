@@ -1,0 +1,116 @@
+"""Profiler (reference paddle/fluid/platform/profiler.h RecordEvent,
+python/paddle/fluid/profiler.py).
+
+TPU-native: jax.profiler emits TensorBoard/perfetto traces (the
+chrome-trace analog); RecordEvent maps to jax.profiler.TraceAnnotation named
+scopes which show up inside the XLA trace timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
+           "Profiler", "summary"]
+
+_events = defaultdict(list)
+_active = [False]
+_trace_dir = [None]
+
+
+class RecordEvent:
+    """RAII scope timer + device trace annotation."""
+
+    def __init__(self, name, event_type="op"):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        if _active[0]:
+            _events[self.name].append(time.perf_counter() - self._t0)
+        return False
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    _active[0] = True
+    _events.clear()
+    if trace_dir:
+        _trace_dir[0] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    _active[0] = False
+    if _trace_dir[0]:
+        jax.profiler.stop_trace()
+        _trace_dir[0] = None
+    return summary(sorted_key)
+
+
+def summary(sorted_key="total"):
+    rows = []
+    for name, times in _events.items():
+        rows.append({
+            "name": name, "calls": len(times), "total": sum(times),
+            "avg": sum(times) / len(times), "max": max(times), "min": min(times),
+        })
+    rows.sort(key=lambda r: -r["total"])
+    if rows:
+        print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}")
+        for r in rows:
+            print(f"{r['name']:<40}{r['calls']:>8}{r['total']:>12.6f}{r['avg']:>12.6f}")
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile", tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, trace_dir="/tmp/paddle_tpu_trace"):
+        self.trace_dir = trace_dir
+
+    def start(self):
+        start_profiler(trace_dir=self.trace_dir)
+
+    def stop(self):
+        stop_profiler()
+
+    def step(self):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,24 @@
+"""Regularizers (reference python/paddle/regularizer.py, fluid/regularizer.py)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    pass
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"

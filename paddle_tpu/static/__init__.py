@@ -1,0 +1,171 @@
+"""paddle_tpu.static — traced "static graph" mode.
+
+The reference's static world (ProgramDesc + Executor,
+framework.py:4393 Program / executor.py:1065 Executor.run) is replaced by
+jax tracing: a Program here is a captured python callable + InputSpecs that
+compiles to one XLA module. ``Executor.run(feed/fetch)`` keeps the
+reference's call signature over that.
+
+This module provides the user-facing shims; the real machinery lives in
+paddle_tpu.jit.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..framework.core import Tensor
+from ..jit import InputSpec  # noqa: F401
+
+__all__ = [
+    "InputSpec", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "CompiledProgram",
+    "name_scope", "device_guard", "py_func", "save_inference_model",
+    "load_inference_model", "gradients",
+]
+
+_static_mode = [False]
+
+
+class Variable:
+    """Symbolic placeholder in a static Program."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+
+    def __repr__(self):
+        return f"Var({self.name}, shape={self.shape}, dtype={self.dtype})"
+
+
+class Program:
+    """A deferred computation: feeds -> fetches via a traced callable.
+
+    Build with program_guard + paddle_tpu.static.data + a builder function
+    registered via ``set_forward`` — or (typical migration path) skip static
+    mode entirely and use paddle_tpu.jit.to_static.
+    """
+
+    def __init__(self):
+        self.feed_vars: Dict[str, Variable] = {}
+        self.fetch_builders = []
+        self._forward = None
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def set_forward(self, fn):
+        self._forward = fn
+        return fn
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.copy(self)
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+@contextmanager
+def program_guard(main_program, startup_program=None):
+    pm, ps = _default_main[0], _default_startup[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    try:
+        yield
+    finally:
+        _default_main[0], _default_startup[0] = pm, ps
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    v = Variable(name, shape, dtype)
+    default_main_program().feed_vars[name] = v
+    return v
+
+
+@contextmanager
+def name_scope(prefix):
+    yield
+
+
+@contextmanager
+def device_guard(device=None):
+    """Pipeline-stage placement hint (reference framework.py device_guard).
+
+    In the TPU build, stage placement is declared via PipelineLayer /
+    mesh shardings; this context is accepted and recorded as a no-op hint.
+    """
+    yield
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("py_func: wrap python code with jax.pure_callback instead")
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+
+class Executor:
+    """exe.run(feed/fetch) shim over jit (reference executor.py:607)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        if program._forward is None:
+            # startup program: nothing to execute (params init eagerly)
+            return []
+        feed = feed or {}
+        arrays = {k: (v._data if isinstance(v, Tensor) else np.asarray(v)) for k, v in feed.items()}
+        fn = self._cache.get(id(program))
+        if fn is None:
+            fn = jax.jit(program._forward)
+            self._cache[id(program)] = fn
+        outs = fn(**arrays)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+    from ..framework.io import save as _save
+
+    _save({"feed": feed_vars, "fetch": fetch_vars}, path_prefix + ".pdmodel.meta")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.load")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..framework.core import grad as _grad
+
+    return _grad(targets, inputs, target_gradients, allow_unused=True)

@@ -1,0 +1,192 @@
+"""paddle_tpu.tensor — the op namespace, plus Tensor method attachment.
+
+Mirrors the reference's monkey-patching of math/manipulation/... methods
+onto Tensor (python/paddle/tensor/__init__.py + fluid/dygraph/math_op_patch.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, Parameter, apply_op, to_tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, search, random, linalg  # noqa: F401
+
+
+def _einsum_impl(*ops, equation):
+    return jnp.einsum(equation, *ops)
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(_einsum_impl, *operands, equation=equation)
+
+
+# --------------------------------------------------------------------------
+# index helpers for Tensor __getitem__/__setitem__
+# --------------------------------------------------------------------------
+
+def _norm_index(idx):
+    """Normalize an index: Tensors -> numpy arrays (concrete), keep rest."""
+    def conv(i):
+        if isinstance(i, Tensor):
+            d = i._data
+            if isinstance(d, jax.core.Tracer):
+                return d
+            if d.dtype == jnp.bool_:
+                return np.asarray(d)
+            return np.asarray(d)
+        if isinstance(i, (list, np.ndarray)):
+            return np.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _getitem_impl(x, idx):
+    return x[idx]
+
+
+def _tensor_getitem(self, idx):
+    idx = _norm_index(idx)
+    return apply_op(_getitem_impl, self, idx=idx)
+
+
+def _setitem_impl(x, v, idx):
+    return x.at[idx].set(v)
+
+
+def _tensor_setitem(self, idx, value):
+    idx = _norm_index(idx)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, dtype=self._data.dtype))
+    elif value.dtype != self.dtype:
+        value = cast(value, self.dtype)
+    out = apply_op(_setitem_impl, self, value, idx=idx)
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._out_index = out._out_index
+    if out._grad_node is not None:
+        self.stop_gradient = False
+
+
+# --------------------------------------------------------------------------
+# dunders
+# --------------------------------------------------------------------------
+
+def _binop(fn):
+    def op(self, other):
+        return fn(self, other)
+
+    return op
+
+
+def _rbinop(fn):
+    def op(self, other):
+        return fn(other, self)
+
+    return op
+
+
+_DUNDERS = {
+    "__add__": _binop(add), "__radd__": _rbinop(add),
+    "__sub__": _binop(subtract), "__rsub__": _rbinop(subtract),
+    "__mul__": _binop(multiply), "__rmul__": _rbinop(multiply),
+    "__truediv__": _binop(divide), "__rtruediv__": _rbinop(divide),
+    "__floordiv__": _binop(floor_divide), "__rfloordiv__": _rbinop(floor_divide),
+    "__mod__": _binop(remainder), "__rmod__": _rbinop(remainder),
+    "__pow__": _binop(pow), "__rpow__": _rbinop(pow),
+    "__matmul__": _binop(matmul), "__rmatmul__": _rbinop(matmul),
+    "__eq__": _binop(equal), "__ne__": _binop(not_equal),
+    "__lt__": _binop(less_than), "__le__": _binop(less_equal),
+    "__gt__": _binop(greater_than), "__ge__": _binop(greater_equal),
+    "__and__": _binop(logical_and), "__or__": _binop(logical_or),
+    "__xor__": _binop(logical_xor),
+    "__getitem__": _tensor_getitem,
+    "__setitem__": _tensor_setitem,
+}
+
+
+def _neg(self):
+    return neg(self)
+
+
+def _abs(self):
+    return abs(self)
+
+
+def _invert(self):
+    return logical_not(self)
+
+
+_DUNDERS["__neg__"] = _neg
+_DUNDERS["__abs__"] = _abs
+_DUNDERS["__invert__"] = _invert
+
+for name, fn in _DUNDERS.items():
+    setattr(Tensor, name, fn)
+
+# keep identity-based hash (overridden by __eq__ definition above otherwise)
+Tensor.__hash__ = lambda self: id(self)
+
+
+# --------------------------------------------------------------------------
+# method attachment: t.sum(), t.reshape(), ...
+# --------------------------------------------------------------------------
+
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "matmul", "mm", "bmm", "dot", "inner", "outer", "addmm",
+    "maximum", "minimum", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "abs", "sign", "floor", "ceil", "round",
+    "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "reciprocal", "sigmoid",
+    "clip", "sum", "mean", "max", "min", "prod", "cumsum", "cumprod",
+    "logsumexp", "std", "var", "median", "isnan", "isinf", "isfinite",
+    "nan_to_num", "erf", "erfinv", "lgamma", "digamma", "neg", "scale",
+    "all", "any", "trace", "lerp", "kron", "count_nonzero", "frac",
+    # manipulation
+    "reshape", "transpose", "concat", "split", "chunk", "squeeze",
+    "unsqueeze", "flatten", "flip", "roll", "tile", "expand", "expand_as",
+    "broadcast_to", "gather", "gather_nd", "scatter", "scatter_nd_add",
+    "index_select", "masked_select", "unbind", "unique", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "moveaxis", "tolist", "where",
+    "index_sample", "index_add", "pad",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all",
+    "allclose", "isclose",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "kthvalue",
+    "mode",
+    # linalg
+    "norm", "dist", "t", "cross", "cholesky", "inv", "matrix_power",
+    # creation
+    "tril", "triu", "diag",
+]
+
+_ns = globals()
+for _m in _METHODS:
+    if _m in _ns and not hasattr(Tensor, _m):
+        setattr(Tensor, _m, _ns[_m])
+
+# a couple of aliases paddle exposes as methods
+Tensor.dim = lambda self: self.ndim
+Tensor.rank = lambda self: Tensor(jnp.asarray(self.ndim))
+Tensor.cpu = lambda self: self
+Tensor.cuda = lambda self, *a, **k: self
+Tensor.pin_memory = lambda self: self

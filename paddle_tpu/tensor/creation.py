@@ -1,0 +1,178 @@
+"""Tensor creation ops.
+
+Parity surface: reference python/paddle/tensor/creation.py. All creation is
+eager jnp; values land on the default device (TPU) lazily via jax.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply_op, to_tensor  # noqa: F401
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "tril", "triu", "diag", "diagflat", "meshgrid", "assign",
+    "clone", "numel", "one_hot", "complex",
+]
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.default_float_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x, dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x, dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x, fill_value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)
+        ) else None
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = num.item() if isinstance(num, Tensor) else num
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns if num_columns is None else int(num_columns), dtype=_dt(dtype)))
+
+
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(_tril, x, diagonal=int(diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(_triu, x, diagonal=int(diagonal))
+
+
+def _diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply_op(_diag, x, offset=int(offset), padding_value=padding_value)
+
+
+def _diagflat(a, offset=0):
+    return jnp.diagflat(a, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(_diagflat, x, offset=int(offset))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def _identity(x):
+    return x + 0 if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x)
+
+
+def assign(x, output=None):
+    src = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(src)
+        return output
+    return apply_op(_identity, x if isinstance(x, Tensor) else Tensor(src))
+
+
+def clone(x, name=None):
+    return apply_op(_identity, x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size if isinstance(x, Tensor) else np.size(x), dtype=jnp.int32))
+
+
+def _one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=dtypes.default_float_dtype())
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(_one_hot, x, num_classes=int(num_classes))
+
+
+def _complex(r, i):
+    return jax.lax.complex(r, i)
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return apply_op(_complex, real, imag)

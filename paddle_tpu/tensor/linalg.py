@@ -1,0 +1,248 @@
+"""Linear algebra ops (reference python/paddle/tensor/linalg.py).
+
+XLA lowers these to TPU-friendly primitives where available; decompositions
+that XLA:TPU lacks fall back to CPU via jax automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from .math import matmul, dot  # noqa: F401
+
+__all__ = [
+    "norm", "dist", "t", "cross", "cholesky", "qr", "svd", "inv", "det",
+    "slogdet", "solve", "triangular_solve", "matrix_power", "pinv",
+    "multi_dot", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
+    "histogram", "bincount", "mv", "lu", "lstsq", "cov", "corrcoef",
+]
+
+
+def _norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    if isinstance(p, str) and p != "fro":
+        raise ValueError(f"unsupported norm p={p}")
+    return apply_op(_norm, x, p=p if isinstance(p, str) else float(p) if p not in (np.inf, -np.inf) else p, axis=axis, keepdim=bool(keepdim))
+
+
+def _dist(x, y, p=2):
+    return _norm(x - y, p=p)
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op(_dist, x, y, p=float(p) if p not in (np.inf, -np.inf) else p)
+
+
+def _t(x):
+    if x.ndim < 2:
+        return x
+    return x.T
+
+
+def t(input, name=None):  # noqa: A002
+    return apply_op(_t, input)
+
+
+def _cross(x, y, axis=9):
+    ax = axis if axis != 9 else None
+    if ax is None:
+        for i, s in enumerate(x.shape):
+            if s == 3:
+                ax = i
+                break
+    return jnp.cross(x, y, axis=ax)
+
+
+def cross(x, y, axis=9, name=None):
+    return apply_op(_cross, x, y, axis=int(axis))
+
+
+def cholesky(x, upper=False, name=None):
+    return apply_op(_chol_impl, x, upper=bool(upper))
+
+
+def _chol_impl(a, upper=False):
+    L = jnp.linalg.cholesky(a)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def _qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply_op(_qr, x, mode=mode)
+    return out
+
+
+def _svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(_svd, x, full_matrices=bool(full_matrices))
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def _slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def slogdet(x, name=None):
+    return apply_op(_slogdet, x)
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def _triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    if transpose:
+        x = jnp.swapaxes(x, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(x, y, lower=not upper, unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply_op(_triangular_solve, x, y, upper=bool(upper), transpose=bool(transpose), unitriangular=bool(unitriangular))
+
+
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(_matrix_power, x, n=int(n))
+
+
+def _pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(_pinv, x, rcond=float(rcond), hermitian=bool(hermitian))
+
+
+def _multi_dot(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = out @ m
+    return out
+
+
+def multi_dot(x, name=None):
+    return apply_op(_multi_dot, *x)
+
+
+def eig(x, name=None):
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(xa)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(xa)))
+
+
+def _eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(_eigh, x, UPLO=UPLO)
+
+
+def _eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(_eigvalsh, x, UPLO=UPLO)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.linalg.matrix_rank(xa, rtol=tol))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    xa = np.asarray(input._data if isinstance(input, Tensor) else input)
+    if min == 0 and max == 0:
+        min, max = xa.min(), xa.max()  # noqa: A001
+    h, _ = np.histogram(xa, bins=bins, range=(min, max))
+    return Tensor(jnp.asarray(h.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    wa = weights._data if isinstance(weights, Tensor) else weights
+    n = int(jnp.max(xa)) + 1 if xa.size else 0
+    length = builtins_max(n, int(minlength))
+    return Tensor(jnp.bincount(xa, weights=wa, length=length))
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    lu_, piv = jsl.lu_factor(xa)
+    if get_infos:
+        return Tensor(lu_), Tensor(piv.astype(jnp.int32)), Tensor(jnp.zeros((), jnp.int32))
+    return Tensor(lu_), Tensor(piv.astype(jnp.int32))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    ya = np.asarray(y._data if isinstance(y, Tensor) else y)
+    sol, res, rank, sv = np.linalg.lstsq(xa, ya, rcond=rcond)
+    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)), Tensor(jnp.asarray(rank)), Tensor(jnp.asarray(sv)))
+
+
+def _cov(x, rowvar=True, ddof=True):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(_cov, x, rowvar=bool(rowvar), ddof=bool(ddof))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(_corrcoef, x, rowvar=bool(rowvar))
+
+
+def _corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)

@@ -1,0 +1,80 @@
+"""Comparison / logical / bitwise ops (reference python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all",
+    "allclose", "isclose", "is_empty", "is_tensor",
+]
+
+
+def _w(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _mk(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, _w(x), _w(y), op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+equal = _mk(jnp.equal, "equal")
+not_equal = _mk(jnp.not_equal, "not_equal")
+greater_than = _mk(jnp.greater, "greater_than")
+greater_equal = _mk(jnp.greater_equal, "greater_equal")
+less_than = _mk(jnp.less, "less_than")
+less_equal = _mk(jnp.less_equal, "less_equal")
+logical_and = _mk(jnp.logical_and, "logical_and")
+logical_or = _mk(jnp.logical_or, "logical_or")
+logical_xor = _mk(jnp.logical_xor, "logical_xor")
+bitwise_and = _mk(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _mk(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _mk(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return apply_op(jnp.logical_not, _w(x))
+
+
+def bitwise_not(x, name=None):
+    return apply_op(jnp.bitwise_not, _w(x))
+
+
+def _equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(_equal_all, _w(x), _w(y))
+
+
+def _allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(_allclose, _w(x), _w(y), rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def _isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(_isclose, _w(x), _w(y), rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

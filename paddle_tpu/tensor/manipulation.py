@@ -1,0 +1,504 @@
+"""Shape/layout manipulation ops.
+
+Parity surface: reference python/paddle/tensor/manipulation.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "reshape", "transpose", "concat", "stack", "split", "chunk", "squeeze",
+    "unsqueeze", "flatten", "flip", "roll", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "gather", "gather_nd", "scatter",
+    "scatter_nd_add", "index_select", "masked_select", "where", "slice",
+    "unbind", "unique", "unique_consecutive", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "moveaxis", "cast", "unstack",
+    "strided_slice", "tensordot", "as_real", "as_complex", "crop", "pad",
+    "index_sample", "index_add", "tolist", "split_sections",
+]
+
+
+def _ax(a):
+    if isinstance(a, Tensor):
+        a = a.item()
+    return int(a)
+
+
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return apply_op(_reshape, x, shape=shape)
+
+
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return apply_op(_transpose, x, perm=tuple(int(p) for p in perm))
+
+
+def _concat_op(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    return apply_op(_concat_op, *x, axis=_ax(axis))
+
+
+def _stack_op(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(_stack_op, *x, axis=_ax(axis))
+
+
+def _split(x, indices, axis):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = _ax(axis)
+    dim = (x.shape if isinstance(x, Tensor) else list(x.shape))[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(f"split: {dim} not divisible by {n}")
+        indices = n  # jnp.split supports int
+    else:
+        secs = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        n_neg = secs.count(-1)
+        if n_neg:
+            known = sum(s for s in secs if s != -1)
+            secs = [dim - known if s == -1 else s for s in secs]
+        indices = tuple(np.cumsum(secs)[:-1].tolist())
+    out = apply_op(_split, x, indices=indices, axis=axis)
+    return list(out)
+
+
+split_sections = split
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None:
+        if isinstance(axis, (int, np.integer)):
+            axis = (int(axis),)
+        axis = tuple(int(a) % (x.ndim if isinstance(x, Tensor) else x.ndim) for a in axis)
+    return apply_op(_squeeze, x, axis=axis)
+
+
+def _unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    return apply_op(_unsqueeze, x, axis=tuple(int(a) for a in axis))
+
+
+def _flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    sa, so = start_axis % nd, stop_axis % nd
+    new_shape = x.shape[:sa] + (-1,) + x.shape[so + 1:]
+    return x.reshape(new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return apply_op(_flatten, x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    return apply_op(_flip, x, axis=tuple(int(a) for a in axis))
+
+
+def _roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    else:
+        shifts = int(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return apply_op(_roll, x, shifts=shifts, axis=axis)
+
+
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.numpy().tolist()
+    return apply_op(_tile, x, reps=tuple(int(r.item()) if isinstance(r, Tensor) else int(r) for r in repeat_times))
+
+
+def _expand(x, shape):
+    offset = len(shape) - x.ndim
+    shape = tuple(
+        x.shape[i - offset] if (s == -1 and i >= offset) else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return apply_op(_expand, x, shape=shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [expand(t if isinstance(t, Tensor) else Tensor(t), list(shape)) for t in inputs]
+
+
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(index, Tensor) and index.ndim > 1:
+        index = reshape(index, [-1])
+    return apply_op(_gather, x, index, axis=_ax(axis))
+
+
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return apply_op(_gather_nd, x, index)
+
+
+def _scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle scatter(overwrite=False): zero the rows then add
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return apply_op(_scatter, x, index, updates, overwrite=bool(overwrite))
+
+
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply_op(_scatter_nd_add, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(_gather, x, index, axis=_ax(axis))
+
+
+def _index_add(x, index, axis, value):
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply_op(_index_add, x, index, value if isinstance(value, Tensor) else Tensor(jnp.asarray(value)), axis=_ax(axis))
+
+
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index, name=None):
+    return apply_op(_index_sample, x, index)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic-shaped: eager only (not jittable) — mirrors reference semantics
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ma = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    ma = jnp.broadcast_to(ma, xa.shape)
+    return Tensor(xa[ma])
+
+
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=False)
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    if not isinstance(y, Tensor):
+        y = Tensor(jnp.asarray(y))
+    return apply_op(_where, condition, x, y)
+
+
+def _slice_op(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    starts = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts)
+    ends = tuple(int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends)
+    return apply_op(_slice_op, x, axes=tuple(int(a) for a in axes), starts=starts, ends=ends)
+
+
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return apply_op(
+        _strided_slice, x,
+        axes=tuple(int(a) for a in axes),
+        starts=tuple(int(s) for s in starts),
+        ends=tuple(int(e) for e in ends),
+        strides=tuple(int(s) for s in strides),
+    )
+
+
+def _unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unbind(input, axis=0, name=None):  # noqa: A002
+    return list(apply_op(_unbind, input, axis=_ax(axis)))
+
+
+unstack = unbind
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = np.unique(xa, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if axis is not None or xa.ndim > 1 and axis is None:
+        xa = xa.reshape(-1) if axis is None else xa
+    keep = np.ones(xa.shape[0], dtype=bool)
+    keep[1:] = xa[1:] != xa[:-1]
+    out = [Tensor(jnp.asarray(xa[keep]))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, xa.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def _repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._data
+        return Tensor(jnp.repeat(x._data if isinstance(x, Tensor) else x, repeats, axis=axis))
+    return apply_op(_repeat_interleave, x, repeats=int(repeats), axis=None if axis is None else int(axis))
+
+
+def _take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply_op(_take_along_axis, arr, indices, axis=_ax(axis))
+
+
+def _put_along_axis(x, indices, values, axis, reduce="assign"):  # noqa: A002
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    dims = list(range(x.ndim))
+    # build scatter via at[]
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims]) for d, s in enumerate(x.shape)]
+    idx[axis] = indices
+    idx = [jnp.broadcast_to(i, indices.shape) for i in idx]
+    values = jnp.broadcast_to(values, indices.shape)
+    if reduce == "add":
+        return x.at[tuple(idx)].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[tuple(idx)].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values, dtype=(arr.dtype if isinstance(arr, Tensor) else None)))
+    return apply_op(_put_along_axis, arr, indices, values, axis=_ax(axis), reduce=reduce)
+
+
+def _moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    if isinstance(source, (list, tuple)):
+        source = tuple(int(s) for s in source)
+        destination = tuple(int(d) for d in destination)
+    else:
+        source, destination = int(source), int(destination)
+    return apply_op(_moveaxis, x, source=source, destination=destination)
+
+
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    return apply_op(_cast, x, dtype=d)
+
+
+def _tensordot(x, y, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return apply_op(_tensordot, x, y, axes=axes)
+
+
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    return apply_op(_as_real, x)
+
+
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return apply_op(_as_complex, x)
+
+
+def _crop(x, offsets, shape):
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    nd = x.ndim
+    if offsets is None:
+        offsets = [0] * nd
+    shape = [x.shape[i] if s == -1 else int(s) for i, s in enumerate(shape)]
+    return apply_op(_crop, x, offsets=tuple(int(o) for o in offsets), shape=tuple(shape))
+
+
+def _pad_nd(x, pad_width, mode="constant", value=0.0):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode="constant", constant_values=value)
+    if mode == "replicate":
+        return jnp.pad(x, pad_width, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pad_width, mode="reflect")
+    if mode == "circular":
+        return jnp.pad(x, pad_width, mode="wrap")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):  # noqa: A002
+    """paddle.nn.functional.pad-compatible N-d pad.
+
+    ``pad`` is either len==2*ndim (applies to all dims, paddle "ND" form,
+    reversed last-dim-first like the reference) or the conv-style 4/6-elem
+    form with data_format.
+    """
+    nd = x.ndim
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+    if len(pad) == 2 * nd:
+        # paddle semantic: pad is [d0_left, d0_right, d1_left, ...] over all dims
+        pw = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # partial spec applies to trailing spatial dims per data_format
+        df = data_format or ("NCHW" if nd == 4 else ("NCL" if nd == 3 else "NCDHW"))
+        n_spatial = len(pad) // 2
+        pw = [(0, 0)] * nd
+        if df.startswith("NC"):
+            spatial_dims = list(range(2, 2 + n_spatial))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        # like the reference (and torch): pad[0:2] applies to the LAST
+        # spatial dim, pad[2:4] to the one before it, etc.
+        for i, d in enumerate(reversed(spatial_dims)):
+            pw[d] = (pad[2 * i], pad[2 * i + 1])
+        pw = tuple(pw)
+    return apply_op(_pad_nd, x, pad_width=pw, mode=mode, value=float(value))
+
+
+def tolist(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()

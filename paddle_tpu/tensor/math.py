@@ -1,0 +1,436 @@
+"""Math ops (parity surface: reference python/paddle/tensor/math.py).
+
+Every op is a thin wrapper over a module-level pure jnp function dispatched
+through apply_op, so the eager path gets op-level jit caching and the tape
+gets a jax.vjp closure.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "matmul", "mm", "bmm", "dot", "inner", "outer", "addmm",
+    "maximum", "minimum", "fmax", "fmin", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "rsqrt", "square", "abs", "sign", "floor",
+    "ceil", "round", "trunc", "sin", "cos", "tan", "asin", "acos", "atan",
+    "atan2", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "reciprocal",
+    "sigmoid", "clip", "sum", "mean", "max", "min", "amax", "amin", "prod",
+    "cumsum", "cumprod", "logsumexp", "logcumsumexp", "std", "var", "median",
+    "kron", "isnan", "isinf", "isfinite", "nan_to_num", "erf", "erfinv",
+    "lgamma", "digamma", "neg", "increment", "scale", "stanh", "multiplex",
+    "all", "any", "deg2rad", "rad2deg", "angle", "conj", "real", "imag",
+    "trace", "diff", "heaviside", "frac", "count_nonzero", "nansum",
+    "nanmean", "gcd", "lcm", "lerp", "rot90",
+]
+
+
+def _w(x):
+    """Wrap plain python/np scalars so binary ops accept them."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x))
+
+
+def _make_unary(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, _w(x), op_name=name)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def _make_binary(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, _w(x), _w(y), op_name=name)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+exp = _make_unary(jnp.exp, "exp")
+expm1 = _make_unary(jnp.expm1, "expm1")
+log = _make_unary(jnp.log, "log")
+log2 = _make_unary(jnp.log2, "log2")
+log10 = _make_unary(jnp.log10, "log10")
+log1p = _make_unary(jnp.log1p, "log1p")
+sqrt = _make_unary(jnp.sqrt, "sqrt")
+square = _make_unary(jnp.square, "square")
+sign = _make_unary(jnp.sign, "sign")
+floor = _make_unary(jnp.floor, "floor")
+ceil = _make_unary(jnp.ceil, "ceil")
+round = _make_unary(jnp.round, "round")  # noqa: A001
+trunc = _make_unary(jnp.trunc, "trunc")
+sin = _make_unary(jnp.sin, "sin")
+cos = _make_unary(jnp.cos, "cos")
+tan = _make_unary(jnp.tan, "tan")
+asin = _make_unary(jnp.arcsin, "asin")
+acos = _make_unary(jnp.arccos, "acos")
+atan = _make_unary(jnp.arctan, "atan")
+sinh = _make_unary(jnp.sinh, "sinh")
+cosh = _make_unary(jnp.cosh, "cosh")
+tanh = _make_unary(jnp.tanh, "tanh")
+asinh = _make_unary(jnp.arcsinh, "asinh")
+acosh = _make_unary(jnp.arccosh, "acosh")
+atanh = _make_unary(jnp.arctanh, "atanh")
+abs = _make_unary(jnp.abs, "abs")  # noqa: A001
+neg = _make_unary(jnp.negative, "neg")
+erf = _make_unary(jax.scipy.special.erf, "erf")
+erfinv = _make_unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _make_unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _make_unary(jax.scipy.special.digamma, "digamma")
+isnan = _make_unary(jnp.isnan, "isnan")
+isinf = _make_unary(jnp.isinf, "isinf")
+isfinite = _make_unary(jnp.isfinite, "isfinite")
+deg2rad = _make_unary(jnp.deg2rad, "deg2rad")
+rad2deg = _make_unary(jnp.rad2deg, "rad2deg")
+angle = _make_unary(jnp.angle, "angle")
+conj = _make_unary(jnp.conj, "conj")
+real = _make_unary(jnp.real, "real")
+imag = _make_unary(jnp.imag, "imag")
+frac = _make_unary(lambda x: x - jnp.trunc(x), "frac")
+
+add = _make_binary(jnp.add, "add")
+subtract = _make_binary(jnp.subtract, "subtract")
+multiply = _make_binary(jnp.multiply, "multiply")
+divide = _make_binary(jnp.divide, "divide")
+floor_divide = _make_binary(jnp.floor_divide, "floor_divide")
+remainder = _make_binary(jnp.remainder, "remainder")
+mod = remainder
+maximum = _make_binary(jnp.maximum, "maximum")
+minimum = _make_binary(jnp.minimum, "minimum")
+fmax = _make_binary(jnp.fmax, "fmax")
+fmin = _make_binary(jnp.fmin, "fmin")
+atan2 = _make_binary(jnp.arctan2, "atan2")
+kron = _make_binary(jnp.kron, "kron")
+heaviside = _make_binary(jnp.heaviside, "heaviside")
+gcd = _make_binary(jnp.gcd, "gcd")
+lcm = _make_binary(jnp.lcm, "lcm")
+inner = _make_binary(jnp.inner, "inner")
+outer = _make_binary(jnp.outer, "outer")
+dot = _make_binary(jnp.dot, "dot")
+
+
+def _rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+rsqrt = _make_unary(_rsqrt, "rsqrt")
+
+
+def _reciprocal(x):
+    return 1.0 / x
+
+
+reciprocal = _make_unary(_reciprocal, "reciprocal")
+sigmoid = _make_unary(jax.nn.sigmoid, "sigmoid")
+
+
+def _pow(x, y):
+    return jnp.power(x, y)
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return apply_op(_pow, _w(x), _w(y))
+
+
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        axes = list(range(x.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        x = jnp.transpose(x, axes)
+    if transpose_y:
+        axes = list(range(y.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        y = jnp.transpose(y, axes)
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply_op(_matmul, x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y))
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+def _addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply_op(_addmm, input, x, y, beta=float(beta), alpha=float(alpha))
+
+
+def _clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    if isinstance(min, Tensor):
+        min = min.item()  # noqa: A001
+    if isinstance(max, Tensor):
+        max = max.item()  # noqa: A001
+    return apply_op(_clip, x, min=min, max=max)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _sum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op(_sum, x, axis=_axis(axis), keepdim=bool(keepdim), dtype=dtypes.convert_dtype(dtype))
+
+
+def _nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply_op(_nansum, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op(_nanmean, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(_mean, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op(_max, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op(_min, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+amax = max
+amin = min
+
+
+def _prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return apply_op(_prod, x, axis=_axis(axis), keepdim=bool(keepdim), dtype=dtypes.convert_dtype(dtype))
+
+
+def _cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return apply_op(_cumsum, x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype))
+
+
+def _cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op(_cumprod, x, dim=_axis(dim), dtype=dtypes.convert_dtype(dtype))
+
+
+def _logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(_logsumexp, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    m = jax.lax.cummax(x, axis=axis)
+    return jnp.log(jnp.cumsum(jnp.exp(x - m), axis=axis)) + m
+
+
+def logcumsumexp(x, axis=None, name=None):
+    return apply_op(_logcumsumexp, x, axis=_axis(axis))
+
+
+def _std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(_std, x, axis=_axis(axis), unbiased=bool(unbiased), keepdim=bool(keepdim))
+
+
+def _var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(_var, x, axis=_axis(axis), unbiased=bool(unbiased), keepdim=bool(keepdim))
+
+
+def _median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(_median, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(_nan_to_num, x, nan=float(nan), posinf=posinf, neginf=neginf)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op(jnp.add, x, Tensor(jnp.asarray(value, x.dtype)))
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    return x
+
+
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):  # noqa: A002
+    if isinstance(scale, Tensor):
+        scale = scale.item()  # noqa: A001
+    out = apply_op(_scale, x, scale=float(scale), bias=float(bias), bias_after_scale=bool(bias_after_scale))
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def _stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(_stanh, x, scale_a=float(scale_a), scale_b=float(scale_b))
+
+
+def _multiplex(*args):
+    index, cands = args[-1], jnp.stack(args[:-1])
+    index = index.reshape(-1)
+    return cands[index, jnp.arange(index.shape[0])]
+
+
+def multiplex(inputs, index, name=None):
+    idx = index if isinstance(index, Tensor) else Tensor(jnp.asarray(index))
+    return apply_op(_multiplex, *inputs, idx)
+
+
+def _all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op(_all, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op(_any, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(_trace, x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+def _diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    if prepend is not None or append is not None:
+        parts = []
+        if prepend is not None:
+            parts.append(prepend)
+        parts.append(x)
+        if append is not None:
+            parts.append(append)
+        from .manipulation import concat
+
+        x = concat(parts, axis=axis)
+    return apply_op(_diff, x, n=int(n), axis=int(axis))
+
+
+def _count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(_count_nonzero, x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+def _lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    if not isinstance(weight, Tensor):
+        weight = Tensor(jnp.asarray(weight, dtype=(x.dtype if isinstance(x, Tensor) else None)))
+    return apply_op(_lerp, _w(x), _w(y), weight)
+
+
+def _rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(_rot90, x, k=int(k), axes=tuple(axes))

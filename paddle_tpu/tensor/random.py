@@ -1,0 +1,117 @@
+"""Random sampling ops over the global stateful PRNG.
+
+Reference surface: python/paddle/tensor/random.py; seeding semantics from
+framework/generator.cc (see paddle_tpu.framework.random).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework import random as grandom
+from ..framework.core import Tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "multinomial", "bernoulli", "poisson",
+    "uniform_", "normal_", "exponential_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.default_float_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(grandom.next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(grandom.next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jax.random.randint(grandom.next_key(), _shape(shape), int(low), int(high), dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or "int64")
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(grandom.next_key(), int(n)).astype(dtypes.convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.key(seed) if seed else grandom.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype), minval=float(min), maxval=float(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(grandom.next_key(), shp) * s + m)
+    return Tensor(jax.random.normal(grandom.next_key(), _shape(shape)) * std + mean)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(xa, 1e-30))
+    key = grandom.next_key()
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(
+            (num_samples,) + xa.shape[:-1] if xa.ndim > 1 else (num_samples,)
+        ))
+        out = jnp.moveaxis(out, 0, -1) if xa.ndim > 1 else out
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, xa.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    u = jax.random.uniform(grandom.next_key(), xa.shape)
+    return Tensor((u < xa).astype(xa.dtype))
+
+
+def poisson(x, name=None):
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(grandom.next_key(), xa).astype(xa.dtype))
+
+
+# in-place variants used by initializers
+def uniform_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    x._data = jax.random.uniform(grandom.next_key(), tuple(x._data.shape), dtype=x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = jax.random.normal(grandom.next_key(), tuple(x._data.shape), dtype=x._data.dtype) * std + mean
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(grandom.next_key(), tuple(x._data.shape), dtype=x._data.dtype)
+    x._data = -jnp.log(1.0 - u) / lam
+    return x

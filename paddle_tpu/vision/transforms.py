@@ -1,0 +1,327 @@
+"""Vision transforms (reference python/paddle/vision/transforms/).
+
+Numpy/host-side preprocessing — the DataLoader applies these before batches
+hit the device. HWC uint8 numpy in, CHW float out (paddle convention via
+ToTensor).
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Resize", "Normalize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "ColorJitter", "Grayscale",
+    "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop", "crop",
+]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    if (nh, nw) == (h, w):
+        return img
+    # bilinear resize in numpy (host-side; device path uses jax.image)
+    ys = np.linspace(0, h - 1, nh)
+    xs = np.linspace(0, w - 1, nw)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)[:, None], np.round(xs).astype(int)[None, :]]
+        return out
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    f = img.astype(np.float32)
+    out = (f[y0][:, x0] * (1 - wy) * (1 - wx) + f[y1][:, x0] * wy * (1 - wx)
+           + f[y0][:, x1] * (1 - wy) * wx + f[y1][:, x1] * wy * wx)
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return crop(img, i, j, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def to_tensor(pic, data_format="CHW"):
+    img = _as_hwc(pic).astype(np.float32)
+    if img.dtype == np.float32 and np.asarray(pic).dtype == np.uint8:
+        img = img / 255.0
+    elif np.asarray(pic).dtype == np.uint8:
+        img = img / 255.0
+    if data_format == "CHW":
+        img = img.transpose(2, 0, 1)
+    return Tensor(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data)
+    else:
+        arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            p = self.padding if not isinstance(self.padding, numbers.Number) else [self.padding] * 4
+            img = np.pad(img, ((p[1], p[3]), (p[0], p[2]), (0, 0)))
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if h == th and w == tw:
+            return img
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return crop(img, i, j, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            nw = int(round(np.sqrt(target_area * ar)))
+            nh = int(round(np.sqrt(target_area / ar)))
+            if 0 < nw <= w and 0 < nh <= h:
+                i = np.random.randint(0, h - nh + 1)
+                j = np.random.randint(0, w - nw + 1)
+                return resize(crop(img, i, j, nh, nw), self.size, self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, numbers.Number):
+            padding = [padding] * 4
+        elif len(padding) == 2:
+            padding = [padding[0], padding[1], padding[0], padding[1]]
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        p = self.padding
+        return np.pad(img, ((p[1], p[3]), (p[0], p[2]), (0, 0)), constant_values=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        img = _as_hwc(img).astype(np.float32) * alpha
+        return np.clip(img, 0, 255).astype(np.uint8)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        img = _as_hwc(img).astype(np.float32)
+        mean = img.mean()
+        out = img * alpha + mean * (1 - alpha)
+        return np.clip(out, 0, 255).astype(np.uint8)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        img = _as_hwc(img).astype(np.float32)
+        gray = img.mean(axis=2, keepdims=True)
+        out = img * alpha + gray * (1 - alpha)
+        return np.clip(out, 0, 255).astype(np.uint8)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        return img  # full HSV hue rotation: host-side nicety, not on hot path
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        self.transforms = [
+            BrightnessTransform(brightness), ContrastTransform(contrast),
+            SaturationTransform(saturation), HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).astype(np.float32)
+        gray = (img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114)
+        gray = gray[..., None]
+        if self.num_output_channels == 3:
+            gray = np.repeat(gray, 3, axis=2)
+        return gray.astype(np.uint8)

@@ -1,0 +1,33 @@
+"""Test config: force an 8-device CPU mesh (the TPU-sharding test rig).
+
+Mirrors SURVEY.md §4's translation: the reference's single-host
+multi-process cluster tests become single-process multi-device tests over
+a virtual device mesh.
+
+Must run before jax backends initialize. The axon sitecustomize imports jax
+at interpreter start, so we override via jax.config rather than env.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import numpy as np
+
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

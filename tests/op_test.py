@@ -1,0 +1,79 @@
+"""OpTest harness — golden tests against numpy.
+
+The TPU analog of the reference's OpTest
+(python/paddle/fluid/tests/unittests/op_test.py:277): declare an op + inputs,
+check forward against a numpy reference and analytic grads against numeric
+finite differences (reference get_numeric_gradient, op_test.py:110).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, attrs=None, rtol=1e-5, atol=1e-6):
+    """op_fn(*tensors, **attrs) vs np_fn(*arrays, **attrs)."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = op_fn(*tensors, **attrs)
+    ref = np_fn(*inputs, **attrs)
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=rtol, atol=atol)
+    return out
+
+
+def numeric_grad(fn, inputs, wrt_idx, attrs=None, delta=5e-3):
+    """Central finite differences of sum(fn(inputs)) wrt inputs[wrt_idx]."""
+    attrs = attrs or {}
+    x = inputs[wrt_idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def f(xv):
+        args = [a.copy() for a in inputs]
+        args[wrt_idx] = xv.reshape(x.shape).astype(inputs[wrt_idx].dtype)
+        tensors = [paddle.to_tensor(a) for a in args]
+        out = fn(*tensors, **attrs)
+        if isinstance(out, (tuple, list)):
+            return float(sum(np.asarray(o.numpy()).astype(np.float64).sum() for o in out))
+        return float(np.asarray(out.numpy()).astype(np.float64).sum())
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = f(flat)
+        flat[i] = orig - delta
+        fm = f(flat)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * delta)
+    return grad
+
+
+def check_grad(op_fn, inputs, wrt=None, attrs=None, rtol=1e-2, atol=1e-3,
+               max_elems=64):
+    """Analytic grad (tape) vs numeric finite differences."""
+    attrs = attrs or {}
+    wrt = wrt if wrt is not None else list(range(len(inputs)))
+    tensors = [paddle.to_tensor(x, stop_gradient=(i not in wrt))
+               for i, x in enumerate(inputs)]
+    out = op_fn(*tensors, **attrs)
+    if isinstance(out, (tuple, list)):
+        loss = out[0].sum()
+        for o in out[1:]:
+            loss = loss + o.sum()
+    else:
+        loss = out.sum()
+    loss.backward()
+    for i in wrt:
+        if inputs[i].size > max_elems:
+            continue
+        num = numeric_grad(op_fn, inputs, i, attrs)
+        ana = np.asarray(tensors[i].grad.numpy(), dtype=np.float64)
+        np.testing.assert_allclose(ana, num, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")

@@ -1,0 +1,166 @@
+"""Autograd engine tests — tape vs numeric grads and jax.grad.
+
+Covers the BasicEngine semantics from SURVEY.md §3.3: accumulation, reuse,
+retain_graph, paddle.grad, no_grad, PyLayer, stop_gradient.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+from op_test import check_grad
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32), stop_gradient=False)
+        y = (x * x + 2 * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 2, rtol=1e-6)
+
+    def test_grad_accumulation_multi_use(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x + x * 3  # x used twice
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2 * 2.0 + 3.0])
+
+    def test_double_backward_accumulates(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_no_retain_raises(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=True)
+        (x * y).sum().backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only via direct path
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 5
+        assert y._grad_node is None
+
+    def test_non_scalar_backward(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+        y = x * 3
+        y.backward()  # implicit all-ones cotangent
+        np.testing.assert_allclose(x.grad.numpy(), 3 * np.ones((2, 2)))
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+        y = x * 2
+        y.backward(paddle.to_tensor(np.array([1.0, 10.0], np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_numeric_matmul_grad(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 2).astype(np.float32)
+        check_grad(paddle.matmul, [a, b])
+
+    def test_numeric_softmax_grad(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        check_grad(paddle.nn.functional.softmax, [x])
+
+    def test_numeric_layernorm_grad(self):
+        x = np.random.randn(2, 6).astype(np.float32)
+        w = np.random.rand(6).astype(np.float32) + 0.5
+        b = np.random.randn(6).astype(np.float32)
+
+        def fn(x, w, b):
+            return paddle.nn.functional.layer_norm(x, 6, w, b)
+
+        check_grad(fn, [x, w, b], rtol=3e-2, atol=3e-3)
+
+    def test_branching_graph(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        y = (a * b).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 12 * x.numpy(), rtol=1e-6)
+
+
+class TestPaddleGrad:
+    def test_basic(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # side-effect free
+
+    def test_multiple_inputs(self):
+        a = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = a * b + b
+        ga, gb = paddle.grad(y, [a, b])
+        np.testing.assert_allclose(ga.numpy(), [3.0])
+        np.testing.assert_allclose(gb.numpy(), [2.0])
+
+    def test_allow_unused(self):
+        a = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = a * 2
+        ga, gb = paddle.grad(y, [a, b], allow_unused=True)
+        assert gb is None
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 3 * x * x
+
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = Cube.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+class TestRecompute:
+    def test_recompute_matches_direct(self):
+        from paddle_tpu.distributed.fleet.utils.recompute import recompute
+
+        lin = paddle.nn.Linear(4, 4)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32), stop_gradient=False)
+        y1 = recompute(lin, x).sum()
+        y1.backward()
+        g_rec = lin.weight.grad.numpy().copy()
+        lin.clear_gradients()
+        x.grad = None
+        y2 = lin(x).sum()
+        y2.backward()
+        np.testing.assert_allclose(g_rec, lin.weight.grad.numpy(), rtol=1e-5)

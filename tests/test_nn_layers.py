@@ -1,0 +1,369 @@
+"""Layer tests — forward vs torch (cpu) golden reference where available.
+
+The reference compares against numpy goldens (SURVEY.md §4.1-2); torch cpu
+in this environment is a stronger independent oracle for conv/norm/rnn.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+class TestLinearEmbedding:
+    def test_linear_matches_torch(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        w = np.random.randn(8, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        ref = tF.linear(torch.tensor(x), torch.tensor(w.T), torch.tensor(b))
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-5, atol=1e-5)
+
+    def test_embedding(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        ids = np.array([[1, 2], [0, 9]])
+        out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), w[ids], rtol=1e-6)
+
+    def test_embedding_layer_padding_idx(self):
+        emb = paddle.nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([0, 1])))
+        assert np.abs(out.numpy()[0]).sum() == 0
+
+
+class TestConv:
+    @pytest.mark.parametrize("stride,padding,dilation,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+    ])
+    def test_conv2d_matches_torch(self, stride, padding, dilation, groups):
+        x = np.random.randn(2, 4, 9, 9).astype(np.float32)
+        w = np.random.randn(6, 4 // groups, 3, 3).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+                       stride=stride, padding=padding, dilation=dilation, groups=groups)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=stride, padding=padding, dilation=dilation, groups=groups)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-4)
+
+    def test_conv1d_matches_torch(self):
+        x = np.random.randn(2, 3, 12).astype(np.float32)
+        w = np.random.randn(5, 3, 3).astype(np.float32)
+        out = F.conv1d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+        ref = tF.conv1d(torch.tensor(x), torch.tensor(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("stride,padding,output_padding", [
+        (1, 0, 0), (2, 1, 1), (2, 0, 0),
+    ])
+    def test_conv2d_transpose_matches_torch(self, stride, padding, output_padding):
+        x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+        w = np.random.randn(4, 3, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=stride, padding=padding,
+                                 output_padding=output_padding)
+        ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=stride,
+                                  padding=padding, output_padding=output_padding)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-4)
+
+    def test_conv_grad(self):
+        from op_test import check_grad
+
+        x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+        w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+
+        def fn(x, w):
+            return F.conv2d(x, w, padding=1)
+
+        check_grad(fn, [x, w], max_elems=60, rtol=3e-2, atol=3e-3)
+
+
+class TestPooling:
+    def test_max_pool2d_matches_torch(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = tF.max_pool2d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-6)
+
+    def test_max_pool2d_padded(self):
+        x = np.random.randn(2, 3, 7, 7).astype(np.float32)
+        out = F.max_pool2d(paddle.to_tensor(x), 3, 2, 1)
+        ref = tF.max_pool2d(torch.tensor(x), 3, 2, 1)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-6)
+
+    def test_avg_pool2d_matches_torch(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = tF.avg_pool2d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-5)
+
+    def test_adaptive_avg_pool(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        ref = tF.adaptive_avg_pool2d(torch.tensor(x), 1)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-5)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), (3, 5))
+        ref = tF.adaptive_avg_pool2d(torch.tensor(x), (3, 5))
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-5)
+
+
+class TestNorm:
+    def test_batch_norm_train_eval(self):
+        x = np.random.randn(4, 3, 5, 5).astype(np.float32)
+        bn = paddle.nn.BatchNorm2D(3, momentum=0.9)
+        tbn = torch.nn.BatchNorm2d(3, momentum=0.1)  # torch momentum = 1 - paddle
+        bn.train()
+        tbn.train()
+        out = bn(paddle.to_tensor(x))
+        ref = tbn(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(bn._mean.numpy(), t2n(tbn.running_mean),
+                                   rtol=1e-4, atol=1e-5)
+        # paddle tracks BIASED running variance (batch_norm_op.cc), torch
+        # unbiased — tolerance covers the n/(n-1) factor on the update term
+        np.testing.assert_allclose(bn._variance.numpy(), t2n(tbn.running_var),
+                                   rtol=2e-3, atol=1e-4)
+        bn.eval()
+        tbn.eval()
+        out = bn(paddle.to_tensor(x))
+        ref = tbn(torch.tensor(x))
+        # eval path inherits the biased-vs-unbiased running_var delta above
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=3e-3)
+
+    def test_layer_norm_matches_torch(self):
+        x = np.random.randn(2, 5, 8).astype(np.float32)
+        ln = paddle.nn.LayerNorm(8)
+        out = ln(paddle.to_tensor(x))
+        ref = tF.layer_norm(torch.tensor(x), (8,),
+                            torch.ones(8), torch.zeros(8))
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-5)
+
+    def test_group_norm_matches_torch(self):
+        x = np.random.randn(2, 6, 4, 4).astype(np.float32)
+        out = F.group_norm(paddle.to_tensor(x), 3)
+        ref = tF.group_norm(torch.tensor(x), 3)
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-4, atol=1e-4)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("pfn,tfn", [
+        (F.relu, tF.relu), (F.gelu, tF.gelu), (F.silu, tF.silu),
+        (F.sigmoid, torch.sigmoid), (F.tanh, torch.tanh),
+        (F.softplus, tF.softplus), (F.elu, tF.elu),
+        (F.hardswish, tF.hardswish), (F.mish, tF.mish),
+        (F.relu6, tF.relu6),
+    ])
+    def test_matches_torch(self, pfn, tfn):
+        x = np.random.randn(3, 7).astype(np.float32) * 3
+        np.testing.assert_allclose(pfn(paddle.to_tensor(x)).numpy(),
+                                   t2n(tfn(torch.tensor(x))), rtol=1e-4, atol=1e-5)
+
+    def test_softmax(self):
+        x = np.random.randn(3, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            F.softmax(paddle.to_tensor(x), axis=-1).numpy(),
+            t2n(tF.softmax(torch.tensor(x), -1)), rtol=1e-5, atol=1e-6)
+
+    def test_leaky_relu(self):
+        x = np.random.randn(5).astype(np.float32)
+        np.testing.assert_allclose(
+            F.leaky_relu(paddle.to_tensor(x), 0.1).numpy(),
+            t2n(tF.leaky_relu(torch.tensor(x), 0.1)), rtol=1e-6)
+
+
+class TestLosses:
+    def test_cross_entropy_matches_torch(self):
+        x = np.random.randn(6, 10).astype(np.float32)
+        lab = np.random.randint(0, 10, 6)
+        out = F.cross_entropy(paddle.to_tensor(x), paddle.to_tensor(lab))
+        ref = tF.cross_entropy(torch.tensor(x), torch.tensor(lab))
+        np.testing.assert_allclose(float(out.numpy()), float(ref), rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        soft = np.random.rand(4, 5).astype(np.float32)
+        soft /= soft.sum(1, keepdims=True)
+        out = F.cross_entropy(paddle.to_tensor(x), paddle.to_tensor(soft), soft_label=True)
+        ref = tF.cross_entropy(torch.tensor(x), torch.tensor(soft))
+        np.testing.assert_allclose(float(out.numpy()), float(ref), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        x = np.random.randn(6, 10).astype(np.float32)
+        lab = np.array([1, 2, -100, 3, -100, 4])
+        out = F.cross_entropy(paddle.to_tensor(x), paddle.to_tensor(lab), ignore_index=-100)
+        ref = tF.cross_entropy(torch.tensor(x), torch.tensor(lab), ignore_index=-100)
+        np.testing.assert_allclose(float(out.numpy()), float(ref), rtol=1e-5)
+
+    def test_mse_l1_smooth(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()),
+            float(tF.mse_loss(torch.tensor(a), torch.tensor(b))), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()),
+            float(tF.l1_loss(torch.tensor(a), torch.tensor(b))), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()),
+            float(tF.smooth_l1_loss(torch.tensor(a), torch.tensor(b))), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x = np.random.randn(5).astype(np.float32)
+        y = np.random.randint(0, 2, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.binary_cross_entropy_with_logits(
+                paddle.to_tensor(x), paddle.to_tensor(y)).numpy()),
+            float(tF.binary_cross_entropy_with_logits(torch.tensor(x), torch.tensor(y))),
+            rtol=1e-5)
+
+    def test_kl_div(self):
+        logp = tF.log_softmax(torch.randn(4, 5), -1)
+        q = tF.softmax(torch.randn(4, 5), -1)
+        out = F.kl_div(paddle.to_tensor(t2n(logp)), paddle.to_tensor(t2n(q)),
+                       reduction="batchmean")
+        ref = tF.kl_div(logp, q, reduction="batchmean")
+        np.testing.assert_allclose(float(out.numpy()), float(ref), rtol=1e-4)
+
+    def test_ctc_loss_matches_torch(self):
+        T, N, C, L = 12, 3, 6, 4
+        logits = np.random.randn(T, N, C).astype(np.float32)
+        log_probs = tF.log_softmax(torch.tensor(logits), -1)
+        labels = np.random.randint(1, C, (N, L))
+        in_len = np.full((N,), T, np.int64)
+        lab_len = np.array([4, 3, 2], np.int64)
+        out = F.ctc_loss(paddle.to_tensor(t2n(log_probs)), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                         blank=0, reduction="none")
+        ref = tF.ctc_loss(log_probs, torch.tensor(labels), torch.tensor(in_len),
+                          torch.tensor(lab_len), blank=0, reduction="none")
+        np.testing.assert_allclose(out.numpy(), t2n(ref), rtol=1e-3, atol=1e-3)
+
+
+class TestDropout:
+    def test_dropout_train_scale(self):
+        x = np.ones((1000,), np.float32)
+        out = F.dropout(paddle.to_tensor(x), 0.5, training=True).numpy()
+        kept = out[out > 0]
+        assert np.allclose(kept, 2.0)
+        assert 0.3 < (out > 0).mean() < 0.7
+
+    def test_dropout_eval_identity(self):
+        x = np.random.randn(10).astype(np.float32)
+        np.testing.assert_array_equal(
+            F.dropout(paddle.to_tensor(x), 0.5, training=False).numpy(), x)
+
+
+class TestRNN:
+    def test_lstm_matches_torch(self):
+        B, T, I, H = 2, 5, 4, 6
+        x = np.random.randn(B, T, I).astype(np.float32)
+        lstm = paddle.nn.LSTM(I, H)
+        tl = torch.nn.LSTM(I, H, batch_first=True)
+        # copy paddle weights into torch (same [4H, I] layout, gate order i,f,g,o)
+        sd = {k: v.numpy() for k, v in lstm.state_dict().items()}
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(torch.tensor(sd["weight_ih_l0"]))
+            tl.weight_hh_l0.copy_(torch.tensor(sd["weight_hh_l0"]))
+            tl.bias_ih_l0.copy_(torch.tensor(sd["bias_ih_l0"]))
+            tl.bias_hh_l0.copy_(torch.tensor(sd["bias_hh_l0"]))
+        out, (h, c) = lstm(paddle.to_tensor(x))
+        tout, (th, tc) = tl(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), t2n(tout), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(h.numpy(), t2n(th), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(c.numpy(), t2n(tc), rtol=1e-4, atol=1e-4)
+
+    def test_gru_shapes_and_grad(self):
+        gru = paddle.nn.GRU(4, 6, num_layers=2, direction="bidirect")
+        x = paddle.to_tensor(np.random.randn(3, 7, 4).astype(np.float32),
+                             stop_gradient=False)
+        out, h = gru(x)
+        assert out.shape == [3, 7, 12]
+        assert h.shape == [4, 3, 6]
+        out.sum().backward()
+        assert gru.weight_ih_l0.grad is not None
+
+    def test_simple_rnn_cell_matches_reference_math(self):
+        cell = paddle.nn.SimpleRNNCell(3, 4)
+        x = np.random.randn(2, 3).astype(np.float32)
+        h0 = np.random.randn(2, 4).astype(np.float32)
+        out, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        wih = cell.weight_ih.numpy()
+        whh = cell.weight_hh.numpy()
+        ref = np.tanh(x @ wih.T + cell.bias_ih.numpy() + h0 @ whh.T + cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+class TestTransformer:
+    def test_mha_self_attention_shapes(self):
+        mha = paddle.nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(2, 6, 16).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_encoder_layer_forward_backward(self):
+        enc = paddle.nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32),
+                             stop_gradient=False)
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+        out.mean().backward()
+        assert enc.linear1.weight.grad is not None
+
+    def test_full_transformer(self):
+        model = paddle.nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                                      num_decoder_layers=2, dim_feedforward=32,
+                                      dropout=0.0)
+        src = paddle.to_tensor(np.random.randn(2, 6, 16).astype(np.float32))
+        tgt = paddle.to_tensor(np.random.randn(2, 4, 16).astype(np.float32))
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+    def test_attn_mask(self):
+        mha = paddle.nn.MultiHeadAttention(8, 2)
+        x = paddle.to_tensor(np.random.randn(1, 4, 8).astype(np.float32))
+        mask = paddle.to_tensor(np.tril(np.ones((1, 2, 4, 4))).astype(bool))
+        out = mha(x, attn_mask=mask)
+        assert out.shape == [1, 4, 8]
+
+
+class TestLayerMechanics:
+    def test_state_dict_roundtrip(self):
+        m1 = paddle.nn.Sequential(paddle.nn.Linear(3, 4), paddle.nn.Linear(4, 2))
+        m2 = paddle.nn.Sequential(paddle.nn.Linear(3, 4), paddle.nn.Linear(4, 2))
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.to_tensor(np.random.randn(2, 3).astype(np.float32))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_named_parameters(self):
+        m = paddle.nn.Sequential(paddle.nn.Linear(3, 4), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(4, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert "0.weight" in names and "2.bias" in names
+        assert len(names) == 4
+
+    def test_hooks(self):
+        lin = paddle.nn.Linear(3, 3)
+        calls = []
+        h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        lin(paddle.to_tensor(np.zeros((1, 3), np.float32)))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.to_tensor(np.zeros((1, 3), np.float32)))
+        assert calls == [1]
+
+    def test_train_eval_propagates(self):
+        m = paddle.nn.Sequential(paddle.nn.Dropout(0.5))
+        m.eval()
+        assert not m[0].training
+        m.train()
+        assert m[0].training
+
+    def test_layer_to_dtype(self):
+        lin = paddle.nn.Linear(2, 2)
+        lin.to(dtype="bfloat16")
+        assert str(lin.weight.dtype) == "bfloat16"

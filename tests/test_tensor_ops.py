@@ -1,0 +1,258 @@
+"""Tensor op golden tests vs numpy (OpTest pattern, SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import check_output, check_grad
+
+
+class TestMathOps:
+    def test_binary_ops(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        check_output(paddle.add, np.add, [a, b])
+        check_output(paddle.subtract, np.subtract, [a, b])
+        check_output(paddle.multiply, np.multiply, [a, b])
+        check_output(paddle.divide, np.divide, [a, b + 2.0])
+        check_output(paddle.maximum, np.maximum, [a, b])
+        check_output(paddle.minimum, np.minimum, [a, b])
+
+    def test_unary_ops(self):
+        x = np.random.rand(4, 5).astype(np.float32) + 0.5
+        check_output(paddle.exp, np.exp, [x])
+        check_output(paddle.log, np.log, [x])
+        check_output(paddle.sqrt, np.sqrt, [x])
+        check_output(paddle.abs, np.abs, [x - 1.0])
+        check_output(paddle.tanh, np.tanh, [x])
+        check_output(paddle.floor, np.floor, [x * 3])
+        check_output(paddle.ceil, np.ceil, [x * 3])
+        check_output(paddle.square, np.square, [x])
+        np.testing.assert_allclose(
+            paddle.rsqrt(paddle.to_tensor(x)).numpy(), 1.0 / np.sqrt(x), rtol=1e-5)
+
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, [a, b])
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_matmul_batched(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        check_output(paddle.bmm, np.matmul, [a, b])
+
+    def test_reductions(self):
+        x = np.random.randn(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(paddle.to_tensor(x)).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.sum(paddle.to_tensor(x), axis=1).numpy(), x.sum(axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(paddle.to_tensor(x), axis=[0, 2], keepdim=True).numpy(),
+            x.mean(axis=(0, 2), keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(paddle.to_tensor(x), axis=1).numpy(), x.max(axis=1))
+        np.testing.assert_allclose(paddle.min(paddle.to_tensor(x)).numpy(), x.min())
+        np.testing.assert_allclose(
+            paddle.prod(paddle.to_tensor(x), axis=2).numpy(), x.prod(axis=2), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(x), axis=1).numpy(),
+            np.log(np.exp(x).sum(axis=1)), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(), np.cumsum(x, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.clip(paddle.to_tensor(x), -0.5, 0.5).numpy(), np.clip(x, -0.5, 0.5))
+
+    def test_std_var(self):
+        x = np.random.randn(10, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.std(paddle.to_tensor(x), axis=0).numpy(), x.std(axis=0, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.var(paddle.to_tensor(x), unbiased=False).numpy(), x.var(), rtol=1e-4)
+
+    def test_pow_scalar_mix(self):
+        x = np.abs(np.random.randn(3, 3).astype(np.float32)) + 0.1
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose((t ** 2).numpy(), x ** 2, rtol=1e-5)
+        np.testing.assert_allclose((2.0 * t + 1.0).numpy(), 2 * x + 1, rtol=1e-6)
+        np.testing.assert_allclose((1.0 / t).numpy(), 1 / x, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(paddle.reshape(t, [4, 6]).numpy(), x.reshape(4, 6))
+        np.testing.assert_array_equal(paddle.reshape(t, [-1, 8]).numpy(), x.reshape(-1, 8))
+        np.testing.assert_array_equal(
+            paddle.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0).numpy(),
+            np.concatenate([a, b], 0))
+        np.testing.assert_array_equal(
+            paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1).numpy(),
+            np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_array_equal(parts[1].numpy(), a[:, 1:2])
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        np.testing.assert_array_equal(parts[1].numpy(), a[:, 1:])
+        parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+        np.testing.assert_array_equal(parts[1].numpy(), a[:, 1:])
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = np.random.randn(2, 1, 3).astype(np.float32)
+        t = paddle.to_tensor(x)
+        assert paddle.squeeze(t, [1]).shape == [2, 3]
+        assert paddle.unsqueeze(t, [0]).shape == [1, 2, 1, 3]
+        assert paddle.flatten(t).shape == [6]
+        assert paddle.flatten(t, 1, 2).shape == [2, 3]
+
+    def test_gather_scatter(self):
+        x = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(
+            paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(), x[idx])
+        upd = np.ones((3, 3), dtype=np.float32)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        ref = x.copy()
+        ref[idx] = 1.0
+        np.testing.assert_array_equal(out.numpy(), ref)
+
+    def test_where_tile_expand(self):
+        x = np.random.randn(2, 3).astype(np.float32)
+        y = np.random.randn(2, 3).astype(np.float32)
+        cond = x > 0
+        np.testing.assert_array_equal(
+            paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                         paddle.to_tensor(y)).numpy(),
+            np.where(cond, x, y))
+        np.testing.assert_array_equal(
+            paddle.tile(paddle.to_tensor(x), [2, 1]).numpy(), np.tile(x, (2, 1)))
+        np.testing.assert_array_equal(
+            paddle.expand(paddle.to_tensor(x[:1]), [4, 3]).numpy(),
+            np.broadcast_to(x[:1], (4, 3)))
+
+    def test_indexing(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(t[1].numpy(), x[1])
+        np.testing.assert_array_equal(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_array_equal(t[:, -1].numpy(), x[:, -1])
+        idx = paddle.to_tensor(np.array([0, 2]))
+        np.testing.assert_array_equal(t[idx].numpy(), x[[0, 2]])
+
+    def test_setitem(self):
+        x = np.zeros((3, 3), dtype=np.float32)
+        t = paddle.to_tensor(x)
+        t[1] = 5.0
+        assert t.numpy()[1].sum() == 15.0
+        t[0, 0] = 7.0
+        assert t.numpy()[0, 0] == 7.0
+
+    def test_pad(self):
+        x = np.random.randn(1, 2, 3, 3).astype(np.float32)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [1, 2, 7, 5]  # pads trailing dims NCHW spatial
+
+    def test_cast(self):
+        x = np.random.randn(3).astype(np.float32)
+        t = paddle.cast(paddle.to_tensor(x), "int32")
+        assert str(t.dtype) == "int32"
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        b = np.random.randn(3, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            (paddle.to_tensor(a) > paddle.to_tensor(b)).numpy(), a > b)
+        np.testing.assert_array_equal(
+            paddle.equal(paddle.to_tensor(a), paddle.to_tensor(a)).numpy(), a == a)
+
+    def test_argmax_topk_sort(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), x.argmax(1))
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        np.testing.assert_array_equal(
+            paddle.sort(paddle.to_tensor(x), axis=1).numpy(), np.sort(x, 1))
+
+    def test_nonzero_masked_select(self):
+        x = np.array([[1.0, 0.0], [0.0, 2.0]], dtype=np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(x), 1))
+        sel = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(x > 0))
+        np.testing.assert_array_equal(sel.numpy(), x[x > 0])
+
+
+class TestCreation:
+    def test_creators(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert paddle.full([2], 3.5).numpy().tolist() == [3.5, 3.5]
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        x = np.random.randn(3, 3).astype(np.float32)
+        np.testing.assert_array_equal(paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+        np.testing.assert_array_equal(paddle.triu(paddle.to_tensor(x), 1).numpy(), np.triu(x, 1))
+
+    def test_one_hot(self):
+        lab = np.array([0, 2, 1])
+        oh = paddle.one_hot(paddle.to_tensor(lab), 3).numpy()
+        np.testing.assert_array_equal(oh, np.eye(3, dtype=np.float32)[lab])
+
+    def test_rand_shapes(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([2]).shape == [2]
+        r = paddle.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestLinalg:
+    def test_norm(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x)).numpy(), np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x), p=1, axis=1).numpy(),
+            np.abs(x).sum(1), rtol=1e-5)
+
+    def test_solve_inv(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.randn(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(), np.linalg.inv(a),
+            rtol=1e-3, atol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
