@@ -1,0 +1,25 @@
+"""paddle_tpu.models — flagship model families (functional, shardable).
+
+These are the models the reference ships training configs for (driver
+BASELINE.json: LeNet/ResNet-50 in paddle.vision, BERT/ERNIE/GPT via Fleet).
+Vision models live in paddle_tpu.vision.models (Layer API); the language
+models here are written functionally — pure ``forward(params, batch)`` over
+a param pytree with PartitionSpec tables — because that is the shape the
+compiled hybrid-parallel path (paddle_tpu.parallel) consumes directly.
+"""
+from .gpt import (
+    GPTConfig,
+    gpt_init,
+    gpt_forward,
+    gpt_loss,
+    gpt_param_specs,
+    gpt_tiny,
+    gpt_small,
+    gpt_1p3b,
+    bert_base_config,
+)
+
+__all__ = [
+    "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
+    "gpt_tiny", "gpt_small", "gpt_1p3b", "bert_base_config",
+]

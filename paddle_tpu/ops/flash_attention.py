@@ -91,18 +91,41 @@ def _flash_forward(q, k, v, causal=False, scale=None, block_q=128, interpret=Fal
     return out.reshape(b, h, sq, d)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, block_q):
+    return _flash_forward(q, k, v, causal=causal, scale=scale, block_q=block_q)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q):
+    return _flash(q, k, v, causal, scale, block_q), (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, res, g):
+    # Backward recomputes attention through the XLA reference path (the
+    # [S,S] score matrix exists only inside the bwd computation; a Pallas
+    # flash-backward kernel replacing this is tracked work).
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
 def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=128):
-    """Array-level entry (used inside jit traces / functional code)."""
+    """Array-level entry (used inside jit traces / functional code).
+
+    Differentiable: the Pallas kernel runs the forward; a custom_vjp
+    recomputes the backward via the reference formula.
+    """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     use_pallas = _on_tpu() and d in (64, 128, 256) and q.shape[-2] >= 128
     if use_pallas:
-        # checkpoint: recompute attention in backward instead of saving P
-        fwd = jax.checkpoint(
-            functools.partial(_flash_forward, causal=causal, scale=scale,
-                              block_q=block_q, interpret=False))
-        return fwd(q, k, v)
+        return _flash(q, k, v, bool(causal), float(scale), int(block_q))
     return _attention_reference(q, k, v, causal, scale)
 
 

@@ -1,0 +1,50 @@
+"""paddle_tpu.parallel — the compiled (GSPMD) distributed execution path.
+
+This package is the TPU-native replacement for the reference's whole
+distributed *execution* stack:
+
+- NCCL ring plumbing (reference paddle/fluid/platform/collective_helper.h:68,
+  gen_comm_id_helper.cc) → a single :class:`jax.sharding.Mesh` with the four
+  Fleet axes ``("data", "sharding", "pipe", "model")`` (mesh.py). Axis names
+  replace ring_ids; XLA emits the collectives over ICI/DCN.
+- Program-rewriting meta-optimizers (reference
+  fleet/meta_optimizers/sharding_optimizer.py:45, raw_program_optimizer.py,
+  tensor_parallel_optimizer.py) → PartitionSpec *rules* applied to a param
+  pytree (sharding.py). GSPMD propagation replaces the hand-inserted
+  c_allreduce/c_broadcast/c_reducescatter ops.
+- SectionWorker / PipelineParallel 1F1B (reference
+  framework/section_worker.cc:61, fleet/meta_parallel/pipeline_parallel.py:80)
+  → an SPMD pipeline schedule compiled into ONE XLA program: stage-stacked
+  params sharded over "pipe", microbatch rotation via a roll that XLA lowers
+  to CollectivePermute over ICI (pipeline.py).
+- HybridParallelOptimizer (reference dygraph_optimizer/
+  hybrid_parallel_optimizer.py:173) → DistributedTrainStep (train_step.py):
+  loss + grad + clip + optimizer update jitted once with in/out shardings;
+  dp/sharding gradient reduction is implicit in the sharded program.
+"""
+from .mesh import (
+    create_mesh,
+    get_mesh,
+    set_mesh,
+    mesh_shape,
+    MeshGuard,
+    factorize_devices,
+)
+from .sharding import (
+    ShardingRules,
+    apply_rules,
+    zero_shard_specs,
+    shard_params,
+    constraint,
+)
+from .pipeline import pipeline_forward, stack_stages
+from .train_step import DistributedTrainStep, pure_adamw_init, pure_adamw_update
+
+__all__ = [
+    "create_mesh", "get_mesh", "set_mesh", "mesh_shape", "MeshGuard",
+    "factorize_devices",
+    "ShardingRules", "apply_rules", "zero_shard_specs", "shard_params",
+    "constraint",
+    "pipeline_forward", "stack_stages",
+    "DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
+]

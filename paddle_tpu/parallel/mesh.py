@@ -1,0 +1,94 @@
+"""Device mesh management — the TPU-native replacement for NCCL rings.
+
+Parity: reference fleet topology (python/paddle/distributed/fleet/base/
+topology.py:36 CommunicateTopology dims ["data","pipe","sharding","model"])
+and the ring-id based comm contexts (paddle/fluid/platform/
+collective_helper.h:68). One jax.sharding.Mesh with the four Fleet axes
+replaces both: a "group" is a mesh axis name, collective placement is
+decided by GSPMD, and the TCP unique-id bootstrap (gen_comm_id_helper.cc)
+is replaced by jax.distributed's coordinator (multi-host) or nothing at
+all (single-host slices).
+
+Axis order is ("data", "sharding", "pipe", "model"): the innermost axis
+("model") maps to the most tightly coupled devices so TP collectives ride
+the fastest ICI links; "data" is outermost so DP gradient reductions can
+cross DCN on multi-slice topologies.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "sharding", "pipe", "model")
+
+_state = threading.local()
+
+
+def factorize_devices(n: int, dp: int = -1, sharding: int = 1, pp: int = 1,
+                      mp: int = 1) -> Tuple[int, int, int, int]:
+    """Resolve mesh dims; a -1 dim absorbs the remaining devices."""
+    dims = [dp, sharding, pp, mp]
+    fixed = int(np.prod([d for d in dims if d != -1]))
+    free = [i for i, d in enumerate(dims) if d == -1]
+    if len(free) > 1:
+        raise ValueError("at most one mesh dim may be -1")
+    if free:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed dims {dims}")
+        dims[free[0]] = n // fixed
+    if int(np.prod(dims)) != n:
+        raise ValueError(f"mesh dims {dims} != device count {n}")
+    return tuple(dims)
+
+
+def create_mesh(dp: int = -1, sharding: int = 1, pp: int = 1, mp: int = 1,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 4-axis Fleet mesh over the available devices.
+
+    Like fleet._init_hybrid_parallel_env (reference fleet_base.py:338) but
+    the result is a jax Mesh, not a set of NCCL rings.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dims = factorize_devices(len(devices), dp, sharding, pp, mp)
+    arr = np.array(devices).reshape(dims)
+    mesh = Mesh(arr, AXES)
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _state.mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+def mesh_shape(mesh: Optional[Mesh] = None) -> dict:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return {a: 1 for a in AXES}
+    return dict(mesh.shape)
+
+
+class MeshGuard:
+    """Context manager installing a mesh as current."""
+
+    def __init__(self, mesh: Mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = get_mesh()
+        set_mesh(self._mesh)
+        self._ctx = self._mesh
+        self._ctx.__enter__()
+        return self._mesh
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        set_mesh(self._prev)
+        return False

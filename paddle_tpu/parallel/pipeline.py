@@ -1,0 +1,98 @@
+"""SPMD pipeline parallelism compiled into one XLA program.
+
+This is the TPU-native answer to the reference's TWO pipeline runtimes:
+- static SectionWorker 1F1B (reference paddle/fluid/framework/
+  section_worker.cc:61-142: per-stage process runs F then B per microbatch,
+  p2p via send_v2/recv_v2 ops), and
+- dygraph PipelineParallel (reference fleet/meta_parallel/
+  pipeline_parallel.py:80-150: warmup/steady/cooldown loop with NCCL
+  isend/irecv pairs).
+
+Design: all stages live in ONE jitted program. Block params are stacked
+with a leading stage dim sharded over the "pipe" mesh axis; each schedule
+tick applies every stage's layer-stack in parallel (a vmap over the stage
+dim — zero cross-stage communication because params and activations are
+both pipe-sharded), then rotates the activation buffer one stage forward
+with a roll that XLA lowers to a CollectivePermute over ICI. Differentiation
+through the schedule gives the backward pipeline for free (the transpose of
+a CollectivePermute is the reverse permute), so the 1F1B process choreography
+collapses into a lax.scan the compiler software-pipelines.
+
+Schedule (GPipe-style fill/drain, T = n_micro + n_stages - 1 ticks):
+  tick t: stage 0 ingests microbatch t (t < n_micro); stage s processes the
+  activation it received at tick t-1; stage S-1 emits microbatch t-(S-1).
+Bubble fraction = (S-1)/T, same as the reference's F-then-B schedule
+(section_worker.cc:139-142); increase n_micro to amortise.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["stack_stages", "pipeline_forward"]
+
+
+def stack_stages(block_params, n_stages: int):
+    """Reshape leading layer dim L → (n_stages, L // n_stages).
+
+    The analog of the reference's SegmentLayers uniform split
+    (fleet/meta_parallel/pp_layers.py:63-130).
+    """
+
+    def one(x):
+        L = x.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return x.reshape(n_stages, L // n_stages, *x.shape[1:])
+
+    return jax.tree_util.tree_map(one, block_params)
+
+
+def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
+                     n_stages: int):
+    """Run the pipeline schedule; returns per-microbatch outputs.
+
+    Args:
+      stage_fn: ``(params_one_stage, x) -> y`` applying one stage's layer
+        stack; x and y share shape (the inter-stage activation).
+      stage_params: pytree with leading dims (n_stages, layers_per_stage,
+        ...) — shard dim 0 over the "pipe" mesh axis.
+      x_micro: (n_micro, micro_batch, ...) stage-0 inputs.
+      n_stages: pipeline depth (mesh "pipe" size).
+
+    Returns: (n_micro, micro_batch, ...) final-stage outputs.
+    """
+    n_micro = x_micro.shape[0]
+    if n_stages == 1:
+        return jax.vmap(lambda x: stage_fn(
+            jax.tree_util.tree_map(lambda p: p[0], stage_params), x))(x_micro)
+
+    T = n_micro + n_stages - 1
+    act_shape = (n_stages,) + x_micro.shape[1:]
+
+    vstage = jax.vmap(stage_fn)
+
+    def tick(carry, t):
+        acts, outs = carry
+        # inject microbatch t at stage 0 (clamped read; masked write)
+        inj = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+        inj = jnp.where(t < n_micro, inj, jnp.zeros_like(inj))
+        acts = acts.at[0].set(inj.astype(acts.dtype))
+        # all stages compute in parallel on their held activation
+        y = vstage(stage_params, acts)
+        # drain: last stage's output is microbatch t-(S-1); clamped index —
+        # pre-fill garbage at index 0 is overwritten at t = S-1.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, y[-1].astype(outs.dtype), out_idx, axis=0)
+        # rotate activations one stage forward (XLA: CollectivePermute)
+        acts = jnp.roll(y, shift=1, axis=0)
+        return (acts, outs), None
+
+    acts0 = jnp.zeros(act_shape, x_micro.dtype)
+    outs0 = jnp.zeros_like(x_micro)
+    (acts, outs), _ = jax.lax.scan(tick, (acts0, outs0), jnp.arange(T))
+    return outs
