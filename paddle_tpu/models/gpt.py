@@ -52,6 +52,10 @@ class GPTConfig:
     n_stages: int = 1                # pipeline depth (mesh "pipe")
     remat: bool = False
     use_flash: Optional[bool] = None  # None = auto (TPU only)
+    # long-context: ring attention with the seq dim sharded over seq_axis
+    # (context parallelism — new capability vs the reference, SURVEY.md §5)
+    ring_attention: bool = False
+    seq_axis: str = "sharding"
 
     @property
     def head_dim(self):
@@ -164,8 +168,13 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 
 
 def _attention(cfg: GPTConfig, q, k, v):
-    use_flash = cfg.use_flash if cfg.use_flash is not None else _on_tpu()
     scale = 1.0 / math.sqrt(cfg.head_dim)
+    if cfg.ring_attention:
+        from ..parallel.ring_attention import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, causal=True, scale=scale,
+                                      seq_axis=cfg.seq_axis,
+                                      batch_axis="data", head_axis="model")
+    use_flash = cfg.use_flash if cfg.use_flash is not None else _on_tpu()
     if use_flash:
         from ..ops.flash_attention import flash_attention_arrays
         return flash_attention_arrays(q, k, v, causal=True, scale=scale)

@@ -38,6 +38,8 @@ from .sharding import (
     constraint,
 )
 from .pipeline import pipeline_forward, stack_stages
+from .ring_attention import ring_attention, ring_attention_sharded
+from .moe import moe_ffn, moe_init, moe_param_specs, top2_gating
 from .train_step import DistributedTrainStep, pure_adamw_init, pure_adamw_update
 
 __all__ = [
@@ -46,5 +48,7 @@ __all__ = [
     "ShardingRules", "apply_rules", "zero_shard_specs", "shard_params",
     "constraint",
     "pipeline_forward", "stack_stages",
+    "ring_attention", "ring_attention_sharded",
+    "moe_ffn", "moe_init", "moe_param_specs", "top2_gating",
     "DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
 ]

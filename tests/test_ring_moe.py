@@ -1,0 +1,153 @@
+"""Ring attention and MoE expert-parallel tests on the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.ops.flash_attention import _attention_reference
+from paddle_tpu.parallel import (
+    create_mesh, moe_ffn, moe_init, moe_param_specs,
+    ring_attention_sharded, top2_gating,
+)
+from paddle_tpu.parallel.sharding import shard_params
+
+
+def _qkv(b=2, h=4, s=256, d=32, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    mk = lambda k: jax.random.normal(k, (b, h, s, d), dtype)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        """Ring over 4 seq shards ≡ single-device full attention."""
+        mesh = create_mesh(dp=2, sharding=4)
+        q, k, v = _qkv()
+        out = ring_attention_sharded(q, k, v, causal=causal, mesh=mesh)
+        ref = _attention_reference(q, k, v, causal, q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grads_match_reference(self):
+        mesh = create_mesh(dp=1, sharding=8, mp=1)
+        q, k, v = _qkv(b=1, h=2, s=128, d=16)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention_sharded(
+                q, k, v, causal=True, mesh=mesh, batch_axis=None,
+                head_axis=None) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_attention_reference(
+                q, k, v, True, q.shape[-1] ** -0.5) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_long_context_in_jit(self):
+        """Ring attention composes with jit (the long-context train path)."""
+        mesh = create_mesh(dp=1, sharding=8)
+        q, k, v = _qkv(b=1, h=2, s=1024, d=16)
+        f = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, causal=True, mesh=mesh, batch_axis=None, head_axis=None))
+        out = f(q, k, v)
+        assert out.shape == q.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestMoE:
+    def test_gating_shapes_and_weights(self):
+        logits = jax.random.normal(jax.random.key(0), (32, 4))
+        dispatch, combine, aux = top2_gating(logits, capacity=16)
+        assert dispatch.shape == (32, 4, 16)
+        assert combine.shape == (32, 4, 16)
+        # each kept token's combine weights sum to ~1 (top-2 renormalised)
+        w = np.asarray(combine.sum(axis=(1, 2)))
+        kept = w > 0
+        np.testing.assert_allclose(w[kept], 1.0, rtol=1e-5)
+        assert float(aux) > 0
+
+    def test_moe_ffn_runs_and_routes(self):
+        params = moe_init(jax.random.key(0), n_experts=4, d_model=16, d_ff=32)
+        x = jax.random.normal(jax.random.key(1), (2, 8, 16))
+        y, aux = moe_ffn(params, x, expert_axis=None)
+        assert y.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(y)))
+
+    def test_expert_parallel_collectives_in_hlo(self):
+        """Data-sharded tokens × model-sharded experts: the compiled
+        program must reshard between the token and expert layouts — the
+        compiled analog of reference global_scatter/global_gather. No
+        scalar reduction in the traced fn, so every collective present
+        comes from the routing itself."""
+        import re
+
+        from jax.sharding import NamedSharding
+
+        mesh = create_mesh(dp=2, mp=4)
+        params = moe_init(jax.random.key(0), n_experts=8, d_model=16, d_ff=32)
+        params = shard_params(params, moe_param_specs("model"), mesh)
+
+        def f(params, x):
+            y, _ = moe_ffn(params, x, expert_axis="model")
+            return y  # full array out — no loss all-reduce to hide behind
+
+        x = jax.random.normal(jax.random.key(1), (8, 16, 16))
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", None, None)))
+        with mesh:
+            hlo = jax.jit(f).lower(params, xs).compile().as_text()
+        colls = set(re.findall(
+            r"all-to-all|reduce-scatter|all-reduce|all-gather", hlo))
+        assert colls, "expert-parallel MoE compiled with no collectives"
+
+    def test_ep_matches_unsharded(self):
+        mesh = create_mesh(dp=2, mp=4)
+        params = moe_init(jax.random.key(0), n_experts=8, d_model=16, d_ff=32)
+        x = jax.random.normal(jax.random.key(1), (4, 16, 16))
+        y_ref, aux_ref = moe_ffn(params, x, expert_axis=None)
+        sharded = shard_params(params, moe_param_specs("model"), mesh)
+        with mesh:
+            y_ep, aux_ep = jax.jit(
+                lambda p, x: moe_ffn(p, x, expert_axis="model"))(sharded, x)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-4)
+
+
+class TestGPTRingAttention:
+    def test_gpt_trains_with_ring_attention(self):
+        """Context-parallel GPT training step: seq sharded over 'sharding',
+        TP over 'model', dp over 'data' — the long-context train path."""
+        from paddle_tpu.models import gpt_tiny, gpt_init, gpt_loss, gpt_param_specs
+        from paddle_tpu.parallel import DistributedTrainStep
+
+        mesh = create_mesh(dp=2, sharding=2, mp=2)
+        cfg = gpt_tiny(ring_attention=True, use_flash=False)
+        params = gpt_init(cfg, 0)
+        step = DistributedTrainStep(
+            lambda p, b: gpt_loss(cfg, p, b), params, gpt_param_specs(cfg),
+            lr=1e-3, mesh=mesh)
+        rng = np.random.default_rng(0)
+        tok = rng.integers(0, cfg.vocab_size, (8, cfg.seq_len)).astype(np.int32)
+        losses = [float(step((tok, tok))) for _ in range(3)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_ring_matches_dense_gpt(self):
+        from paddle_tpu.models import gpt_tiny, gpt_init, gpt_loss
+
+        mesh = create_mesh(dp=2, sharding=2, mp=2)
+        params = gpt_init(gpt_tiny(), 0)
+        rng = np.random.default_rng(1)
+        cfg_d = gpt_tiny(use_flash=False)
+        tok = rng.integers(0, cfg_d.vocab_size, (4, cfg_d.seq_len)).astype(np.int32)
+        cfg_r = gpt_tiny(ring_attention=True, use_flash=False)
+        with mesh:
+            l_ring = float(jax.jit(lambda p: gpt_loss(cfg_r, p, (tok, tok)))(params))
+        l_dense = float(jax.jit(lambda p: gpt_loss(cfg_d, p, (tok, tok)))(params))
+        np.testing.assert_allclose(l_ring, l_dense, rtol=2e-4)
