@@ -1,0 +1,26 @@
+"""paddle_tpu.core — native (C++) runtime core.
+
+The TPU build's counterpart of the reference's C++ platform layer
+(``paddle.fluid.core``): flags, stat monitor, profiler event recorder,
+blocking queue, host arena allocator. See csrc/ptpu_core.cc and native.py.
+"""
+from .native import (
+    NATIVE_AVAILABLE,
+    ArenaAllocator,
+    BlockingQueue,
+    get_flag,
+    set_flag,
+    stat_add,
+    stat_get,
+    stat_reset,
+    profiler_enable,
+    profiler_dump,
+    profiler_clear,
+    record_event,
+)
+
+__all__ = [
+    "NATIVE_AVAILABLE", "ArenaAllocator", "BlockingQueue",
+    "get_flag", "set_flag", "stat_add", "stat_get", "stat_reset",
+    "profiler_enable", "profiler_dump", "profiler_clear", "record_event",
+]

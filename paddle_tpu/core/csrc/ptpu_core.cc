@@ -1,0 +1,394 @@
+// paddle_tpu native runtime core.
+//
+// TPU-native counterparts of the reference's C++ platform layer, exposed as
+// a C ABI for ctypes (this environment has no pybind11):
+//   - flags registry        (reference paddle/fluid/platform/flags.cc,
+//                            pybind/global_value_getter_setter.cc)
+//   - stat monitor          (reference paddle/fluid/platform/monitor.h:77
+//                            StatRegistry / STAT_ADD)
+//   - profiler events       (reference paddle/fluid/platform/profiler.h:130
+//                            RecordEvent -> chrome trace)
+//   - blocking queue        (reference paddle/fluid/operators/reader/
+//                            lod_tensor_blocking_queue.h, the DataLoader's
+//                            C++ half)
+//   - host arena allocator  (reference paddle/fluid/memory/allocation/
+//                            auto_growth_best_fit_allocator.cc — host-side
+//                            staging analog; device memory belongs to PJRT)
+//
+// Build: make -C paddle_tpu/core (g++ -shared -fPIC). Loaded via ctypes by
+// paddle_tpu/core/native.py with a pure-Python fallback.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define PTPU_API extern "C" __attribute__((visibility("default")))
+
+// ---------------------------------------------------------------------------
+// error reporting (enforce analog): last error string per thread
+// ---------------------------------------------------------------------------
+static thread_local std::string g_last_error;
+
+PTPU_API const char* ptpu_last_error() { return g_last_error.c_str(); }
+
+static void set_error(const std::string& msg) { g_last_error = msg; }
+
+// ---------------------------------------------------------------------------
+// flags registry
+// ---------------------------------------------------------------------------
+extern char** environ;
+
+namespace {
+struct FlagsRegistry {
+  std::mutex mu;
+  std::map<std::string, std::string> flags;
+
+  FlagsRegistry() {
+    // adopt FLAGS_* environment variables, as the reference does for its
+    // exported gflags (platform/flags.cc)
+    for (char** e = environ; e && *e; ++e) {
+      const char* kv = *e;
+      if (std::strncmp(kv, "FLAGS_", 6) == 0) {
+        const char* eq = std::strchr(kv, '=');
+        if (eq) flags.emplace(std::string(kv, eq - kv), std::string(eq + 1));
+      }
+    }
+  }
+};
+FlagsRegistry& flags_registry() {
+  static FlagsRegistry r;
+  return r;
+}
+}  // namespace
+
+PTPU_API void ptpu_flag_set(const char* name, const char* value) {
+  auto& r = flags_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.flags[name] = value;
+}
+
+// returns 1 if found; copies up to cap-1 bytes into out
+PTPU_API int ptpu_flag_get(const char* name, char* out, int cap) {
+  auto& r = flags_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.flags.find(name);
+  if (it == r.flags.end()) return 0;
+  std::strncpy(out, it->second.c_str(), cap - 1);
+  out[cap - 1] = '\0';
+  return 1;
+}
+
+PTPU_API int ptpu_flag_count() {
+  auto& r = flags_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  return (int)r.flags.size();
+}
+
+// ---------------------------------------------------------------------------
+// stat monitor
+// ---------------------------------------------------------------------------
+namespace {
+struct StatRegistry {
+  std::mutex mu;
+  std::map<std::string, std::atomic<int64_t>> stats;
+};
+StatRegistry& stat_registry() {
+  static StatRegistry r;
+  return r;
+}
+}  // namespace
+
+PTPU_API void ptpu_stat_add(const char* name, int64_t delta) {
+  auto& r = stat_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.stats[name] += delta;
+}
+
+PTPU_API int64_t ptpu_stat_get(const char* name) {
+  auto& r = stat_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.stats.find(name);
+  return it == r.stats.end() ? 0 : it->second.load();
+}
+
+PTPU_API void ptpu_stat_reset(const char* name) {
+  auto& r = stat_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.stats[name] = 0;
+}
+
+// ---------------------------------------------------------------------------
+// profiler: RecordEvent ring buffer -> chrome trace JSON
+// ---------------------------------------------------------------------------
+namespace {
+struct ProfEvent {
+  std::string name;
+  int64_t ts_ns;
+  int64_t dur_ns;
+  int64_t tid;
+};
+struct Profiler {
+  std::mutex mu;
+  std::vector<ProfEvent> events;
+  std::atomic<bool> enabled{false};
+};
+Profiler& profiler() {
+  static Profiler p;
+  return p;
+}
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+PTPU_API void ptpu_profiler_enable(int on) { profiler().enabled = on != 0; }
+
+PTPU_API int64_t ptpu_event_begin() { return now_ns(); }
+
+PTPU_API void ptpu_event_end(const char* name, int64_t begin_ns) {
+  auto& p = profiler();
+  if (!p.enabled) return;
+  int64_t tid = (int64_t)std::hash<std::thread::id>{}(std::this_thread::get_id());
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.events.push_back({name, begin_ns, now_ns() - begin_ns, tid & 0xffff});
+}
+
+PTPU_API int ptpu_profiler_event_count() {
+  auto& p = profiler();
+  std::lock_guard<std::mutex> lk(p.mu);
+  return (int)p.events.size();
+}
+
+// serialize chrome-trace JSON; returns bytes written (or required size if
+// out==nullptr), truncates at cap
+PTPU_API int64_t ptpu_profiler_dump(char* out, int64_t cap) {
+  auto& p = profiler();
+  std::lock_guard<std::mutex> lk(p.mu);
+  std::string json = "{\"traceEvents\":[";
+  bool first = true;
+  for (auto& e : p.events) {
+    if (!first) json += ",";
+    first = false;
+    json += "{\"name\":\"" + e.name + "\",\"ph\":\"X\",\"pid\":0,\"tid\":" +
+            std::to_string(e.tid) + ",\"ts\":" + std::to_string(e.ts_ns / 1000) +
+            ",\"dur\":" + std::to_string(e.dur_ns / 1000) + "}";
+  }
+  json += "]}";
+  if (out == nullptr) return (int64_t)json.size();
+  int64_t n = (int64_t)json.size() < cap ? (int64_t)json.size() : cap;
+  std::memcpy(out, json.data(), n);
+  return n;
+}
+
+PTPU_API void ptpu_profiler_clear() {
+  auto& p = profiler();
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.events.clear();
+}
+
+// ---------------------------------------------------------------------------
+// blocking queue of byte buffers
+// ---------------------------------------------------------------------------
+namespace {
+struct ByteBuf {
+  char* data;
+  int64_t len;
+};
+struct BlockingQueue {
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<ByteBuf> q;
+  size_t capacity;
+  bool closed = false;
+};
+}  // namespace
+
+PTPU_API void* ptpu_queue_create(int capacity) {
+  auto* q = new BlockingQueue();
+  q->capacity = capacity > 0 ? (size_t)capacity : 1;
+  return q;
+}
+
+// returns 1 on success, 0 if closed, -1 on timeout (timeout_ms < 0 = block)
+PTPU_API int ptpu_queue_push(void* h, const char* data, int64_t len,
+                             int timeout_ms) {
+  auto* q = (BlockingQueue*)h;
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto ready = [&] { return q->closed || q->q.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->cv_push.wait(lk, ready);
+  } else if (!q->cv_push.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                  ready)) {
+    return -1;
+  }
+  if (q->closed) return 0;
+  char* copy = (char*)std::malloc(len);
+  std::memcpy(copy, data, len);
+  q->q.push_back({copy, len});
+  q->cv_pop.notify_one();
+  return 1;
+}
+
+// returns length >=0 on success (caller then calls ptpu_queue_take to copy
+// out + free), 0-with-closed semantics via status: 1 ok, 0 closed+empty,
+// -1 timeout
+PTPU_API int ptpu_queue_pop(void* h, char** out_data, int64_t* out_len,
+                            int timeout_ms) {
+  auto* q = (BlockingQueue*)h;
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto ready = [&] { return q->closed || !q->q.empty(); };
+  if (timeout_ms < 0) {
+    q->cv_pop.wait(lk, ready);
+  } else if (!q->cv_pop.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                 ready)) {
+    return -1;
+  }
+  if (q->q.empty()) return 0;  // closed and drained
+  ByteBuf b = q->q.front();
+  q->q.pop_front();
+  q->cv_push.notify_one();
+  *out_data = b.data;
+  *out_len = b.len;
+  return 1;
+}
+
+PTPU_API void ptpu_buffer_free(char* data) { std::free(data); }
+
+PTPU_API int ptpu_queue_size(void* h) {
+  auto* q = (BlockingQueue*)h;
+  std::lock_guard<std::mutex> lk(q->mu);
+  return (int)q->q.size();
+}
+
+PTPU_API void ptpu_queue_close(void* h) {
+  auto* q = (BlockingQueue*)h;
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->cv_pop.notify_all();
+  q->cv_push.notify_all();
+}
+
+PTPU_API void ptpu_queue_destroy(void* h) {
+  auto* q = (BlockingQueue*)h;
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    for (auto& b : q->q) std::free(b.data);
+    q->q.clear();
+    q->closed = true;
+  }
+  delete q;
+}
+
+// ---------------------------------------------------------------------------
+// host arena allocator (best-fit with coalescing) + stats
+// ---------------------------------------------------------------------------
+namespace {
+struct Arena {
+  std::mutex mu;
+  char* base = nullptr;
+  size_t size = 0;
+  // offset -> length, free blocks
+  std::map<size_t, size_t> free_blocks;
+  std::map<size_t, size_t> used_blocks;
+  int64_t allocated = 0, peak = 0, alloc_count = 0;
+};
+}  // namespace
+
+PTPU_API void* ptpu_arena_create(int64_t bytes) {
+  auto* a = new Arena();
+  a->base = (char*)std::malloc(bytes);
+  if (!a->base) {
+    delete a;
+    set_error("arena: malloc failed");
+    return nullptr;
+  }
+  a->size = bytes;
+  a->free_blocks[0] = bytes;
+  return a;
+}
+
+PTPU_API void* ptpu_arena_alloc(void* h, int64_t bytes) {
+  auto* a = (Arena*)h;
+  std::lock_guard<std::mutex> lk(a->mu);
+  size_t need = (size_t)((bytes + 63) & ~63LL);  // 64B aligned
+  // best fit
+  auto best = a->free_blocks.end();
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= need &&
+        (best == a->free_blocks.end() || it->second < best->second)) {
+      best = it;
+    }
+  }
+  if (best == a->free_blocks.end()) {
+    set_error("arena: out of memory");
+    return nullptr;
+  }
+  size_t off = best->first, len = best->second;
+  a->free_blocks.erase(best);
+  if (len > need) a->free_blocks[off + need] = len - need;
+  a->used_blocks[off] = need;
+  a->allocated += (int64_t)need;
+  a->alloc_count += 1;
+  if (a->allocated > a->peak) a->peak = a->allocated;
+  return a->base + off;
+}
+
+PTPU_API int ptpu_arena_free(void* h, void* ptr) {
+  auto* a = (Arena*)h;
+  std::lock_guard<std::mutex> lk(a->mu);
+  size_t off = (char*)ptr - a->base;
+  auto it = a->used_blocks.find(off);
+  if (it == a->used_blocks.end()) {
+    set_error("arena: free of unknown pointer");
+    return 0;
+  }
+  size_t len = it->second;
+  a->used_blocks.erase(it);
+  a->allocated -= (int64_t)len;
+  // insert + coalesce with neighbors
+  auto ins = a->free_blocks.emplace(off, len).first;
+  if (ins != a->free_blocks.begin()) {
+    auto prev = std::prev(ins);
+    if (prev->first + prev->second == ins->first) {
+      prev->second += ins->second;
+      a->free_blocks.erase(ins);
+      ins = prev;
+    }
+  }
+  auto next = std::next(ins);
+  if (next != a->free_blocks.end() &&
+      ins->first + ins->second == next->first) {
+    ins->second += next->second;
+    a->free_blocks.erase(next);
+  }
+  return 1;
+}
+
+PTPU_API int64_t ptpu_arena_stat(void* h, int which) {
+  auto* a = (Arena*)h;
+  std::lock_guard<std::mutex> lk(a->mu);
+  switch (which) {
+    case 0: return a->allocated;
+    case 1: return a->peak;
+    case 2: return a->alloc_count;
+    case 3: return (int64_t)a->free_blocks.size();
+    default: return -1;
+  }
+}
+
+PTPU_API void ptpu_arena_destroy(void* h) {
+  auto* a = (Arena*)h;
+  std::free(a->base);
+  delete a;
+}

@@ -1,0 +1,310 @@
+"""ctypes bindings for the native runtime core (csrc/ptpu_core.cc).
+
+The reference binds its C++ core with pybind11 (paddle/fluid/pybind/
+pybind.cc); this environment has no pybind11, so the native library exports
+a C ABI consumed here via ctypes. The .so is lazy-built with the Makefile
+on first import; if the toolchain is unavailable the pure-Python fallbacks
+below keep the API working (slower, same semantics).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "lib", "libptpu_core.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    # signatures
+    lib.ptpu_last_error.restype = ctypes.c_char_p
+    lib.ptpu_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ptpu_flag_get.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_flag_get.restype = ctypes.c_int
+    lib.ptpu_stat_add.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ptpu_stat_get.argtypes = [ctypes.c_char_p]
+    lib.ptpu_stat_get.restype = ctypes.c_int64
+    lib.ptpu_stat_reset.argtypes = [ctypes.c_char_p]
+    lib.ptpu_profiler_enable.argtypes = [ctypes.c_int]
+    lib.ptpu_event_begin.restype = ctypes.c_int64
+    lib.ptpu_event_end.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ptpu_profiler_dump.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ptpu_profiler_dump.restype = ctypes.c_int64
+    lib.ptpu_profiler_event_count.restype = ctypes.c_int
+    lib.ptpu_queue_create.argtypes = [ctypes.c_int]
+    lib.ptpu_queue_create.restype = ctypes.c_void_p
+    lib.ptpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64, ctypes.c_int]
+    lib.ptpu_queue_push.restype = ctypes.c_int
+    lib.ptpu_queue_pop.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                                   ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.ptpu_queue_pop.restype = ctypes.c_int
+    lib.ptpu_buffer_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.ptpu_queue_size.argtypes = [ctypes.c_void_p]
+    lib.ptpu_queue_size.restype = ctypes.c_int
+    lib.ptpu_queue_close.argtypes = [ctypes.c_void_p]
+    lib.ptpu_queue_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptpu_arena_create.argtypes = [ctypes.c_int64]
+    lib.ptpu_arena_create.restype = ctypes.c_void_p
+    lib.ptpu_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ptpu_arena_alloc.restype = ctypes.c_void_p
+    lib.ptpu_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ptpu_arena_free.restype = ctypes.c_int
+    lib.ptpu_arena_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_arena_stat.restype = ctypes.c_int64
+    lib.ptpu_arena_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib = _build_and_load()
+NATIVE_AVAILABLE = _lib is not None
+
+
+# -- flags ------------------------------------------------------------------
+
+_py_flags = {}
+_py_flags_lock = threading.Lock()
+
+
+def set_flag(name: str, value) -> None:
+    if _lib is not None:
+        _lib.ptpu_flag_set(name.encode(), str(value).encode())
+    else:
+        with _py_flags_lock:
+            _py_flags[name] = str(value)
+
+
+def get_flag(name: str, default=None):
+    if _lib is not None:
+        buf = ctypes.create_string_buffer(4096)
+        if _lib.ptpu_flag_get(name.encode(), buf, 4096):
+            return buf.value.decode()
+        return default
+    with _py_flags_lock:
+        if name in _py_flags:
+            return _py_flags[name]
+    return os.environ.get(name, default)
+
+
+# -- stats ------------------------------------------------------------------
+
+_py_stats = {}
+
+
+def stat_add(name: str, delta: int = 1) -> None:
+    if _lib is not None:
+        _lib.ptpu_stat_add(name.encode(), int(delta))
+    else:
+        with _py_flags_lock:
+            _py_stats[name] = _py_stats.get(name, 0) + int(delta)
+
+
+def stat_get(name: str) -> int:
+    if _lib is not None:
+        return int(_lib.ptpu_stat_get(name.encode()))
+    with _py_flags_lock:
+        return _py_stats.get(name, 0)
+
+
+def stat_reset(name: str) -> None:
+    if _lib is not None:
+        _lib.ptpu_stat_reset(name.encode())
+    else:
+        with _py_flags_lock:
+            _py_stats[name] = 0
+
+
+# -- profiler ---------------------------------------------------------------
+
+_py_events = []
+_py_prof_enabled = [False]
+
+
+def profiler_enable(on: bool = True) -> None:
+    if _lib is not None:
+        _lib.ptpu_profiler_enable(1 if on else 0)
+    else:
+        _py_prof_enabled[0] = bool(on)
+
+
+def profiler_clear() -> None:
+    if _lib is not None:
+        _lib.ptpu_profiler_clear()
+    else:
+        _py_events.clear()
+
+
+def profiler_dump() -> str:
+    """Chrome-trace JSON of recorded events."""
+    if _lib is not None:
+        n = _lib.ptpu_profiler_dump(None, 0)
+        buf = ctypes.create_string_buffer(int(n) + 1)
+        _lib.ptpu_profiler_dump(buf, n)
+        return buf.raw[:n].decode()
+    import json
+    return json.dumps({"traceEvents": [
+        {"name": name, "ph": "X", "pid": 0, "tid": 0,
+         "ts": int(ts * 1e6), "dur": int(dur * 1e6)}
+        for name, ts, dur in _py_events]})
+
+
+@contextmanager
+def record_event(name: str):
+    """RAII event scope (reference platform/profiler.h:130 RecordEvent)."""
+    if _lib is not None:
+        t0 = _lib.ptpu_event_begin()
+        try:
+            yield
+        finally:
+            _lib.ptpu_event_end(name.encode(), t0)
+    else:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if _py_prof_enabled[0]:
+                _py_events.append((name, t0, time.perf_counter() - t0))
+
+
+# -- blocking queue ---------------------------------------------------------
+
+class BlockingQueue:
+    """Bounded byte-buffer queue backed by the native impl (pure-Python
+    fallback uses queue.Queue). Payloads are bytes; producers block when
+    full, consumers when empty; close() releases both sides."""
+
+    def __init__(self, capacity: int = 8):
+        self._native = _lib is not None
+        if self._native:
+            self._h = _lib.ptpu_queue_create(int(capacity))
+        else:
+            import queue
+            self._q = queue.Queue(maxsize=capacity)
+            self._closed = threading.Event()
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> bool:
+        if self._native:
+            r = _lib.ptpu_queue_push(self._h, data, len(data), timeout_ms)
+            if r == -1:
+                raise TimeoutError("queue push timed out")
+            return r == 1
+        # fallback: poll in short slices so close() wakes blocked pushers
+        # (matching the native close semantics)
+        import queue as _q
+        deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1e3
+        while True:
+            if self._closed.is_set():
+                return False
+            try:
+                self._q.put(data, timeout=0.05)
+                return True
+            except _q.Full:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("queue push timed out")
+
+    def pop(self, timeout_ms: int = -1) -> Optional[bytes]:
+        """None means closed-and-drained."""
+        if self._native:
+            pdata = ctypes.POINTER(ctypes.c_char)()
+            plen = ctypes.c_int64()
+            r = _lib.ptpu_queue_pop(self._h, ctypes.byref(pdata),
+                                    ctypes.byref(plen), timeout_ms)
+            if r == -1:
+                raise TimeoutError("queue pop timed out")
+            if r == 0:
+                return None
+            out = ctypes.string_at(pdata, plen.value)
+            _lib.ptpu_buffer_free(pdata)
+            return out
+        import queue as _q
+        deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1e3
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except _q.Empty:
+                if self._closed.is_set() and self._q.empty():
+                    return None
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("queue pop timed out")
+
+    def __len__(self):
+        if self._native:
+            return _lib.ptpu_queue_size(self._h)
+        return self._q.qsize()
+
+    def close(self):
+        if self._native:
+            _lib.ptpu_queue_close(self._h)
+        else:
+            self._closed.set()
+
+    def __del__(self):
+        try:
+            if self._native and _lib is not None:
+                _lib.ptpu_queue_destroy(self._h)
+        except Exception:
+            pass
+
+
+# -- arena allocator --------------------------------------------------------
+
+class ArenaAllocator:
+    """Host staging arena with best-fit + coalescing and stats.
+
+    Stats indices: 0=allocated bytes, 1=peak bytes, 2=alloc count,
+    3=free-block count (fragmentation signal).
+    """
+
+    def __init__(self, nbytes: int):
+        if _lib is None:
+            raise RuntimeError("native core unavailable — ArenaAllocator "
+                               "requires the compiled runtime")
+        self._h = _lib.ptpu_arena_create(int(nbytes))
+        if not self._h:
+            raise MemoryError(_lib.ptpu_last_error().decode())
+
+    def alloc(self, nbytes: int) -> int:
+        p = _lib.ptpu_arena_alloc(self._h, int(nbytes))
+        if not p:
+            raise MemoryError(_lib.ptpu_last_error().decode())
+        return p
+
+    def free(self, ptr: int) -> None:
+        if not _lib.ptpu_arena_free(self._h, ptr):
+            raise ValueError(_lib.ptpu_last_error().decode())
+
+    def stat(self, which: int) -> int:
+        return int(_lib.ptpu_arena_stat(self._h, which))
+
+    @property
+    def allocated(self):
+        return self.stat(0)
+
+    @property
+    def peak(self):
+        return self.stat(1)
+
+    def __del__(self):
+        try:
+            if _lib is not None and getattr(self, "_h", None):
+                _lib.ptpu_arena_destroy(self._h)
+        except Exception:
+            pass

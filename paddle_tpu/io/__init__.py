@@ -46,6 +46,10 @@ class IterableDataset(Dataset):
 
 
 class TensorDataset(Dataset):
+    # holds device buffers — DataLoader must not hand these to forked
+    # workers (fork-after-XLA-init deadlock); the threaded path is used
+    _holds_device_arrays = True
+
     def __init__(self, tensors):
         lengths = {t.shape[0] for t in tensors}
         assert len(lengths) == 1, "tensors must share dim 0"
@@ -246,6 +250,64 @@ def get_worker_info():
     return _worker_info[0]
 
 
+def _to_numpy_tree(x):
+    """Tensors → numpy for cross-process pickling (workers must not ship
+    device buffers)."""
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_numpy_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_numpy_tree(v) for k, v in x.items()}
+    return x
+
+
+def _from_numpy_tree(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_from_numpy_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _from_numpy_tree(v) for k, v in x.items()}
+    return x
+
+
+def _dataset_holds_device_arrays(ds, depth=0) -> bool:
+    """Recursively detect device buffers behind dataset wrappers
+    (Subset/ComposeDataset/ChainDataset or anything exposing .dataset(s))."""
+    if depth > 8:
+        return True  # unknown deep nesting — be safe
+    if getattr(ds, "_holds_device_arrays", False):
+        return True
+    for attr in ("dataset", "datasets"):
+        inner = getattr(ds, attr, None)
+        if inner is None:
+            continue
+        if isinstance(inner, (list, tuple)):
+            if any(_dataset_holds_device_arrays(d, depth + 1) for d in inner):
+                return True
+        elif _dataset_holds_device_arrays(inner, depth + 1):
+            return True
+    return False
+
+
+def _numpy_collate_fn(batch):
+    """default_collate_fn that stays in numpy — used inside forked workers,
+    which must never touch XLA."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_numpy_collate_fn(list(items)) for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: _numpy_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
 def default_collate_fn(batch):
     """Stack samples into batched Tensors (reference
     fluid/dataloader/collate.py default_collate_fn)."""
@@ -285,6 +347,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_size = batch_size
@@ -328,6 +392,19 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._gen_batches()
             return
+        # fork workers only when safe AND semantics-preserving: the default
+        # collate (custom collate_fns see Tensors in-process — the threaded
+        # path keeps that contract) and no device buffers reachable from
+        # the dataset (fork-after-XLA-init hazard).
+        if self.use_shared_memory and not self._iterable_ds \
+                and self.batch_sampler is not None \
+                and self.collate_fn is default_collate_fn \
+                and not _dataset_holds_device_arrays(self.dataset):
+            yield from self._iter_multiprocess()
+            return
+        yield from self._iter_threaded()
+
+    def _iter_threaded(self):
         # buffered prefetch on a thread (BufferedReader analog)
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * max(1, self.num_workers))
         sentinel = object()
@@ -352,3 +429,97 @@ class DataLoader:
         t.join()
         if err:
             raise err[0]
+
+    def _iter_multiprocess(self):
+        """True multiprocess workers — the reference's dataloader_iter.py
+        worker pool. Workers pickle collated batches over mp queues; a
+        reader thread pushes them through the NATIVE blocking queue
+        (core/csrc/ptpu_core.cc — the LoDTensorBlockingQueue analog) which
+        provides the bounded prefetch/flow control; the main iterator pops
+        and deserialises in sampler order."""
+        import multiprocessing as mp
+        import pickle
+
+        from ..core import BlockingQueue
+
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        nw = max(1, self.num_workers)
+        in_queues = [ctx.Queue() for _ in range(nw)]
+        out_queue = ctx.Queue(maxsize=self.prefetch_factor * nw)
+
+        def worker_loop(wid, in_q, out_q):
+            _worker_info[0] = _WorkerInfo(wid, nw, self.dataset)
+            if getattr(self, "worker_init_fn", None):
+                self.worker_init_fn(wid)
+            while True:
+                job = in_q.get()
+                if job is None:
+                    break
+                seq, idxs = job
+                try:
+                    # numpy-only in the child: never touch XLA after fork
+                    samples = [_to_numpy_tree(self.dataset[i]) for i in idxs]
+                    batch = _numpy_collate_fn(samples)
+                    payload = pickle.dumps(batch,
+                                           protocol=pickle.HIGHEST_PROTOCOL)
+                    out_q.put((seq, payload, None))
+                except Exception as e:  # noqa: BLE001
+                    out_q.put((seq, None, repr(e)))
+
+        procs = [ctx.Process(target=worker_loop, args=(w, in_queues[w], out_queue),
+                             daemon=True) for w in range(nw)]
+        for p in procs:
+            p.start()
+        for seq, idxs in enumerate(batches):
+            in_queues[seq % nw].put((seq, idxs))
+        for q_ in in_queues:
+            q_.put(None)
+
+        # native bounded buffer: reader thread drains the mp queue into it;
+        # a fixed 9-byte header (seq:int64, err:u8) prefixes the payload so
+        # the already-pickled batch bytes are never re-serialized
+        import struct
+
+        native_q = BlockingQueue(capacity=self.prefetch_factor * nw)
+        n_total = len(batches)
+
+        def reader():
+            for _ in range(n_total):
+                seq, payload, err = out_queue.get()
+                if err is not None:
+                    body = struct.pack("<qB", seq, 1) + err.encode()
+                else:
+                    body = struct.pack("<qB", seq, 0) + payload
+                try:
+                    if not native_q.push(body):
+                        return  # closed by consumer — stop draining
+                except TimeoutError:
+                    return
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+
+        import pickle as pk
+        pending = {}
+        next_seq = 0
+        try:
+            for _ in range(n_total):
+                item = native_q.pop()
+                if item is None:
+                    break
+                seq, is_err = struct.unpack_from("<qB", item)
+                if is_err:
+                    raise RuntimeError(
+                        f"DataLoader worker failed: {item[9:].decode()}")
+                pending[seq] = item[9:]
+                while next_seq in pending:
+                    yield _from_numpy_tree(pk.loads(pending.pop(next_seq)))
+                    next_seq += 1
+        finally:
+            native_q.close()
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+            rt.join(timeout=5)

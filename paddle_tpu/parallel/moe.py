@@ -96,35 +96,51 @@ def moe_param_specs(expert_axis: str = "model") -> Dict[str, P]:
 
 def moe_ffn(params, x, capacity_factor: float = 1.25,
             expert_axis: Optional[str] = "model",
-            compute_dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            compute_dtype=None,
+            groups: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE feed-forward. x (B, S, D) → (y (B, S, D), aux_loss).
 
     The dispatch einsum + expert-sharded compute + combine einsum is the
     dense equivalent of global_scatter → local expert FFN → global_gather
     (reference global_scatter_op.cc:63-80, global_gather_op.cc).
+
+    ``groups``: tokens are gated in G independent groups (GShard's group
+    dim) so dispatch/combine stay (G, Tg, E, C) with C ∝ Tg/E — linear,
+    not quadratic, in total token count. Default: smallest G dividing T
+    with Tg ≤ 4096.
     """
     B, S, D = x.shape
     E = params["router_w"].shape[-1]
     cd = compute_dtype or x.dtype
     T = B * S
-    # top-2 routing → up to 2T assignments; balanced load is 2T/E per expert
-    capacity = max(1, int(2 * capacity_factor * T / E))
+    if groups is None:
+        groups = 1
+        while T // groups > 4096 and T % (groups * 2) == 0:
+            groups *= 2
+    if T % groups != 0:
+        raise ValueError(f"token count {T} not divisible by groups {groups}")
+    Tg = T // groups
+    # top-2 routing → up to 2Tg assignments; balanced load is 2Tg/E per expert
+    capacity = max(1, int(2 * capacity_factor * Tg / E))
 
-    tokens = x.reshape(T, D)
-    logits = tokens.astype(jnp.float32) @ params["router_w"].astype(jnp.float32)
-    dispatch, combine, aux = top2_gating(logits, capacity)
+    tokens = x.reshape(groups, Tg, D)
+    logits = jnp.einsum("gtd,de->gte", tokens.astype(jnp.float32),
+                        params["router_w"].astype(jnp.float32))
+    dispatch, combine, aux = jax.vmap(
+        lambda lg: top2_gating(lg, capacity))(logits)
+    aux = jnp.mean(aux)
 
-    # scatter tokens to (E, C, D) expert buffers — GSPMD AllToAll happens
+    # scatter tokens to (G, E, C, D) expert buffers — GSPMD AllToAll happens
     # here when the expert dim is sharded and tokens are data-sharded
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), tokens)
+    expert_in = jnp.einsum("gtec,gtd->gecd", dispatch.astype(cd), tokens)
     if expert_axis:
-        expert_in = constraint(expert_in, expert_axis, None, None)
+        expert_in = constraint(expert_in, None, expert_axis, None, None)
 
-    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(cd))
+    h = jnp.einsum("gecd,edf->gecf", expert_in, params["w_in"].astype(cd))
     h = jax.nn.gelu(h)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(cd))
+    expert_out = jnp.einsum("gecf,efd->gecd", h, params["w_out"].astype(cd))
     if expert_axis:
-        expert_out = constraint(expert_out, expert_axis, None, None)
+        expert_out = constraint(expert_out, None, expert_axis, None, None)
 
-    y = jnp.einsum("tec,ecd->td", combine.astype(cd), expert_out)
+    y = jnp.einsum("gtec,gecd->gtd", combine.astype(cd), expert_out)
     return y.reshape(B, S, D), aux

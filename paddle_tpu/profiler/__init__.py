@@ -28,6 +28,7 @@ class RecordEvent:
         self.name = name
         self._ann = None
         self._t0 = None
+        self._native_cm = None
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -36,11 +37,21 @@ class RecordEvent:
             self._ann.__enter__()
         except Exception:
             self._ann = None
+        # host-event recorder (native when built, py-fallback otherwise)
+        try:
+            from ..core import record_event as _record_event
+            self._native_cm = _record_event(self.name)
+            self._native_cm.__enter__()
+        except Exception:
+            self._native_cm = None
         return self
 
     def __exit__(self, *exc):
         if self._ann is not None:
             self._ann.__exit__(*exc)
+        if self._native_cm is not None:
+            self._native_cm.__exit__(*exc)
+            self._native_cm = None
         if _active[0]:
             _events[self.name].append(time.perf_counter() - self._t0)
         return False

@@ -1,0 +1,154 @@
+"""Native runtime core (C++ via ctypes) + its integrations."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import (
+    NATIVE_AVAILABLE, ArenaAllocator, BlockingQueue, get_flag,
+    profiler_clear, profiler_dump, profiler_enable, record_event, set_flag,
+    stat_add, stat_get, stat_reset,
+)
+
+
+class TestFlagsStats:
+    def test_flags_roundtrip(self):
+        set_flag("FLAGS_test_xyz", "42")
+        assert get_flag("FLAGS_test_xyz") == "42"
+        assert get_flag("FLAGS_does_not_exist", "dflt") == "dflt"
+
+    def test_stats(self):
+        stat_reset("STAT_test")
+        stat_add("STAT_test", 5)
+        stat_add("STAT_test", 7)
+        assert stat_get("STAT_test") == 12
+        stat_reset("STAT_test")
+        assert stat_get("STAT_test") == 0
+
+
+class TestProfiler:
+    def test_record_and_dump(self):
+        profiler_clear()
+        profiler_enable(True)
+        with record_event("my_kernel"):
+            time.sleep(0.001)
+        trace = profiler_dump()
+        assert "my_kernel" in trace
+        assert "traceEvents" in trace
+        profiler_enable(False)
+        profiler_clear()
+
+    def test_record_event_api_integration(self):
+        """paddle_tpu.profiler.RecordEvent feeds the native recorder."""
+        from paddle_tpu.profiler import RecordEvent
+        profiler_clear()
+        profiler_enable(True)
+        with RecordEvent("layer_fwd"):
+            pass
+        if NATIVE_AVAILABLE:
+            assert "layer_fwd" in profiler_dump()
+        profiler_enable(False)
+        profiler_clear()
+
+
+class TestBlockingQueue:
+    def test_fifo_and_close(self):
+        q = BlockingQueue(4)
+        q.push(b"a")
+        q.push(b"b")
+        assert q.pop() == b"a"
+        assert q.pop() == b"b"
+        q.close()
+        assert q.pop() is None
+
+    def test_blocking_producer_consumer(self):
+        q = BlockingQueue(2)
+        got = []
+
+        def consumer():
+            while True:
+                item = q.pop()
+                if item is None:
+                    return
+                got.append(item)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(20):
+            q.push(str(i).encode())
+        q.close()
+        t.join(timeout=10)
+        assert got == [str(i).encode() for i in range(20)]
+
+    def test_pop_timeout(self):
+        q = BlockingQueue(2)
+        with pytest.raises(TimeoutError):
+            q.pop(timeout_ms=50)
+
+
+@pytest.mark.skipif(not NATIVE_AVAILABLE, reason="native core not built")
+class TestArena:
+    def test_alloc_free_coalesce(self):
+        a = ArenaAllocator(1 << 16)
+        ptrs = [a.alloc(1000) for _ in range(10)]
+        assert a.allocated >= 10 * 1000
+        assert a.peak == a.allocated
+        for p in ptrs:
+            a.free(p)
+        assert a.allocated == 0
+        assert a.stat(3) == 1  # fully coalesced back to one block
+
+    def test_oom_and_double_free(self):
+        a = ArenaAllocator(4096)
+        p = a.alloc(2048)
+        with pytest.raises(MemoryError):
+            a.alloc(1 << 20)
+        a.free(p)
+        with pytest.raises(ValueError):
+            a.free(p)
+
+    def test_best_fit_reuse(self):
+        a = ArenaAllocator(1 << 16)
+        p1 = a.alloc(256)
+        p2 = a.alloc(8192)
+        a.free(p1)
+        p3 = a.alloc(128)  # should land in the small hole
+        assert p3 == p1
+        a.free(p2)
+        a.free(p3)
+
+
+class TestMultiprocessDataLoader:
+    def test_mp_workers_produce_ordered_batches(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class SquareDS(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return np.asarray([i * i], dtype=np.float32)
+
+        dl = DataLoader(SquareDS(), batch_size=4, num_workers=2,
+                        shuffle=False, drop_last=False)
+        out = [np.asarray(b._data).ravel() for b in dl]
+        assert len(out) == 8
+        flat = np.concatenate(out)
+        np.testing.assert_array_equal(flat, np.arange(32.0) ** 2)
+
+    def test_mp_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class BadDS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom")
+                return np.zeros(1, np.float32)
+
+        dl = DataLoader(BadDS(), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(dl)
