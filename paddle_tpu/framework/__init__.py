@@ -12,3 +12,4 @@ from .core import (
     to_tensor,
 )
 from .random import seed, get_rng_state, set_rng_state
+from .checkpoint import CheckpointManager, load_checkpoint, save_checkpoint
