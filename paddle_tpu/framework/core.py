@@ -386,13 +386,30 @@ def _jitted(fn, attrs):
     return j
 
 
+_symbolic_dispatch_hook = [None]
+
+
+def set_symbolic_dispatch(fn):
+    """Install the static-mode recorder (paddle_tpu.static.graph): called
+    with (fn, args, attrs, op_name); returns NotImplemented to fall
+    through to eager execution."""
+    _symbolic_dispatch_hook[0] = fn
+
+
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
     """Run pure function ``fn(*arrays, **attrs)`` on Tensor/array args.
 
     Records a GradNode when grad is enabled, we are not inside a jax trace,
     and at least one input requires grad. Returns Tensor (or tuple of
-    Tensors mirroring fn's output structure).
+    Tensors mirroring fn's output structure). When static mode has
+    installed a symbolic dispatcher and an arg is symbolic, the op is
+    recorded into the active Program instead of executed.
     """
+    hook = _symbolic_dispatch_hook[0]
+    if hook is not None:
+        res = hook(fn, args, attrs, op_name)
+        if res is not NotImplemented:
+            return res
     arrays = tuple(_unwrap(a) for a in args)
     tracing = any(_is_tracer(a) for a in arrays)
     input_tensors = tuple(a if isinstance(a, Tensor) else None for a in args)

@@ -137,6 +137,16 @@ class Optimizer:
 
     # -- API parity ----------------------------------------------------------
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import SymbolicTensor, default_main_program
+
+        if isinstance(loss, SymbolicTensor):
+            # static mode: register a training directive on the program that
+            # OWNS the loss (the reference's optimizer appends grad+update
+            # ops to that ProgramDesc; Executor.run performs them per call)
+            prog = getattr(getattr(loss._expr, "op", None), "program", None) \
+                or default_main_program()
+            prog.train_specs.append((self, loss))
+            return None, []
         backward(loss)
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]

@@ -1,72 +1,85 @@
-"""paddle_tpu.static — traced "static graph" mode.
+"""paddle_tpu.static — the static-graph world.
 
-The reference's static world (ProgramDesc + Executor,
-framework.py:4393 Program / executor.py:1065 Executor.run) is replaced by
-jax tracing: a Program here is a captured python callable + InputSpecs that
-compiles to one XLA module. ``Executor.run(feed/fetch)`` keeps the
-reference's call signature over that.
+Parity: the reference's Program/Executor stack (framework.py:4393
+``Program``, executor.py:1065 ``Executor.run``, backward.py:1406
+``append_backward``, optimizer minimize on programs). TPU-native design:
+user code builds the graph by calling ordinary ops on symbolic placeholders
+(static/graph.py records through the SAME apply_op funnel eager mode uses),
+and ``Executor.run`` replays the recorded DAG as ONE jitted XLA program per
+(fetch set, feed shapes) — the ProgramDesc interpreter loop (reference
+executor.cc:490 op-by-op hot loop) collapses into a single compiled module.
 
-This module provides the user-facing shims; the real machinery lives in
-paddle_tpu.jit.
+Typical reference workflow that runs unchanged::
+
+    paddle.enable_static()
+    x = paddle.static.data("x", [-1, 784])
+    y = paddle.static.data("y", [-1, 1], dtype="int64")
+    logits = my_layer(x)                    # any eager layers/ops
+    loss = F.cross_entropy(logits, y)
+    opt = paddle.optimizer.SGD(0.01, parameters=my_layer.parameters())
+    opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    loss_val, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
 """
 from __future__ import annotations
 
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
-from ..framework.core import Tensor
+from ..framework.core import Parameter, Tensor
 from ..jit import InputSpec  # noqa: F401
+from .graph import (  # noqa: F401
+    OpRecord, SymbolicTensor, SymExpr, collect_leaves, evaluate_exprs,
+)
 
 __all__ = [
     "InputSpec", "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "CompiledProgram",
     "name_scope", "device_guard", "py_func", "save_inference_model",
-    "load_inference_model", "gradients",
+    "load_inference_model", "gradients", "append_backward",
 ]
 
 _static_mode = [False]
 
 
-class Variable:
-    """Symbolic placeholder in a static Program."""
-
-    def __init__(self, name, shape, dtype):
-        self.name = name
-        self.shape = list(shape)
-        self.dtype = dtype
-
-    def __repr__(self):
-        return f"Var({self.name}, shape={self.shape}, dtype={self.dtype})"
-
-
 class Program:
-    """A deferred computation: feeds -> fetches via a traced callable.
-
-    Build with program_guard + paddle_tpu.static.data + a builder function
-    registered via ``set_forward`` — or (typical migration path) skip static
-    mode entirely and use paddle_tpu.jit.to_static.
-    """
+    """A recorded op DAG + feed placeholders + training directives."""
 
     def __init__(self):
-        self.feed_vars: Dict[str, Variable] = {}
-        self.fetch_builders = []
-        self._forward = None
+        self.feed_vars: Dict[str, SymbolicTensor] = {}
+        self.ops: List[OpRecord] = []
+        self.train_specs: List[tuple] = []   # (optimizer, loss SymbolicTensor)
         self.random_seed = None
 
     def global_block(self):
         return self
 
-    def set_forward(self, fn):
-        self._forward = fn
-        return fn
+    @property
+    def blocks(self):
+        return [self]
+
+    def all_parameters(self):
+        exprs = [t._expr for t in self.feed_vars.values()]
+        exprs += [loss._expr for _, loss in self.train_specs]
+        _, tensors = collect_leaves(
+            [SymExpr("op", op=op, index=0) for op in self.ops] + exprs)
+        return [t for t in tensors if isinstance(t, Parameter)]
 
     def clone(self, for_test=False):
-        import copy
+        p = Program()
+        p.feed_vars = dict(self.feed_vars)
+        p.ops = list(self.ops)
+        p.train_specs = [] if for_test else list(self.train_specs)
+        p.random_seed = self.random_seed
+        return p
 
-        return copy.copy(self)
+    def __repr__(self):
+        return (f"Program(feeds={list(self.feed_vars)}, ops={len(self.ops)}, "
+                f"train_specs={len(self.train_specs)})")
 
 
 _default_main = [Program()]
@@ -79,6 +92,11 @@ def default_main_program():
 
 def default_startup_program():
     return _default_startup[0]
+
+
+def _on_op_recorded(rec: OpRecord):
+    rec.program = _default_main[0]
+    _default_main[0].ops.append(rec)
 
 
 @contextmanager
@@ -94,9 +112,18 @@ def program_guard(main_program, startup_program=None):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    v = Variable(name, shape, dtype)
-    default_main_program().feed_vars[name] = v
-    return v
+    """Feed placeholder (reference paddle.static.data). dim -1/None means
+    runtime-determined (shown as 1 in build-time shape inference; the
+    executor retraces per concrete shape)."""
+    from ..framework import dtype as dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+    shape = tuple(1 if (s is None or int(s) < 0) else int(s) for s in shape)
+    aval = jax.ShapeDtypeStruct(shape, dt)
+    t = SymbolicTensor(SymExpr("feed", name=name, aval=aval), aval)
+    t.name = name
+    default_main_program().feed_vars[name] = t
+    return t
 
 
 @contextmanager
@@ -106,16 +133,55 @@ def name_scope(prefix):
 
 @contextmanager
 def device_guard(device=None):
-    """Pipeline-stage placement hint (reference framework.py device_guard).
-
-    In the TPU build, stage placement is declared via PipelineLayer /
-    mesh shardings; this context is accepted and recorded as a no-op hint.
-    """
+    """Pipeline-stage placement hint (reference framework.py device_guard);
+    stage placement in the TPU build is declared via mesh shardings, so
+    this is accepted and ignored."""
     yield
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     raise NotImplementedError("py_func: wrap python code with jax.pure_callback instead")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Static autodiff (reference backward.py:1406). Returns
+    [(param, grad_symbol)] — grads become fetchable symbols."""
+    if not isinstance(loss, SymbolicTensor):
+        raise TypeError("append_backward expects a symbolic loss")
+    params = parameter_list or _params_for(loss)
+    grad_op = OpRecord(_GradFn(loss, params), [loss._expr], {}, "grad")
+    grad_op.n_outputs = len(params)
+    out = []
+    for i, p in enumerate(params):
+        aval = jax.ShapeDtypeStruct(tuple(p._data.shape), p._data.dtype)
+        g = SymbolicTensor(SymExpr("op", op=grad_op, index=i, aval=aval), aval)
+        g.name = (p.name or f"param{i}") + "@GRAD"
+        out.append((p, g))
+    return out
+
+
+class _GradFn:
+    """Env-aware op body: dloss/dparams by replaying the loss subgraph
+    under jax.grad with the params as traced inputs (XLA CSEs the
+    duplicated forward away inside the one jitted replay)."""
+
+    __name__ = "grad"
+
+    def __init__(self, loss, params):
+        self.loss_expr = loss._expr
+        self.params = params
+
+    def evaluate_with_env(self, feed_env, tensor_env):
+        from .graph import grad_of_loss
+
+        return grad_of_loss(self.loss_expr, self.params, feed_env, tensor_env)
+
+
+def _params_for(loss: SymbolicTensor):
+    _, tensors = collect_leaves([loss._expr])
+    return [t for t in tensors
+            if isinstance(t, Parameter) and getattr(t, "trainable", True)
+            and not t.stop_gradient]
 
 
 class CompiledProgram:
@@ -124,45 +190,221 @@ class CompiledProgram:
         self.build_strategy = build_strategy
 
 
+class ParallelEnv:
+    pass
+
+
 class Executor:
-    """exe.run(feed/fetch) shim over jit (reference executor.py:607)."""
+    """Replays recorded programs as jitted XLA modules
+    (reference executor.py:607 Executor / :1065 run)."""
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
+        self._cache: Dict[tuple, Any] = {}
+
+    # -- internals ----------------------------------------------------------
+
+    def _exec_fetches(self, fetch_exprs, feed_arrays, grads_of=None):
+        """One jitted call: fetch values (+ optional grads wrt params).
+
+        Returns (fetch_values, grads, params) where grads aligns with
+        params (None when grads_of is None)."""
+        from .graph import grad_of_loss
+
+        feeds_needed, tensors = collect_leaves(fetch_exprs)
+        # differentiate only trainable, unfrozen Parameters; frozen ones
+        # ride along as plain captured tensors
+        params = [t for t in tensors
+                  if isinstance(t, Parameter) and not t.stop_gradient
+                  and getattr(t, "trainable", True)]
+        param_ids = {id(p) for p in params}
+        other = [t for t in tensors if id(t) not in param_ids]
+        key = (tuple((id(e.op), e.index) if e.kind == "op"
+                     else (e.kind, e.name, id(e.tensor))
+                     for e in fetch_exprs),
+               tuple((k, tuple(np.shape(v))) for k, v in sorted(feed_arrays.items())),
+               grads_of is not None)
+        fn = self._cache.get(key)
+        if fn is None:
+            loss_expr = grads_of
+
+            def pure(param_arrays, other_arrays, feed_env):
+                tensor_env = {id(t): a for t, a in zip(params, param_arrays)}
+                tensor_env.update({id(t): a for t, a in zip(other, other_arrays)})
+                if loss_expr is not None:
+                    grads = grad_of_loss(loss_expr, params, feed_env, tensor_env)
+                else:
+                    grads = None
+                vals = evaluate_exprs(fetch_exprs, feed_env, tensor_env)
+                return vals, grads
+
+            fn = jax.jit(pure)
+            self._cache[key] = fn
+        param_arrays = [p._data for p in params]
+        other_arrays = [t._data for t in other]
+        vals, grads = fn(param_arrays, other_arrays, feed_arrays)
+        return vals, grads, params
+
+    # -- public -------------------------------------------------------------
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
-        program = program or default_main_program()
+        program = program if program is not None else default_main_program()
         if isinstance(program, CompiledProgram):
             program = program.program
-        if program._forward is None:
-            # startup program: nothing to execute (params init eagerly)
-            return []
+        if not isinstance(program, Program):
+            raise TypeError(f"cannot run {type(program)}")
+        if not program.ops and not program.train_specs and not fetch_list:
+            return []  # startup program: params initialize eagerly
+
         feed = feed or {}
-        arrays = {k: (v._data if isinstance(v, Tensor) else np.asarray(v)) for k, v in feed.items()}
-        fn = self._cache.get(id(program))
-        if fn is None:
-            fn = jax.jit(program._forward)
-            self._cache[id(program)] = fn
-        outs = fn(**arrays)
-        if not isinstance(outs, (list, tuple)):
-            outs = [outs]
+        feed_arrays = {
+            k: (v._data if isinstance(v, Tensor) else np.asarray(v))
+            for k, v in feed.items()
+        }
+        fetch_list = fetch_list or []
+        fetch_exprs = []
+        for f in fetch_list:
+            if isinstance(f, SymbolicTensor):
+                fetch_exprs.append(f._expr)
+            elif isinstance(f, str) and f in program.feed_vars:
+                fetch_exprs.append(program.feed_vars[f]._expr)
+            else:
+                raise TypeError(f"cannot fetch {f!r}")
+
+        # training directives run like the reference's optimizer ops at the
+        # end of the program: grads of pre-update params, then update.
+        # Multiple minimize() calls (e.g. GAN d/g) run sequentially, each
+        # seeing the previous spec's updates; fetches evaluate with the
+        # FIRST spec (pre-any-update), matching op order in the reference.
+        fetch_vals = None
+        for optimizer, loss in program.train_specs:
+            want = fetch_exprs if fetch_vals is None else []
+            vals, grads, params = self._exec_fetches(
+                want + [loss._expr], feed_arrays, grads_of=loss._expr)
+            if fetch_vals is None:
+                fetch_vals = vals[:-1]
+            grad_of = {id(p): g for p, g in zip(params, grads)}
+            if optimizer._parameter_list is None:
+                optimizer._parameter_list = list(params)
+            for p in optimizer._parameter_list:
+                if id(p) in grad_of:
+                    p.grad = Tensor(grad_of[id(p)])
+            optimizer.step()
+            optimizer.clear_grad()
+        if program.train_specs:
+            if return_numpy:
+                return [np.asarray(v) for v in fetch_vals]
+            return [Tensor(v) for v in fetch_vals]
+
+        if not fetch_exprs:
+            return []
+        vals, _, _ = self._exec_fetches(fetch_exprs, feed_arrays)
         if return_numpy:
-            return [np.asarray(o) for o in outs]
-        return [Tensor(o) for o in outs]
+            return [np.asarray(v) for v in vals]
+        return [Tensor(v) for v in vals]
 
     def close(self):
         pass
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    """Serialize the inference graph: params + a replayable closure
+    (reference fluid/io.py save_inference_model)."""
+    import pickle
+
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    exprs = [t._expr for t in fetch_vars]
+    feeds, tensors = collect_leaves(exprs)
+    state = {f"__t{i}": np.asarray(t._data) for i, t in enumerate(tensors)}
+    meta = {
+        "feed_names": [t.name for t in feed_vars],
+        "state": state,
+    }
     from ..framework.io import save as _save
 
-    _save({"feed": feed_vars, "fetch": fetch_vars}, path_prefix + ".pdmodel.meta")
+    _save(meta, path_prefix + ".pdiparams")
+    # cloudpickle: op bodies are often closures/partials a plain pickle
+    # cannot carry (the reference serializes a ProgramDesc proto instead;
+    # our "program" IS the python closure DAG)
+    import cloudpickle
+
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        cloudpickle.dump(_ExportedProgram(exprs, tensors), f)
+
+
+class _ExportedProgram:
+    """Pickled closure of the fetch DAG; tensors are re-bound on load."""
+
+    def __init__(self, exprs, tensors):
+        # replace tensor leaves with indices for pickling
+        self.n_tensors = len(tensors)
+        idx = {id(t): i for i, t in enumerate(tensors)}
+        self.exprs = [_strip(e, idx) for e in exprs]
+
+    def bind(self, arrays):
+        return [_rebind(e, arrays) for e in self.exprs]
+
+
+def _strip(e, idx, memo=None):
+    # memo keyed by id(OpRecord): sibling outputs of a multi-output op must
+    # reference the SAME op tuple so pickling (and _rebind's dedup)
+    # preserves the sharing and the op executes once after load
+    memo = memo if memo is not None else {}
+    if not isinstance(e, SymExpr):
+        return e
+    if e.kind == "tensor":
+        return ("__tensor__", idx[id(e.tensor)])
+    if e.kind == "feed":
+        return ("__feed__", e.name)
+    if id(e.op) not in memo:
+        memo[id(e.op)] = ("__op__", e.op.fn,
+                          tuple(_strip(a, idx, memo) for a in e.op.args),
+                          tuple(sorted(e.op.attrs.items())), e.op.n_outputs)
+    return ("__out__", memo[id(e.op)], e.index)
+
+
+def _rebind(e, arrays, memo=None, op_memo=None):
+    memo = memo if memo is not None else {}
+    op_memo = op_memo if op_memo is not None else {}
+    if not isinstance(e, tuple) or not e or not isinstance(e[0], str):
+        return e
+    if e[0] == "__tensor__":
+        return SymExpr("tensor", tensor=Tensor(arrays[e[1]]))
+    if e[0] == "__feed__":
+        return SymExpr("feed", name=e[1])
+    if e[0] == "__out__":
+        _, op_t, index = e
+        key = id(op_t)
+        if key not in op_memo:
+            _, fn, args, attrs, n_out = op_t
+            rec = OpRecord(fn, [ _rebind(a, arrays, memo, op_memo) for a in args],
+                           dict(attrs), getattr(fn, "__name__", "op"))
+            rec.n_outputs = n_out
+            op_memo[key] = rec
+        return SymExpr("op", op=op_memo[key], index=index)
+    return e
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit.load")
+    """Returns (program, feed_names, fetch_symbols) runnable via
+    Executor.run."""
+    import pickle
+
+    from ..framework.io import load as _load
+
+    meta = _load(path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = pickle.load(f)
+    arrays = [np.asarray(meta["state"][f"__t{i}"])
+              for i in range(exported.n_tensors)]
+    exprs = exported.bind(arrays)
+    prog = Program()
+    fetches = [SymbolicTensor(e, None) for e in exprs]
+    return prog, meta["feed_names"], fetches
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -1,0 +1,220 @@
+"""Symbolic graph capture for static mode.
+
+The reference's static world records ops into a ProgramDesc as user code
+calls layer functions on symbolic Variables (reference framework.py:3222
+Block.append_op via layer helpers). Here the SAME op funnel the eager mode
+uses (framework/core.py apply_op) records into the current Program when any
+input is symbolic: an op node keeps the pure jax function + its symbolic/
+literal args, and execution later REPLAYS the recorded DAG inside one
+jax.jit — so "building a program" and "tracing for XLA" are the same
+mechanism, and every eager op is automatically available in static mode
+(the reference needed a separate wrapper per op in fluid/layers).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, set_symbolic_dispatch
+
+__all__ = ["SymbolicTensor", "OpRecord", "SymExpr", "evaluate_exprs",
+           "collect_leaves"]
+
+
+class OpRecord:
+    """One recorded op: pure fn + (symbolic|literal) args + attrs.
+    ``program`` is set by the Program that recorded it, so later calls
+    (e.g. optimizer.minimize outside the program_guard) can find the
+    owning program."""
+
+    __slots__ = ("fn", "args", "attrs", "name", "n_outputs", "program")
+
+    def __init__(self, fn, args, attrs, name):
+        self.fn = fn
+        self.args = args          # SymExpr | Tensor | literal per position
+        self.attrs = attrs
+        self.name = name
+        self.n_outputs = 1
+        self.program = None
+
+
+class SymExpr:
+    """A value in the symbolic graph.
+
+    kind: "feed" (runtime placeholder), "tensor" (captured eager Tensor —
+    typically a Parameter; evaluated to its CURRENT value at run time),
+    "op" (output ``index`` of an OpRecord).
+    """
+
+    __slots__ = ("kind", "name", "tensor", "op", "index", "aval")
+
+    def __init__(self, kind, name=None, tensor=None, op=None, index=0,
+                 aval=None):
+        self.kind = kind
+        self.name = name
+        self.tensor = tensor
+        self.op = op
+        self.index = index
+        self.aval = aval
+
+
+class SymbolicTensor(Tensor):
+    """Tensor whose ``_data`` is an abstract aval; carries the SymExpr."""
+
+    __slots__ = ("_expr",)
+
+    def __init__(self, expr: SymExpr, aval: jax.ShapeDtypeStruct):
+        # bypass Tensor.__init__'s jnp.asarray
+        self._data = aval
+        self.stop_gradient = True
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = expr.name
+        self.persistable = False
+        self.sharding = None
+        self._expr = expr
+
+    def numpy(self):
+        raise RuntimeError(
+            "SymbolicTensor has no value at build time — fetch it through "
+            "Executor.run(fetch_list=[...])")
+
+    def __repr__(self):
+        return (f"SymbolicTensor(name={self.name}, shape={list(self._data.shape)}, "
+                f"dtype={self._data.dtype})")
+
+
+def _fake_aval(x):
+    if isinstance(x, SymbolicTensor):
+        return x._data
+    if isinstance(x, Tensor):
+        return jax.ShapeDtypeStruct(tuple(x._data.shape), x._data.dtype)
+    return x
+
+
+def _symbolic_dispatch(fn, args, attrs, op_name):
+    """Installed into framework.core.apply_op: record instead of execute
+    when any arg is symbolic."""
+    if not any(isinstance(a, SymbolicTensor) for a in args):
+        return NotImplemented
+
+    rec_args = []
+    for a in args:
+        if isinstance(a, SymbolicTensor):
+            rec_args.append(a._expr)
+        elif isinstance(a, Tensor):
+            rec_args.append(SymExpr("tensor", tensor=a))
+        else:
+            rec_args.append(a)
+    rec = OpRecord(fn, rec_args, attrs, op_name or getattr(fn, "__name__", "op"))
+
+    # shape/dtype inference via eval_shape on the abstract inputs
+    avals = [_fake_aval(a) for a in args]
+
+    def shaped(*xs):
+        return fn(*xs, **attrs)
+
+    out_aval = jax.eval_shape(shaped, *avals)
+    multi = isinstance(out_aval, (tuple, list))
+    outs = tuple(out_aval) if multi else (out_aval,)
+    rec.n_outputs = len(outs)
+    result = [SymbolicTensor(SymExpr("op", op=rec, index=i, aval=o), o)
+              for i, o in enumerate(outs)]
+    # register into the active program, if one is listening
+    from . import _on_op_recorded
+
+    _on_op_recorded(rec)
+    return tuple(result) if multi else result[0]
+
+
+set_symbolic_dispatch(_symbolic_dispatch)
+
+
+# -- evaluation -------------------------------------------------------------
+
+def collect_leaves(exprs: List[SymExpr]):
+    """Return (feed_names, tensor_leaves) reachable from exprs; tensor
+    leaves are the captured eager Tensors (Parameters etc.), deduped by id,
+    in deterministic discovery order."""
+    feeds: List[str] = []
+    tensors: List[Tensor] = []
+    seen_ops = set()
+    seen_feed = set()
+    seen_t = set()
+
+    def walk(e):
+        if not isinstance(e, SymExpr):
+            return
+        if e.kind == "feed":
+            if e.name not in seen_feed:
+                seen_feed.add(e.name)
+                feeds.append(e.name)
+        elif e.kind == "tensor":
+            if id(e.tensor) not in seen_t:
+                seen_t.add(id(e.tensor))
+                tensors.append(e.tensor)
+        elif e.kind == "op":
+            if id(e.op) in seen_ops:
+                return
+            seen_ops.add(id(e.op))
+            for a in e.op.args:
+                walk(a)
+
+    for e in exprs:
+        walk(e)
+    return feeds, tensors
+
+
+def grad_of_loss(loss_expr: SymExpr, params, feed_env: Dict[str, Any],
+                 tensor_env: Dict[int, Any]):
+    """dloss/dparams by replaying the loss subgraph under jax.grad with the
+    params as traced inputs (shared by append_backward's grad op and the
+    Executor train path; XLA CSEs the duplicated forward inside one jit)."""
+    base = [tensor_env.get(id(p), p._data) for p in params]
+
+    def loss_fn(param_arrays):
+        te = dict(tensor_env)
+        te.update({id(p): a for p, a in zip(params, param_arrays)})
+        (lv,) = evaluate_exprs([loss_expr], feed_env, te)
+        return lv.astype(jnp.float32) if lv.dtype != jnp.float32 else lv
+
+    return tuple(jax.grad(loss_fn)(base))
+
+
+def evaluate_exprs(exprs: List[SymExpr], feed_env: Dict[str, Any],
+                   tensor_env: Optional[Dict[int, Any]] = None):
+    """Replay the DAG; returns the list of values for ``exprs``.
+
+    ``tensor_env`` maps id(tensor) → array, letting the caller substitute
+    traced values for captured Parameters (how grads are taken)."""
+    tensor_env = tensor_env or {}
+    memo: Dict[int, Any] = {}
+
+    def ev(e):
+        if not isinstance(e, SymExpr):
+            return e
+        if e.kind == "feed":
+            try:
+                return feed_env[e.name]
+            except KeyError:
+                raise KeyError(f"missing feed for placeholder '{e.name}'")
+        if e.kind == "tensor":
+            if id(e.tensor) in tensor_env:
+                return tensor_env[id(e.tensor)]
+            return e.tensor._data
+        # op
+        if id(e.op) not in memo:
+            if hasattr(e.op.fn, "evaluate_with_env"):
+                # env-aware ops (static grad op): need the full replay
+                # context, not just materialized args
+                out = e.op.fn.evaluate_with_env(feed_env, tensor_env)
+            else:
+                argvals = [ev(a) for a in e.op.args]
+                out = e.op.fn(*argvals, **e.op.attrs)
+            memo[id(e.op)] = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        return memo[id(e.op)][e.index]
+
+    return [ev(e) for e in exprs]
