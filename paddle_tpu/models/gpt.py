@@ -205,7 +205,12 @@ def _block_stack(cfg: GPTConfig, blocks, x):
     """lax.scan over the leading layer dim — one compiled body."""
     body = _block
     if cfg.remat:
-        body = jax.checkpoint(body, static_argnums=(0,))
+        # keep non-batch matmul results (weights-only dots), recompute the
+        # rest: measured equal to full remat at batch 16 and ~10% faster at
+        # batch 32 on v5e (BERT-base)
+        body = jax.checkpoint(
+            body, static_argnums=(0,),
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
     def step(h, layer_p):
         return body(cfg, layer_p, h), None
@@ -220,11 +225,16 @@ def _embed(cfg: GPTConfig, params, tokens):
     return emb + pos[None, :, :]
 
 
-def _head(cfg: GPTConfig, params, x):
-    x = _layer_norm(x, params["lnf_s"], params["lnf_b"])
-    # tied head — fp32 logits for a stable softmax
+def _logits(params, x):
+    # tied head — fp32 logits for a stable softmax (single source of truth:
+    # used by gpt_forward, gpt_loss, and the chunked CE)
     return jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
                       params["wte"].astype(jnp.float32))
+
+
+def _head(cfg: GPTConfig, params, x):
+    x = _layer_norm(x, params["lnf_s"], params["lnf_b"])
+    return _logits(params, x)
 
 
 def gpt_forward(cfg: GPTConfig, params, tokens):
@@ -258,8 +268,35 @@ def _pipeline_hidden(cfg: GPTConfig, params, tokens, n_micro):
     return h.reshape(B, S, cfg.hidden)
 
 
-def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1):
-    """Causal-LM cross entropy. batch = (tokens, labels), both (B, S)."""
+def _chunked_ce(params, x, labels, chunk: int):
+    """Cross entropy without materializing (B, S, V) logits: the final LN'd
+    hiddens are processed in sequence chunks; each chunk's logits live only
+    inside its scan iteration (remat'd), so peak memory is (B, chunk, V) —
+    the (B,S,V) fp32 logits buffer (~1GB at BERT-base/batch16) never
+    exists. HBM-bound loss → big memory headroom for larger batch."""
+    B, S, H = x.shape
+    n_chunks = S // chunk
+    xc = x.reshape(B, n_chunks, chunk, H).transpose(1, 0, 2, 3)
+    lc = labels.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def one(args):
+        xs, ls = args
+        logp = jax.nn.log_softmax(_logits(params, xs), axis=-1)
+        return -jnp.sum(jnp.take_along_axis(logp, ls[..., None], axis=-1))
+
+    total = jnp.sum(jax.lax.map(one, (xc, lc)))
+    return total / (B * S)
+
+
+def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1,
+             loss_chunk: Optional[int] = None):
+    """Causal-LM cross entropy. batch = (tokens, labels), both (B, S).
+
+    ``loss_chunk``: sequence-chunked CE — peak-memory saver for huge vocab
+    or long seq (full (B,S,V) fp32 logits never materialize); measured
+    ~10% slower than the fused full-logits path at BERT-base scale, so off
+    by default."""
     tokens, labels = batch
     if cfg.n_stages > 1:
         if n_micro < cfg.n_stages:
@@ -267,9 +304,17 @@ def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1):
                 f"n_micro={n_micro} must be >= n_stages={cfg.n_stages} "
                 "(fewer microbatches than stages leaves the pipeline idle)")
         x = _pipeline_hidden(cfg, params, tokens, n_micro)
-        logits = _head(cfg, params, x)
     else:
-        logits = gpt_forward(cfg, params, tokens)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+        x = _embed(cfg, params, tokens)
+        x = _block_stack(cfg, params["blocks"], x)
+    x = _layer_norm(x, params["lnf_s"], params["lnf_b"])
+    if loss_chunk and tokens.shape[1] > loss_chunk:
+        if tokens.shape[1] % loss_chunk != 0:
+            raise ValueError(
+                f"loss_chunk={loss_chunk} must divide seq_len="
+                f"{tokens.shape[1]} (the memory saver would otherwise be "
+                "silently disabled)")
+        return _chunked_ce(params, x, labels, loss_chunk)
+    logp = jax.nn.log_softmax(_logits(params, x), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
