@@ -61,8 +61,18 @@ def init_parallel_env(mesh_shape=None, axis_names=None):
 
     Reference (parallel.py:69) bootstraps NCCL rings over TCP; here we build
     the global device mesh. Default: 1-D "data" mesh over all local devices.
-    Multi-host: call jax.distributed.initialize first (launcher does this).
+    Multi-host: the launcher exports JAX_COORDINATOR_ADDRESS/JAX_PROCESS_ID
+    and jax.distributed.initialize is called here before touching devices.
     """
+    n_procs = int(os.environ.get("JAX_NUM_PROCESSES", 1))
+    if n_procs > 1 and not _state["initialized"]:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+                num_processes=n_procs,
+                process_id=int(os.environ.get("JAX_PROCESS_ID", 0)))
+        except RuntimeError:
+            pass  # already initialized
     devs = np.array(_devices())
     if mesh_shape is None:
         mesh_shape = (len(devs),)
@@ -74,6 +84,9 @@ def init_parallel_env(mesh_shape=None, axis_names=None):
         "rank": jax.process_index(),
         "world_size": max(jax.process_count(), 1),
     })
+    from ..parallel.mesh import set_mesh
+
+    set_mesh(mesh)
     return ParallelEnv()
 
 

@@ -49,6 +49,9 @@ class Fleet:
                 f"hybrid_configs needs {n_needed} devices, have {len(devs)}")
         devs = devs[:n_needed].reshape(dp, pp, sh, mp)
         self._mesh = jax.sharding.Mesh(devs, ("data", "pipe", "sharding", "model"))
+        from ....parallel.mesh import set_mesh
+
+        set_mesh(self._mesh)
         self._topology = CommunicateTopology(("data", "pipe", "sharding", "model"),
                                              (dp, pp, sh, mp))
         self._hcg = HybridCommunicateGroup(self._topology, env.get_rank())

@@ -1,0 +1,162 @@
+"""Distributed launcher — ``python -m paddle_tpu.distributed.launch``.
+
+Parity: reference fleet launcher (python/paddle/distributed/fleet/
+launch.py:250 launch_collective — builds a Cluster/Pod, spawns one worker
+process per device with PADDLE_* env, watches children, aborts the pod on
+failure) and the elastic relaunch loop (fleet/elastic/manager.py:103).
+
+TPU-native process model: ONE worker process per HOST drives all local
+chips (the reference's one-proc-per-GPU maps to jax's one-proc-per-host);
+``--nproc_per_node`` exists for CPU rehearsal and multi-host emulation.
+Workers get the jax.distributed coordinator env (the TCP bootstrap that
+replaces the reference's gen_comm_id_helper NCCL-id rendezvous) plus the
+PADDLE_* variables reference role-makers read. ``--elastic`` enables
+supervised restarts: a failed worker pod is relaunched up to
+``--max_restarts`` times, picking up from the newest checkpoint (see
+framework/checkpoint.py CheckpointManager.restore_latest).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main", "get_cluster_env", "wait_pod"]
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def get_cluster_env(rank: int, nproc: int, coordinator: str,
+                    endpoints: List[str]) -> dict:
+    """Env block for one worker (reference launch_utils.py pod env)."""
+    env = dict(os.environ)
+    env.update({
+        # reference PaddleCloudRoleMaker reads these (role_maker.py:692)
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nproc),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        # jax.distributed bootstrap (replaces NCCL-id TCP rendezvous)
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(nproc),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    return env
+
+
+class Pod:
+    """Local worker group (reference launch_utils.py:144 Pod)."""
+
+    def __init__(self, procs: List[subprocess.Popen], log_files: List[str]):
+        self.procs = procs
+        self.log_files = log_files
+
+    def poll(self) -> Optional[int]:
+        """None while all alive; else the first non-zero exit code (0 when
+        all exited cleanly)."""
+        codes = [p.poll() for p in self.procs]
+        if any(c is None for c in codes):
+            for c in codes:
+                if c not in (None, 0):
+                    return c  # fail fast while others still run
+            return None
+        bad = [c for c in codes if c != 0]
+        return bad[0] if bad else 0
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def start_pod(script: List[str], nproc: int, log_dir: Optional[str] = None) -> Pod:
+    """Spawn nproc workers with cluster env (reference
+    start_local_trainers)."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
+    procs, logs = [], []
+    for rank in range(nproc):
+        env = get_cluster_env(rank, nproc, coordinator, endpoints)
+        stdout = None
+        log_path = ""
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"workerlog.{rank}")
+            stdout = open(log_path, "w")
+        p = subprocess.Popen([sys.executable] + script, env=env,
+                             stdout=stdout,
+                             stderr=subprocess.STDOUT if stdout else None)
+        procs.append(p)
+        logs.append(log_path)
+    return Pod(procs, logs)
+
+
+def wait_pod(pod: Pod, poll_interval: float = 0.5) -> int:
+    """Watch children; abort the pod when any worker fails (reference
+    launch_utils.py watch_local_trainers)."""
+    while True:
+        code = pod.poll()
+        if code is None:
+            time.sleep(poll_interval)
+            continue
+        if code != 0:
+            pod.terminate()
+        return code
+
+
+def launch(script: List[str], nproc: int = 1, log_dir: Optional[str] = None,
+           elastic: bool = False, max_restarts: int = 3,
+           poll_interval: float = 0.5) -> int:
+    """Run the pod (optionally under elastic supervision). Returns the
+    final exit code."""
+    restarts = 0
+    while True:
+        pod = start_pod(script, nproc, log_dir)
+        code = wait_pod(pod, poll_interval)
+        if code == 0:
+            return 0
+        if not elastic or restarts >= max_restarts:
+            return code
+        restarts += 1
+        sys.stderr.write(
+            f"[paddle_tpu.launch] pod failed (exit {code}); elastic restart "
+            f"{restarts}/{max_restarts}\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher")
+    ap.add_argument("--nproc_per_node", type=int, default=1,
+                    help="worker processes on this host (TPU: usually 1 — "
+                         "one process drives all local chips)")
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--elastic", action="store_true",
+                    help="supervised restarts on worker failure")
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("script", help="training script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    return launch([args.script] + args.script_args,
+                  nproc=args.nproc_per_node, log_dir=args.log_dir,
+                  elastic=args.elastic, max_restarts=args.max_restarts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
