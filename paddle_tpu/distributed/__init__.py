@@ -10,8 +10,8 @@ from .env import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, all_reduce, reduce, broadcast,
-    all_gather, scatter, alltoall, send, recv, barrier, wait,
-    destroy_process_group, split,
+    all_gather, scatter, alltoall, send, recv, sendrecv, barrier, wait,
+    destroy_process_group, split, psum, pmax, pmin, pmean,
 )
 from .parallel import DataParallel  # noqa: F401
 from .sharding_utils import P, shard_constraint, named_sharding, current_mesh  # noqa: F401

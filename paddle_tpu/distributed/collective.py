@@ -10,26 +10,32 @@ a ring_id. Collectives have two execution regimes:
 1. **Traced** (inside shard_map over the global mesh — the performance
    path): lower directly to lax.psum/all_gather/ppermute; XLA emits ICI
    collectives.
-2. **Eager single-process**: the world is this process; ops are identity
-   (world_size 1 per process) matching reference semantics where each
-   process holds one shard. Cross-device eager work is done by jit'ing a
-   shard_map over the group's mesh.
+2. **Eager, single process**: the reference's "one process per rank"
+   becomes "one mesh-axis slot per rank". Eager collectives take the
+   **rank-major layout**: ``tensor.shape[0] == group.nranks``, slice ``i``
+   being rank i's tensor. The op executes on the devices through a jitted
+   ``shard_map`` over the group's axis (XLA emits the real collective),
+   and every rank's result comes back in the same layout. A group of size
+   1 is the identity, as in the reference. Anything else raises — a
+   collective must never silently return its input.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import functools
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
-from ..framework.core import Tensor, apply_op
+from ..framework.core import Tensor
 from . import env
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "reduce",
     "broadcast", "all_gather", "scatter", "alltoall", "send", "recv",
-    "barrier", "split", "wait", "destroy_process_group",
+    "sendrecv", "barrier", "split", "wait", "destroy_process_group",
 ]
 
 
@@ -101,6 +107,105 @@ def _axis_name(group: Optional[Group]):
     return g.axis_name or "data"
 
 
+# -- eager execution over the mesh ------------------------------------------
+
+def _eager_setup(arr, group, opname):
+    """Resolve (mesh, axis, nranks) for an eager collective; validate the
+    rank-major layout. Raises instead of silently passing data through."""
+    from ..parallel.mesh import get_mesh
+
+    g = group or _get_default_group()
+    axis = g.axis_name or "data"
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.shape:
+        raise RuntimeError(
+            f"distributed.{opname}: no device mesh with axis '{axis}' is "
+            f"active. Create one (paddle_tpu.parallel.create_mesh or "
+            f"init_parallel_env) before eager collectives, or call the op "
+            f"inside shard_map.")
+    n = mesh.shape[axis]
+    if env.get_world_size() > 1:
+        raise NotImplementedError(
+            f"distributed.{opname}: eager collectives across processes are "
+            f"not supported; use the compiled path (DistributedTrainStep) "
+            f"or in-trace collectives under shard_map.")
+    if g.nranks not in (1, n):
+        raise RuntimeError(
+            f"distributed.{opname}: group has {g.nranks} ranks but mesh "
+            f"axis '{axis}' has {n} slots.")
+    if arr.ndim == 0 or arr.shape[0] != n:
+        raise RuntimeError(
+            f"distributed.{opname}: eager single-process collectives use "
+            f"the rank-major layout — tensor.shape[0] must equal the group "
+            f"size ({n}); got shape {tuple(arr.shape)}. Each slice [i] is "
+            f"rank i's tensor.")
+    return mesh, axis, n
+
+
+@functools.lru_cache(maxsize=256)
+def _eager_fn(kind, axis, mesh, extra=None):
+    """Build + cache the jitted shard_map program for an eager collective.
+    The mesh itself is part of the cache key — two meshes with the same
+    axis name/size but different device layouts must not share programs."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(axis)
+
+    if kind == "all_reduce":
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[extra]
+        body = lambda x: red(x, axis)
+    elif kind == "reduce":
+        dst, op = extra
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
+
+        def body(x):
+            total = red(x, axis)
+            idx = jax.lax.axis_index(axis)
+            keep = (idx == dst)
+            return jnp.where(keep, total, x)
+    elif kind == "broadcast":
+        src = extra
+
+        def body(x):
+            idx = jax.lax.axis_index(axis)
+            return jax.lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)),
+                                axis)
+    elif kind == "all_gather":
+        body = lambda x: jax.lax.all_gather(x, axis, tiled=True)
+    elif kind == "alltoall":
+        body = lambda x: jax.lax.all_to_all(x, axis, split_axis=0,
+                                            concat_axis=0, tiled=True)
+    elif kind == "ppermute":
+        perm = extra
+        body = lambda x: jax.lax.ppermute(x, axis, list(perm))
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec,
+                             check_rep=False))
+
+
+def _run_eager(kind, arr, group, opname, extra=None):
+    mesh, axis, n = _eager_setup(arr, group, opname)
+    if n == 1:
+        return arr
+    with mesh:
+        return _eager_fn(kind, axis, mesh, extra)(arr)
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _rewrap(tensor, out):
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
+
+
 # Pure collective fns usable on arrays inside shard_map --------------------
 
 def psum(x, axis_name):
@@ -121,40 +226,44 @@ def pmean(x, axis_name):
 
 # Tensor-level API ---------------------------------------------------------
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=True):
-    axis = _axis_name(group)
-    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
-        out = fn(arr, axis)
-        if isinstance(tensor, Tensor):
-            tensor._data = out
-            return tensor
-        return out
-    # eager single process: identity (world of one per process)
-    return tensor
+        return _rewrap(tensor, fn(arr, _axis_name(group)))
+    return _rewrap(tensor, _run_eager("all_reduce", arr, group,
+                                      "all_reduce", op))
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    arr = _unwrap(tensor)
+    if _axis_in_trace(arr):
+        axis = _axis_name(group)
+        fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+              ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
+        total = fn(arr, axis)
+        idx = jax.lax.axis_index(axis)
+        return _rewrap(tensor, jnp.where(idx == dst, total, arr))
+    return _rewrap(tensor, _run_eager("reduce", arr, group, "reduce",
+                                      (int(dst), op)))
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         axis = _axis_name(group)
         idx = jax.lax.axis_index(axis)
-        src_val = jax.lax.psum(jnp.where(idx == src, arr, jnp.zeros_like(arr)), axis)
-        if isinstance(tensor, Tensor):
-            tensor._data = src_val
-            return tensor
-        return src_val
-    return tensor
+        out = jax.lax.psum(jnp.where(idx == src, arr, jnp.zeros_like(arr)),
+                           axis)
+        return _rewrap(tensor, out)
+    return _rewrap(tensor, _run_eager("broadcast", arr, group, "broadcast",
+                                      int(src)))
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
-    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         ax = _axis_name(group)
         out = jax.lax.all_gather(arr, ax)
@@ -163,44 +272,106 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.extend(Tensor(out[i]) for i in range(n))
             return tensor_list
         return out
+    mesh, ax, n = _eager_setup(arr, group, "all_gather")
+    # rank-major input already holds every rank's tensor; still run the
+    # real collective so the mesh path is exercised, then unstack. Each
+    # device's tiled gather contributes a full copy — take the first.
+    if n > 1:
+        with mesh:
+            gathered = _eager_fn("all_gather", ax, mesh)(arr)
+        out_rows = [gathered[i] for i in range(n)]
+    else:
+        out_rows = [arr[0]]
     if isinstance(tensor_list, list):
-        tensor_list.append(tensor.clone() if isinstance(tensor, Tensor) else Tensor(arr))
+        tensor_list.extend(Tensor(r) for r in out_rows)
         return tensor_list
-    return tensor
+    return jnp.stack(out_rows)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list is not None and len(tensor_list):
-        g = group or _get_default_group()
-        tensor.set_value(tensor_list[g.rank if g.rank >= 0 else 0])
-    return tensor
+    if tensor_list is None or not len(tensor_list):
+        raise ValueError("distributed.scatter needs tensor_list on src")
+    arrs = [_unwrap(t) for t in tensor_list]
+    if _axis_in_trace(arrs[0]):
+        ax = _axis_name(group)
+        stacked = jnp.stack(arrs)
+        idx = jax.lax.axis_index(ax)
+        picked = jnp.take(stacked, idx, axis=0)
+        return _rewrap(tensor, picked)
+    # eager rank-major: rank i receives tensor_list[i]
+    out = jnp.stack(arrs)
+    return _rewrap(tensor, out)
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
-    arrs = [t._data if isinstance(t, Tensor) else t for t in in_tensor_list]
+    arrs = [_unwrap(t) for t in in_tensor_list]
     if arrs and _axis_in_trace(arrs[0]):
         ax = _axis_name(group)
         stacked = jnp.stack(arrs)
-        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0, tiled=False)
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
         out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
         return out_tensor_list
-    out_tensor_list.extend(in_tensor_list)
+    # eager rank-major: in_tensor_list[i] has leading dim nranks;
+    # out[j] slice i = in[i] slice j  (transpose ranks <-> chunks)
+    stacked = jnp.stack(arrs)  # [n_in, n, ...]
+    mesh, ax, n = _eager_setup(stacked[0], group, "alltoall")
+    if stacked.shape[0] != n:
+        raise RuntimeError(
+            f"alltoall: need one input tensor per rank ({n}); got "
+            f"{stacked.shape[0]}")
+    out = jnp.swapaxes(stacked, 0, 1)
+    out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
     return out_tensor_list
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+def sendrecv(tensor, perm, group=None):
+    """SPMD point-to-point: CollectivePermute with explicit (src, dst)
+    pairs — the mesh-native form of the reference's send_v2/recv_v2 pair
+    (operators/collective/send_v2_op.cc). Works in-trace and eagerly
+    (rank-major layout)."""
+    arr = _unwrap(tensor)
+    perm = tuple((int(s), int(d)) for s, d in perm)
     if _axis_in_trace(arr):
-        ax = _axis_name(group)
-        # point-to-point on a mesh axis = ppermute to dst
-        src = jax.lax.axis_index(ax)
-        del src
-        return jax.lax.ppermute(arr, ax, [(env.get_rank(), dst)])
-    return tensor
+        return _rewrap(tensor, jax.lax.ppermute(arr, _axis_name(group), list(perm)))
+    return _rewrap(tensor, _run_eager("ppermute", arr, group, "sendrecv", perm))
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    return tensor
+def send(tensor, dst=0, group=None, sync_op=True, src=None):
+    """P2P send. In SPMD every device runs the same program, so the
+    (src, dst) pair must be explicit: pass src= or use sendrecv()."""
+    arr = _unwrap(tensor)
+    if _axis_in_trace(arr):
+        if src is None:
+            raise ValueError(
+                "distributed.send inside a trace needs an explicit src rank "
+                "(SPMD programs are identical on every device; the process "
+                "rank is meaningless here). Use send(tensor, dst, src=s) or "
+                "sendrecv(tensor, [(s, d)]).")
+        return _rewrap(tensor, jax.lax.ppermute(
+            arr, _axis_name(group), [(int(src), int(dst))]))
+    if src is None:
+        raise NotImplementedError(
+            "distributed.send: one-sided eager p2p has no single-process "
+            "SPMD meaning; use sendrecv(tensor, [(src, dst)]).")
+    return sendrecv(tensor, [(int(src), int(dst))], group)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """P2P recv — the receiving half of sendrecv. See send()."""
+    arr = _unwrap(tensor)
+    if _axis_in_trace(arr):
+        if dst is None:
+            raise ValueError(
+                "distributed.recv inside a trace needs an explicit dst rank; "
+                "use recv(tensor, src, dst=d) or sendrecv(tensor, [(s, d)]).")
+        return _rewrap(tensor, jax.lax.ppermute(
+            arr, _axis_name(group), [(int(src), int(dst))]))
+    if dst is None:
+        raise NotImplementedError(
+            "distributed.recv: one-sided eager p2p has no single-process "
+            "SPMD meaning; use sendrecv(tensor, [(src, dst)]).")
+    return sendrecv(tensor, [(int(src), int(dst))], group)
 
 
 def barrier(group=None):
@@ -217,6 +388,7 @@ def wait(tensor, group=None, use_calc_stream=True):
 def destroy_process_group(group=None):
     _groups.clear()
     _default_group[0] = None
+    _eager_fn.cache_clear()
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,

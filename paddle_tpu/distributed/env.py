@@ -71,8 +71,15 @@ def init_parallel_env(mesh_shape=None, axis_names=None):
                 coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
                 num_processes=n_procs,
                 process_id=int(os.environ.get("JAX_PROCESS_ID", 0)))
-        except RuntimeError:
-            pass  # already initialized
+        except RuntimeError as e:
+            # Only the double-init case is benign; a genuine bootstrap
+            # failure (bad coordinator address, bind failure) must not
+            # silently degrade to a wrong single-process world view.
+            # jax 0.9 phrases double-init as "should only be called once".
+            msg = str(e).lower()
+            if ("already initialized" not in msg
+                    and "only be called once" not in msg):
+                raise
     devs = np.array(_devices())
     if mesh_shape is None:
         mesh_shape = (len(devs),)

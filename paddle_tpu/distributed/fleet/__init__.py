@@ -10,9 +10,18 @@ init = fleet.init
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+get_mesh = fleet.get_mesh
+minimize = fleet.minimize
 worker_index = fleet.worker_index
 is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
+is_server = fleet.is_server
+is_worker = fleet.is_worker
+stop_worker = fleet.stop_worker
+
+
+def worker_num():
+    return fleet.worker_num
 
 
 class UserDefinedRoleMaker:

@@ -97,7 +97,7 @@ class HybridCommunicateGroup:
         self._pp_degree = topology.get_dim("pipe")
         self._sharding_degree = topology.get_dim("sharding")
 
-        from .. import collective as C
+        from ... import collective as C
 
         coord = topology.get_coord(global_rank)
         names = topology.get_hybrid_group_names()

@@ -1,26 +1,49 @@
-"""DataParallel wrapper.
+"""DataParallel wrapper — eager SPMD data parallelism.
 
 Parity: reference python/paddle/fluid/dygraph/parallel.py:389 (DataParallel)
-+ C++ Reducer (imperative/reducer.cc). TPU-native: there is no per-process
-NCCL ring to bucket gradients for — XLA fuses the grad all-reduce into the
-compiled step. Eager semantics:
++ C++ Reducer (imperative/reducer.cc:648-971). The reference makes each
+process compute on its batch shard and bucket-allreduces gradients over
+NCCL. TPU-native redesign: ONE process drives all devices of the mesh's
+"data" axis; DataParallel
 
-- world_size==1 (single process driving N devices): passthrough; the
-  multi-device speedup comes from the jit'd TrainStep over the mesh (data
-  axis sharding replaces the Reducer entirely).
-- multi-process (jax.distributed): gradient sync happens inside the jit'd
-  step via psum; the eager hook path averages grads across processes lazily
-  on backward completion for API parity with `loss.backward()` + `opt.step()`.
+1. replicates parameters across the mesh at construction (the analog of
+   the reference's startup param broadcast, hybrid_parallel_util.py:111),
+2. shards each forward input's leading (batch) dim over the data axis,
+3. lets GSPMD propagate shardings through every eager op — where an op
+   contracts the sharded batch dim (loss reductions, weight gradients),
+   XLA inserts the cross-device reduction that the Reducer did by hand.
+
+So after ``loss.backward()`` each parameter's ``grad`` is already the
+full-batch gradient, replicated on every device: ``apply_collective_grads``
+verifies this instead of communicating. ``scale_loss`` is identity because
+the mean over the globally sharded batch is already the global mean.
+
+Multi-process eager DDP is not supported — use the launcher + compiled
+DistributedTrainStep (fleet.distributed_model routes there).
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.core import Tensor
 from ..nn.layer.layers import Layer
 from . import env
 
 __all__ = ["DataParallel"]
+
+
+def _data_mesh():
+    from ..parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None or "data" not in mesh.shape:
+        return None
+    return mesh
 
 
 class DataParallel(Layer):
@@ -31,8 +54,45 @@ class DataParallel(Layer):
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self._group = group
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-process eager DataParallel is not supported on TPU; "
+                "launch one process and let DataParallel shard over the "
+                "local mesh, or use fleet.distributed_model (compiled "
+                "DistributedTrainStep) for multi-host training.")
+        self._mesh = _data_mesh()
+        if self._mesh is not None:
+            self._replicate_params()
+
+    # -- setup ----------------------------------------------------------
+
+    def _replicate_params(self):
+        """Startup broadcast analog: place every parameter replicated on
+        the mesh so each device holds the same copy."""
+        repl = NamedSharding(self._mesh, P())
+        for p in self._layers.parameters():
+            p._data = jax.device_put(p._data, repl)
+
+    def _shard_batch(self, x):
+        """Shard an input tensor's leading dim over the data axis."""
+        if self._mesh is None:
+            return x
+        n = self._mesh.shape["data"]
+        arr = x._data if isinstance(x, Tensor) else x
+        if not hasattr(arr, "ndim") or arr.ndim == 0 or arr.shape[0] % n != 0:
+            return x  # unshardable input passes through replicated
+        sh = NamedSharding(self._mesh, P("data"))
+        arr = jax.device_put(arr, sh)
+        if isinstance(x, Tensor):
+            x._data = arr
+            return x
+        return Tensor(arr)
+
+    # -- forward --------------------------------------------------------
 
     def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_batch(x) for x in inputs)
+        kwargs = {k: self._shard_batch(v) for k, v in kwargs.items()}
         return self._layers(*inputs, **kwargs)
 
     # passthrough the wrapped module's state (reference behavior)
@@ -45,15 +105,31 @@ class DataParallel(Layer):
     set_dict = set_state_dict
     load_dict = set_state_dict
 
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
     def scale_loss(self, loss):
+        """Identity: the loss mean over the sharded global batch already
+        is the global-batch mean (reference scales by 1/nranks because
+        each process only saw 1/nranks of the batch)."""
         return loss
 
     def apply_collective_grads(self):
-        # grads are synchronized inside the compiled step on TPU
-        pass
-
-    from contextlib import contextmanager
+        """Reducer.FusedAllReduceSchedule analog. Under GSPMD the weight
+        gradients come out of backward already reduced across the data
+        axis; this re-asserts the replicated placement (a no-op collective
+        when XLA already replicated them, the reduction otherwise)."""
+        if self._mesh is None:
+            return
+        repl = NamedSharding(self._mesh, P())
+        for p in self._layers.parameters():
+            g = getattr(p, "grad", None)
+            if g is not None and isinstance(g, Tensor):
+                g._data = jax.device_put(g._data, repl)
 
     @contextmanager
     def no_sync(self):
+        """Gradients are produced reduced under GSPMD; there is no deferred
+        communication to skip, so no_sync is the identity (kept for API
+        parity with reference parallel.py:656)."""
         yield

@@ -22,23 +22,35 @@ import jax
 
 
 class _GlobalRNG:
+    """Key creation is lazy: importing paddle_tpu must never initialize an
+    XLA backend (DataLoader spawn/forkserver children import the package in
+    environments where the parent's device plugin is unavailable)."""
+
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.seed(seed)
+        self._seed = int(seed)
+        self._key = None
 
     def seed(self, s: int):
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             self._seed = int(s)
             self._key = jax.random.key(int(s))
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
 
     def next_key(self):
         """Split the global key; returns a fresh subkey (eager use)."""
         with self._lock:
+            self._ensure()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            self._ensure()
+            return self._key
 
     def set_state(self, key):
         with self._lock:

@@ -1,0 +1,37 @@
+"""Incubate fused operators (reference python/paddle/incubate/operators/):
+softmax_mask_fuse, softmax_mask_fuse_upper_triangle — XLA fuses the mask
++ softmax into one kernel, so these are thin compositions, kept for API
+parity with the reference's hand-fused CUDA ops
+(operators/fused_softmax_mask_op.cu)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import apply_op
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+_NEG = -1e30
+
+
+def _mask_softmax(x, mask):
+    s = x.astype(jnp.float32) + mask.astype(jnp.float32) * _NEG
+    return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+
+
+def _tri_softmax(x):
+    q, k = x.shape[-2], x.shape[-1]
+    tri = jnp.tril(jnp.ones((q, k), bool), k=k - q)
+    s = jnp.where(tri, x.astype(jnp.float32), _NEG)
+    return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask*-inf) over the last dim; mask 1 = masked out."""
+    return apply_op(_mask_softmax, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax over the last dim (upper triangle masked)."""
+    return apply_op(_tri_softmax, x)

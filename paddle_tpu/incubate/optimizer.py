@@ -1,0 +1,160 @@
+"""Incubate optimizers: LookAhead, ModelAverage.
+
+Parity: reference python/paddle/incubate/optimizer/{lookahead.py,
+modelaverage.py} (and fluid LookaheadOptimizer, fluid/optimizer.py:6610).
+TPU-native: both are wrappers over the inner optimizer's eager step; the
+slow-weight / averaging math is a jitted pure update over each param.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """Lookahead (https://arxiv.org/abs/1907.08610): the inner optimizer
+    updates fast weights every step; every k steps the slow weights catch
+    up: slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._k_count = 0
+        self._slow = {}  # id(param) -> jnp array
+        self._params = inner_optimizer._parameter_list or []
+        self._name = name
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        for p in self._params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            a = self.alpha
+            for p in self._params:
+                slow = self._slow[id(p)]
+                slow = slow + a * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = {"k_count": self._k_count}
+        sd["inner"] = self.inner_optimizer.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        self._k_count = int(sd.get("k_count", 0))
+        if "inner" in sd:
+            self.inner_optimizer.set_state_dict(sd["inner"])
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..framework.core import backward
+
+        backward(loss)
+        self.step()
+        return None, []
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters over a trailing window
+    (reference incubate/optimizer/modelaverage.py): accumulates param sums;
+    ``apply()`` swaps averaged weights in (optionally within a context),
+    ``restore()`` swaps training weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._params = list(parameters) if parameters is not None else []
+        # per-param: sum_1 (current window), sum_2 (previous windows),
+        # num_accumulates, old_num_accumulates, num_updates
+        self._state = {}
+        self._backup = {}
+        self._name = name
+
+    def _st(self, p):
+        st = self._state.get(id(p))
+        if st is None:
+            z = jnp.zeros_like(p._data)
+            st = {"sum_1": z, "sum_2": z, "num_acc": 0, "old_num_acc": 0,
+                  "num_upd": 0}
+            self._state[id(p)] = st
+        return st
+
+    def step(self):
+        """Accumulate after the inner training step (call each iteration)."""
+        for p in self._params:
+            st = self._st(p)
+            st["sum_1"] = st["sum_1"] + p._data
+            st["num_acc"] += 1
+            st["num_upd"] += 1
+            window = min(self.max_average_window,
+                         max(self.min_average_window,
+                             int(st["num_upd"] * self.average_window)))
+            if st["num_acc"] + st["old_num_acc"] >= window \
+                    and st["num_acc"] >= self.min_average_window:
+                st["sum_2"] = st["sum_1"]
+                st["old_num_acc"] = st["num_acc"]
+                st["sum_1"] = jnp.zeros_like(p._data)
+                st["num_acc"] = 0
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged params in. Returns a context manager when used in
+        ``with``-form via contextlib below."""
+        for p in self._params:
+            st = self._st(p)
+            total = st["num_acc"] + st["old_num_acc"]
+            if total == 0:
+                continue
+            self._backup[id(p)] = p._data
+            avg = (st["sum_1"] + st["sum_2"]) / float(total)
+            p._data = avg.astype(p._data.dtype)
+        self._need_restore = need_restore
+        return self
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False
+
+    def clear_grad(self):
+        for p in self._params:
+            p.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, []

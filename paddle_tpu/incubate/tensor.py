@@ -1,0 +1,38 @@
+"""Incubate segment ops (reference python/paddle/incubate/tensor/math.py)
+over jax.ops.segment_* — XLA lowers to sorted-segment reductions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import apply_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def _seg(reduction, data, ids):
+    n = None  # dynamic segment count is host-side: use max id + 1
+    num = int(ids.max()) + 1 if hasattr(ids, "max") else None
+    fn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+          "min": jax.ops.segment_min}.get(reduction)
+    if reduction == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=num)
+        c = jax.ops.segment_sum(jnp.ones_like(data), ids, num_segments=num)
+        return s / jnp.maximum(c, 1)
+    return fn(data, ids, num_segments=num)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return apply_op(_seg, data, segment_ids, reduction="sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return apply_op(_seg, data, segment_ids, reduction="mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return apply_op(_seg, data, segment_ids, reduction="max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return apply_op(_seg, data, segment_ids, reduction="min")

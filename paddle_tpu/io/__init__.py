@@ -291,6 +291,29 @@ def _dataset_holds_device_arrays(ds, depth=0) -> bool:
     return False
 
 
+def _mp_worker_loop(wid, nw, dataset, worker_init_fn, in_q, out_q):
+    """DataLoader child-process loop (module-level so spawn can pickle it).
+
+    numpy-only in the child: never touches XLA."""
+    import pickle
+
+    _worker_info[0] = _WorkerInfo(wid, nw, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        job = in_q.get()
+        if job is None:
+            break
+        seq, idxs = job
+        try:
+            samples = [_to_numpy_tree(dataset[i]) for i in idxs]
+            batch = _numpy_collate_fn(samples)
+            payload = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+            out_q.put((seq, payload, None))
+        except Exception as e:  # noqa: BLE001
+            out_q.put((seq, None, repr(e)))
+
+
 def _numpy_collate_fn(batch):
     """default_collate_fn that stays in numpy — used inside forked workers,
     which must never touch XLA."""
@@ -399,10 +422,32 @@ class DataLoader:
         if self.use_shared_memory and not self._iterable_ds \
                 and self.batch_sampler is not None \
                 and self.collate_fn is default_collate_fn \
-                and not _dataset_holds_device_arrays(self.dataset):
+                and not _dataset_holds_device_arrays(self.dataset) \
+                and self._mp_payload_picklable():
             yield from self._iter_multiprocess()
             return
         yield from self._iter_threaded()
+
+    def _mp_payload_picklable(self) -> bool:
+        """spawn/forkserver workers receive the dataset by pickle; an
+        unpicklable dataset (or init fn) falls back to the thread path.
+        The probe is O(dataset size), so its result is cached per
+        (dataset, init_fn) identity — one probe, not one per epoch."""
+        if self._mp_context().get_start_method() == "fork":
+            return True
+        key = (id(self.dataset), id(getattr(self, "worker_init_fn", None)))
+        cached = getattr(self, "_pickle_probe", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        import pickle
+
+        try:
+            pickle.dumps((self.dataset, getattr(self, "worker_init_fn", None)))
+            ok = True
+        except Exception:
+            ok = False
+        self._pickle_probe = (key, ok)
+        return ok
 
     def _iter_threaded(self):
         # buffered prefetch on a thread (BufferedReader analog)
@@ -430,6 +475,29 @@ class DataLoader:
         if err:
             raise err[0]
 
+    def _mp_context(self):
+        """Pick a start method that cannot deadlock the XLA runtime.
+
+        fork-ing a process whose XLA backend threads are already running is
+        the classic dataloader deadlock (jax warns on it); fork is only
+        used while the backend is untouched. Otherwise forkserver/spawn —
+        whose children never inherit the runtime — are used, which
+        requires the dataset/worker_init_fn to be picklable (checked by
+        the caller)."""
+        import multiprocessing as mp
+
+        try:
+            from jax._src import xla_bridge
+
+            backend_up = xla_bridge.backends_are_initialized()
+        except Exception:
+            backend_up = True  # unknown → assume live, stay safe
+        if not backend_up:
+            return mp.get_context("fork")
+        methods = mp.get_all_start_methods()
+        return mp.get_context(
+            "forkserver" if "forkserver" in methods else "spawn")
+
     def _iter_multiprocess(self):
         """True multiprocess workers — the reference's dataloader_iter.py
         worker pool. Workers pickle collated batches over mp queues; a
@@ -437,38 +505,19 @@ class DataLoader:
         (core/csrc/ptpu_core.cc — the LoDTensorBlockingQueue analog) which
         provides the bounded prefetch/flow control; the main iterator pops
         and deserialises in sampler order."""
-        import multiprocessing as mp
-        import pickle
-
         from ..core import BlockingQueue
 
-        ctx = mp.get_context("fork")
+        ctx = self._mp_context()
         batches = list(self.batch_sampler)
         nw = max(1, self.num_workers)
         in_queues = [ctx.Queue() for _ in range(nw)]
         out_queue = ctx.Queue(maxsize=self.prefetch_factor * nw)
 
-        def worker_loop(wid, in_q, out_q):
-            _worker_info[0] = _WorkerInfo(wid, nw, self.dataset)
-            if getattr(self, "worker_init_fn", None):
-                self.worker_init_fn(wid)
-            while True:
-                job = in_q.get()
-                if job is None:
-                    break
-                seq, idxs = job
-                try:
-                    # numpy-only in the child: never touch XLA after fork
-                    samples = [_to_numpy_tree(self.dataset[i]) for i in idxs]
-                    batch = _numpy_collate_fn(samples)
-                    payload = pickle.dumps(batch,
-                                           protocol=pickle.HIGHEST_PROTOCOL)
-                    out_q.put((seq, payload, None))
-                except Exception as e:  # noqa: BLE001
-                    out_q.put((seq, None, repr(e)))
-
-        procs = [ctx.Process(target=worker_loop, args=(w, in_queues[w], out_queue),
-                             daemon=True) for w in range(nw)]
+        worker_init = getattr(self, "worker_init_fn", None)
+        procs = [ctx.Process(
+            target=_mp_worker_loop,
+            args=(w, nw, self.dataset, worker_init, in_queues[w], out_queue),
+            daemon=True) for w in range(nw)]
         for p in procs:
             p.start()
         for seq, idxs in enumerate(batches):
@@ -485,8 +534,28 @@ class DataLoader:
         n_total = len(batches)
 
         def reader():
-            for _ in range(n_total):
-                seq, payload, err = out_queue.get()
+            # watch_local_trainers analog (reference launch_utils.py): poll
+            # with a timeout and treat silently-dead workers as failure
+            # instead of blocking forever on their never-arriving batches.
+            import queue as _q
+
+            done = 0
+            while done < n_total:
+                try:
+                    seq, payload, err = out_queue.get(timeout=1.0)
+                except _q.Empty:
+                    if all(not p.is_alive() for p in procs):
+                        dead = [p.exitcode for p in procs]
+                        body = struct.pack("<qB", -1 & 0x7FFFFFFFFFFFFFFF, 1) + (
+                            f"all workers exited (exitcodes={dead}) with "
+                            f"{n_total - done} batches outstanding").encode()
+                        try:
+                            native_q.push(body)
+                        except TimeoutError:
+                            pass
+                        return
+                    continue
+                done += 1
                 if err is not None:
                     body = struct.pack("<qB", seq, 1) + err.encode()
                 else:

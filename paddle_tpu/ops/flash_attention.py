@@ -1,11 +1,23 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels with KV blocking (fwd + bwd).
 
 TPU-native answer to the reference's fused attention
-(operators/fused/fused_transformer_op.cu, fmha_ref.h): instead of a cuda
-fMHA, a Pallas kernel that tiles Q into VMEM blocks and computes softmax(QK^T)V
-per block, so the [S, S] score matrix never hits HBM. The backward pass
-recomputes attention inside jax.checkpoint (rematerialization is cheaper
-than saving scores on TPU — HBM bandwidth is the bottleneck).
+(operators/fused/fused_transformer_op.cu, fmha_ref.h): instead of a CUDA
+fMHA, Pallas kernels that stream K/V through VMEM in blocks with
+online-softmax accumulation, so neither the [S, S] score matrix nor the
+full K/V ever needs to sit in fast memory at once.
+
+Design notes (tuned on a v5e chip):
+- grid (bh/block_b, q blocks, kv blocks), kv innermost so the VMEM
+  scratch (m, l, acc) carries across the kv sweep; block_b batches
+  several batch*head rows per grid step to amortize per-step overhead
+  at short sequence lengths.
+- matmuls run at the input dtype's MXU rate (bf16 in training) with f32
+  accumulation; softmax statistics stay f32.
+- backward is ONE fused kernel: dK/dV accumulate in scratch over the
+  inner q sweep, while dQ per-kv partials go to HBM and are summed by
+  XLA — S and dP are computed once instead of twice (4 matmuls, the
+  same count as XLA's saved-P backward, but without materializing P).
+- lse/delta travel as [.., seq, 1] f32 — no lane-broadcast HBM waste.
 
 Layout: [batch, heads, seq, head_dim] (matches MultiHeadAttention internals).
 """
@@ -17,7 +29,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-_INTERPRET_CACHE = {}
+NEG_INF = -1e30
 
 
 def _on_tpu() -> bool:
@@ -32,101 +44,285 @@ def _attention_reference(q, k, v, causal, scale):
     if causal:
         qlen, klen = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((qlen, klen), dtype=bool), k=klen - qlen)
-        s = jnp.where(mask, s, -1e30)
+        s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+def _pick_block_b(bh: int, bq: int, bk: int) -> int:
+    """Largest divisor of bh keeping the f32 score block under ~4MB —
+    the kernel holds ~2 score-sized f32 intermediates plus double-buffered
+    input blocks inside the 16MB VMEM scoped limit."""
+    budget = 4 * 1024 * 1024
+    bb = 1
+    for cand in (2, 4, 8, 16):
+        if bh % cand == 0 and cand * bq * bk * 4 <= budget:
+            bb = cand
+    return bb
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                scale, causal, block_q, block_k, n_kv, off=0):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
-    k = k_ref[0].astype(jnp.float32)  # [S, d]
-    v = v_ref[0].astype(jnp.float32)  # [S, d]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale  # [block_q, S]
-    if causal:
-        seq = k.shape[0]
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, -1e30)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32) / l
-    o_ref[0] = o.astype(o_ref.dtype)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # Causal: skip kv blocks strictly above this q block's diagonal.
+    live = (qi * block_q + block_q - 1 + off >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]                                  # [bb, bq, d]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(q_pos + off >= k_pos, s, NEG_INF)
+        m_prev = m_s[:, :, 0:1]                         # [bb, bq, 1]
+        l_prev = l_s[:, :, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                          # [bb, bq, bk] f32
+        l_s[:] = jnp.broadcast_to(alpha * l_prev + jnp.sum(p, -1, keepdims=True),
+                                  l_s.shape)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kv - 1)
+    def _finalize():
+        l = l_s[:, :, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_s[:] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_s[:, :, 0:1] + jnp.log(l)      # [bb, bq, 1]
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "interpret"))
-def _flash_forward(q, k, v, causal=False, scale=None, block_q=128, interpret=False):
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _flash_forward(q, k, v, causal=False, scale=None, block_q=512,
+                   block_k=1024, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    if scale is None:
-        scale = 1.0 / math.sqrt(d)
     bq = min(block_q, sq)
-    if sq % bq != 0:
-        return _attention_reference(q, k, v, causal, scale)
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
-    grid = (b * h, sq // bq)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal, block_q=bq)
-    out = pl.pallas_call(
+    bk = min(block_k, sk)
+    n_q, n_kv = sq // bq, sk // bk
+    bh = b * h
+    bb = _pick_block_b(bh, bq, bk)
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, n_kv=n_kv,
+                               off=sk - sq)
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=grid,
+        out_shape=(jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)),
+        grid=(bh // bb, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((bb, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((bb, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((bb, bk, d), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_specs=(pl.BlockSpec((bb, bq, d), lambda i, j, kk: (i, j, 0)),
+                   pl.BlockSpec((bb, bq, 1), lambda i, j, kk: (i, j, 0))),
+        scratch_shapes=[
+            pltpu.VMEM((bb, bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bb, bq, 128), jnp.float32),   # running sum
+            pltpu.VMEM((bb, bq, d), jnp.float32),     # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, block_q):
-    return _flash_forward(q, k, v, causal=causal, scale=scale, block_q=block_q)
+# --------------------------------------------------------------------------
+# backward, one fused kernel (see module docstring). delta = rowsum(dO*O)
+# is one fused XLA pass producing a tiny [bh, sq, 1] input.
+# --------------------------------------------------------------------------
+
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dqp_ref, dk_s, dv_s, *,
+                scale, causal, block_q, block_k, n_q, off=0):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    live = (qi * block_q + block_q - 1 + off >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]                                  # [bb, bq, d]
+        k = k_ref[...]                                  # [bb, bk, d]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]                              # [bb, bq, 1]
+        delta = delta_ref[...]                          # [bb, bq, 1]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                            # [bb, bq, bk] f32
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, p.shape, 2)
+            p = jnp.where(q_pos + off >= k_pos, p, 0.0)
+        pb = p.astype(do.dtype)
+        dv_s[:] += jax.lax.dot_general(pb, do, (((1,), (1,)), ((0,), (0,))),
+                                       preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bb, bq, bk]
+        dk_s[:] += jax.lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))),
+                                       preferred_element_type=jnp.float32)
+        dqp_ref[0] = jax.lax.dot_general(
+            ds, k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        dqp_ref[0] = jnp.zeros_like(dqp_ref[0])
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[...] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q):
-    return _flash(q, k, v, causal, scale, block_q), (q, k, v)
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _flash_backward(q, k, v, o, lse, g, causal=False, scale=None,
+                    block_q=512, block_k=1024, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    n_q, n_kv = sq // bq, sk // bk
+    bh = b * h
+    bb = _pick_block_b(bh, bq, bk)
+    qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
+    dor = g.reshape(bh, sq, d)
+    # delta = rowsum(dO * O): one fused XLA pass, tiny [bh, sq, 1] output
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(bh, sq, 1)
+    dqp_dtype = q.dtype if n_kv == 1 else jnp.float32
+
+    dk, dv, dqp = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_q=n_q, off=sk - sq),
+        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+                   jax.ShapeDtypeStruct((n_kv, bh, sq, d), dqp_dtype)),
+        grid=(bh // bb, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((bb, bq, d), lambda i, kk, j: (i, j, 0)),
+            pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((bb, bq, d), lambda i, kk, j: (i, j, 0)),
+            pl.BlockSpec((bb, bq, 1), lambda i, kk, j: (i, j, 0)),
+            pl.BlockSpec((bb, bq, 1), lambda i, kk, j: (i, j, 0)),
+        ],
+        out_specs=(pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
+                   pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
+                   pl.BlockSpec((1, bb, bq, d),
+                                lambda i, kk, j: (kk, i, j, 0))),
+        scratch_shapes=[pltpu.VMEM((bb, bk, d), jnp.float32),
+                        pltpu.VMEM((bb, bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, delta)
+
+    dq = jnp.sum(dqp, axis=0).astype(q.dtype) if n_kv > 1 else dqp[0]
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
-def _flash_bwd_rule(causal, scale, block_q, res, g):
-    # Backward recomputes attention through the XLA reference path (the
-    # [S,S] score matrix exists only inside the bwd computation; a Pallas
-    # flash-backward kernel replacing this is tracked work).
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, scale),
-        q, k, v)
-    return vjp(g)
+# --------------------------------------------------------------------------
+# differentiable entry
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=128):
+def _supported(q, k, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if d % 128 != 0 and d not in (64,):
+        return False
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    return sq % bq == 0 and sk % bk == 0 and sq >= 128 and sk >= 128
+
+
+def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=512,
+                           block_k=1024, interpret=None):
     """Array-level entry (used inside jit traces / functional code).
 
-    Differentiable: the Pallas kernel runs the forward; a custom_vjp
-    recomputes the backward via the reference formula.
+    Differentiable end to end in Pallas: KV-blocked online-softmax forward,
+    delta-trick fused backward.
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    use_pallas = _on_tpu() and d in (64, 128, 256) and q.shape[-2] >= 128
-    if use_pallas:
-        return _flash(q, k, v, bool(causal), float(scale), int(block_q))
-    return _attention_reference(q, k, v, causal, scale)
+    if interpret is None:
+        interpret = False
+        if not _on_tpu():
+            return _attention_reference(q, k, v, causal, scale)
+    if not _supported(q, k, block_q, block_k):
+        return _attention_reference(q, k, v, causal, scale)
+    return _flash(q, k, v, bool(causal), float(scale), int(block_q),
+                  int(block_k), bool(interpret))
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

@@ -23,6 +23,14 @@ Schedule (GPipe-style fill/drain, T = n_micro + n_stages - 1 ticks):
   activation it received at tick t-1; stage S-1 emits microbatch t-(S-1).
 Bubble fraction = (S-1)/T, same as the reference's F-then-B schedule
 (section_worker.cc:139-142); increase n_micro to amortise.
+
+Memory: each tick body runs under jax.checkpoint, so backward saves only
+the inter-stage carry per tick and rematerialises the per-layer internals
+— peak live activation memory is O(n_stages · act) + O(T · carry), not
+O(n_micro · layer_internals). This is the memory property 1F1B exists for
+(reference pipeline_parallel.py:80-150 holds ≤ n_stages in-flight
+microbatches); the remat trades one extra forward per tick for it, the
+standard TPU-side bargain (HBM is the binding constraint, MXU is not).
 """
 from __future__ import annotations
 
@@ -51,7 +59,7 @@ def stack_stages(block_params, n_stages: int):
 
 
 def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
-                     n_stages: int):
+                     n_stages: int, remat: bool = True):
     """Run the pipeline schedule; returns per-microbatch outputs.
 
     Args:
@@ -74,8 +82,7 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
 
     vstage = jax.vmap(stage_fn)
 
-    def tick(carry, t):
-        acts, outs = carry
+    def tick(acts, t):
         # inject microbatch t at stage 0 (clamped read; masked write)
         inj = jax.lax.dynamic_index_in_dim(
             x_micro, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
@@ -83,16 +90,13 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
         acts = acts.at[0].set(inj.astype(acts.dtype))
         # all stages compute in parallel on their held activation
         y = vstage(stage_params, acts)
-        # drain: last stage's output is microbatch t-(S-1); clamped index —
-        # pre-fill garbage at index 0 is overwritten at t = S-1.
-        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-        outs = jax.lax.dynamic_update_index_in_dim(
-            outs, y[-1].astype(outs.dtype), out_idx, axis=0)
-        # rotate activations one stage forward (XLA: CollectivePermute)
-        acts = jnp.roll(y, shift=1, axis=0)
-        return (acts, outs), None
+        # rotate activations one stage forward (XLA: CollectivePermute);
+        # emit the last stage's output as this tick's y (scan-stacked, NOT
+        # part of the carry — keeps the carry O(n_stages))
+        return jnp.roll(y, shift=1, axis=0), y[-1]
 
     acts0 = jnp.zeros(act_shape, x_micro.dtype)
-    outs0 = jnp.zeros_like(x_micro)
-    (acts, outs), _ = jax.lax.scan(tick, (acts0, outs0), jnp.arange(T))
-    return outs
+    body = jax.checkpoint(tick) if remat else tick
+    _, ys = jax.lax.scan(body, acts0, jnp.arange(T))
+    # drain: tick t >= n_stages-1 emitted microbatch t-(n_stages-1)
+    return ys[n_stages - 1:].astype(x_micro.dtype)

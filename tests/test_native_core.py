@@ -119,18 +119,31 @@ class TestArena:
         a.free(p3)
 
 
+class _SquareDS:
+    """Module-level so spawn/forkserver workers can pickle it."""
+
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        return np.asarray([i * i], dtype=np.float32)
+
+
+class _BadDS:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom")
+        return np.zeros(1, np.float32)
+
+
 class TestMultiprocessDataLoader:
     def test_mp_workers_produce_ordered_batches(self):
-        from paddle_tpu.io import DataLoader, Dataset
+        from paddle_tpu.io import DataLoader
 
-        class SquareDS(Dataset):
-            def __len__(self):
-                return 32
-
-            def __getitem__(self, i):
-                return np.asarray([i * i], dtype=np.float32)
-
-        dl = DataLoader(SquareDS(), batch_size=4, num_workers=2,
+        dl = DataLoader(_SquareDS(), batch_size=4, num_workers=2,
                         shuffle=False, drop_last=False)
         out = [np.asarray(b._data).ravel() for b in dl]
         assert len(out) == 8
@@ -138,17 +151,24 @@ class TestMultiprocessDataLoader:
         np.testing.assert_array_equal(flat, np.arange(32.0) ** 2)
 
     def test_mp_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_BadDS(), batch_size=2, num_workers=2)
+        with pytest.raises((RuntimeError, ValueError), match="boom"):
+            list(dl)
+
+    def test_unpicklable_dataset_falls_back_to_threads(self):
+        # Local class → unpicklable under spawn/forkserver → thread path,
+        # same batches either way.
         from paddle_tpu.io import DataLoader, Dataset
 
-        class BadDS(Dataset):
+        class LocalDS(Dataset):
             def __len__(self):
                 return 8
 
             def __getitem__(self, i):
-                if i == 5:
-                    raise ValueError("boom")
-                return np.zeros(1, np.float32)
+                return np.asarray([i], dtype=np.float32)
 
-        dl = DataLoader(BadDS(), batch_size=2, num_workers=2)
-        with pytest.raises(RuntimeError, match="boom"):
-            list(dl)
+        dl = DataLoader(LocalDS(), batch_size=2, num_workers=2, shuffle=False)
+        flat = np.concatenate([np.asarray(b._data).ravel() for b in dl])
+        np.testing.assert_array_equal(flat, np.arange(8.0))

@@ -1,0 +1,99 @@
+"""Pipeline memory: per-tick remat keeps activations O(n_stages).
+
+VERDICT r1 weak #7: GPipe-through-scan used to carry every tick's stage
+internals into backward — O(n_micro · layer_internals) live activation
+memory. With jax.checkpoint per tick, backward stores only the inter-stage
+carry and rematerialises internals, the memory property 1F1B exists for
+(reference pipeline_parallel.py:80-150, section_worker.cc:61-142).
+
+Proof: compile grad of a pipeline whose stage has a 32x internal blowup
+and compare XLA's temp_size_in_bytes with and without remat.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.parallel.pipeline import pipeline_forward, stack_stages
+
+S = 4          # stages
+D = 64         # activation width
+EXPAND = 32    # internal blowup per stage
+MICRO = 4      # microbatch size
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    w1 = jnp.asarray(rng.normal(size=(S, 1, D, D * EXPAND)) * 0.05, jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(S, 1, D * EXPAND, D)) * 0.05, jnp.float32)
+    return {"w1": w1, "w2": w2}
+
+
+def _stage_fn(p, x):
+    # one "layer" per stage with a big internal activation
+    h = jax.nn.relu(x @ p["w1"][0])
+    return x + h @ p["w2"][0]
+
+
+def _compiled_temp_bytes(n_micro, remat):
+    params = _params()
+    x = jnp.zeros((n_micro, MICRO, D), jnp.float32)
+
+    def loss(params, x):
+        out = pipeline_forward(_stage_fn, params, x, S, remat=remat)
+        return jnp.sum(out * out)
+
+    g = jax.jit(jax.grad(loss))
+    stats = g.lower(params, x).compile().memory_analysis()
+    return stats.temp_size_in_bytes
+
+
+class TestPipelineMemory:
+    def test_remat_bounds_per_microbatch_memory_growth(self):
+        """Temp memory slope per extra microbatch: without remat every tick
+        keeps S*MICRO*D*EXPAND internals live into backward; with remat
+        only the O(S·D) carry per tick survives. The constant offset
+        (param grad buffers) is identical, so compare slopes."""
+        slope_remat = (_compiled_temp_bytes(32, True)
+                       - _compiled_temp_bytes(8, True)) / 24
+        slope_noremat = (_compiled_temp_bytes(32, False)
+                         - _compiled_temp_bytes(8, False)) / 24
+        per_tick_internals = S * MICRO * D * EXPAND * 4
+        assert slope_remat < slope_noremat / 2, (slope_remat, slope_noremat)
+        # absolute bound: the remat slope must be far below one tick's
+        # internals — i.e. internals are NOT accumulated across ticks
+        assert slope_remat < per_tick_internals / 2, (
+            slope_remat, per_tick_internals)
+
+    def test_forward_correctness_remat_matches_no_remat(self):
+        params = _params(3)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(6, MICRO, D)), jnp.float32)
+        a = pipeline_forward(_stage_fn, params, x, S, remat=True)
+        b = pipeline_forward(_stage_fn, params, x, S, remat=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_grad_correctness_vs_sequential(self):
+        """Pipeline grads == running the stages sequentially per microbatch."""
+        params = _params(5)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(6, MICRO, D)), jnp.float32)
+
+        def loss_pipe(params):
+            return jnp.sum(pipeline_forward(_stage_fn, params, x, S) ** 2)
+
+        def loss_seq(params):
+            def one_micro(xm):
+                h = xm
+                for s in range(S):
+                    p_s = jax.tree_util.tree_map(lambda a: a[s], params)
+                    h = _stage_fn(p_s, h)
+                return h
+            out = jax.vmap(one_micro)(x)
+            return jnp.sum(out ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(params)
+        g_seq = jax.grad(loss_seq)(params)
+        for k in g_pipe:
+            np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                       np.asarray(g_seq[k]),
+                                       rtol=2e-4, atol=2e-5)
