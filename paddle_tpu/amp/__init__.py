@@ -103,6 +103,21 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     return models, optimizers
 
 
+import jax as _jax
+
+
+@_jax.jit
+def _unscale_and_check(grads, scale):
+    inv = 1.0 / scale
+    new = tuple(g * inv for g in grads)
+    finite = _jax.tree_util.tree_reduce(
+        jnp.logical_and,
+        tuple(jnp.isfinite(g).all() for g in new),
+        jnp.bool_(True),
+    )
+    return new, finite
+
+
 class GradScaler:
     """Loss scaling (reference python/paddle/amp/grad_scaler.py:26 over
     check_finite_and_unscale / update_loss_scaling ops).
@@ -133,18 +148,20 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        import numpy as np
-
-        inv = 1.0 / self._scale
-        found = False
+        grads = [p.grad._data for p in optimizer._parameter_list or []
+                 if p.grad is not None]
+        if not grads:
+            self._found_inf = False
+            return
+        # One fused XLA program: unscale every grad and reduce a single
+        # scalar finite-flag — a single device→host sync per step (the
+        # reference fuses the same way in check_finite_and_unscale_op.cu).
+        new_grads, finite = _unscale_and_check(tuple(grads), self._scale)
+        it = iter(new_grads)
         for p in optimizer._parameter_list or []:
-            if p.grad is None:
-                continue
-            g = p.grad._data * inv
-            p.grad = Tensor(g)
-            if not bool(jnp.isfinite(g).all()):
-                found = True
-        self._found_inf = found
+            if p.grad is not None:
+                p.grad = Tensor(next(it))
+        self._found_inf = not bool(finite)
 
     def minimize(self, optimizer, scaled_loss):
         from ..framework.core import backward

@@ -321,6 +321,14 @@ PTPU_API void* ptpu_arena_create(int64_t bytes) {
 PTPU_API void* ptpu_arena_alloc(void* h, int64_t bytes) {
   auto* a = (Arena*)h;
   std::lock_guard<std::mutex> lk(a->mu);
+  if (bytes < 0) {
+    set_error("arena: negative allocation size");
+    return nullptr;
+  }
+  // Round 0-byte requests up to one aligned unit: need==0 would re-insert
+  // the chosen free block at its own offset while also recording it in
+  // used_blocks — a double-tracked region that corrupts later coalescing.
+  if (bytes == 0) bytes = 1;
   size_t need = (size_t)((bytes + 63) & ~63LL);  // 64B aligned
   // best fit
   auto best = a->free_blocks.end();

@@ -31,8 +31,26 @@ class Fleet:
 
     # -- init ----------------------------------------------------------------
     def init(self, role_maker=None, is_collective=True, strategy=None):
+        if not is_collective:
+            # Parameter-server mode (reference fleet_base.py:170 with
+            # is_collective=False → brpc PS, paddle/fluid/distributed/
+            # service/ps_client.h:55). DECISION (documented in README):
+            # sparse/async PS training has no TPU-native analog — TPU
+            # training is dense SPMD over ICI/DCN meshes; a brpc-style
+            # CPU parameter server is out of the TPU critical path.
+            raise NotImplementedError(
+                "parameter-server mode (is_collective=False) is not "
+                "supported by the TPU backend: use collective mode "
+                "(is_collective=True) with hybrid_configs "
+                "(dp/mp/pp/sharding) instead — see README 'Parameter "
+                "server decision'")
         self._is_collective = is_collective
         self._strategy = strategy or DistributedStrategy()
+        if getattr(self._strategy, "a_sync", False):
+            raise NotImplementedError(
+                "DistributedStrategy.a_sync (async parameter server) is "
+                "not supported on TPU — see README 'Parameter server "
+                "decision'")
         hc = self._strategy.hybrid_configs
         dp = int(hc.get("dp_degree", 1))
         mp = int(hc.get("mp_degree", 1))
@@ -83,10 +101,16 @@ class Fleet:
 
     # -- model/optimizer wrapping --------------------------------------------
     def distributed_model(self, model):
-        """Pick the parallel wrapper (reference fleet_base.py:883)."""
+        """Pick the parallel wrapper (reference fleet_base.py:883).
+
+        pp>1 PipelineLayer → PipelineParallel (train_batch compiles the
+        SPMD pipeline via fleet/engine.py); mp>1 → TensorParallel;
+        sharding>1 → ShardingParallel (train_batch compiles a ZeRO-1
+        sharded step); else eager DataParallel."""
         from ..meta_parallel.pp_layers import PipelineLayer
         from ..meta_parallel.pipeline_parallel import PipelineParallel
-        from ..meta_parallel.tensor_parallel import TensorParallel
+        from ..meta_parallel.tensor_parallel import (ShardingParallel,
+                                                     TensorParallel)
         from ...parallel import DataParallel
 
         if self._hcg is None:
@@ -95,17 +119,25 @@ class Fleet:
             return PipelineParallel(model, self._hcg, self._strategy)
         if self._hcg.get_model_parallel_world_size() > 1:
             return TensorParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, self._hcg, self._strategy)
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):
         from ..meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
             HybridParallelOptimizer,
         )
+        from ..meta_optimizers.gradient_merge import GradientMergeOptimizer
 
         if strategy is not None:
             self._strategy = strategy
         if self._hcg is None:
             self.init()
+        if getattr(self._strategy, "gradient_merge", False):
+            cfg = self._strategy.gradient_merge_configs or {}
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=int(cfg.get("k_steps", 1)),
+                avg=bool(cfg.get("avg", True)))
         if self._topology and self._topology.world_size() > 1:
             return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
         return optimizer

@@ -1,0 +1,1 @@
+from .gradient_merge import GradientMergeOptimizer  # noqa: F401

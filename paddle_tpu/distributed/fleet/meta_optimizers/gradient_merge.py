@@ -1,0 +1,81 @@
+"""GradientMerge optimizer wrapper.
+
+Parity: reference GradientMergeOptimizer (python/paddle/fluid/
+optimizer.py:6782 and fleet/meta_optimizers/gradient_merge_optimizer.py):
+accumulate gradients for k steps, apply the (optionally averaged) sum on
+the k-th, zero the accumulators. The reference rewrites the static program
+with conditional blocks; here the accumulation is an eager wrapper — the
+per-step add is one fused XLA op per parameter, and the inner optimizer is
+untouched between boundaries.
+
+Consumed by fleet.distributed_optimizer when
+``strategy.gradient_merge=True`` (gradient_merge_configs: k_steps, avg).
+"""
+from __future__ import annotations
+
+from ....framework.core import Tensor
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self._inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+        self._step_count = 0
+        self._acc = {}  # id(param) -> accumulated grad array
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list
+
+    def step(self):
+        self._step_count += 1
+        params = self._inner_opt._parameter_list or []
+        boundary = self._step_count % self.k_steps == 0
+        for p in params:
+            if p.grad is None:
+                continue
+            acc = self._acc.get(id(p))
+            g = p.grad._data
+            acc = g if acc is None else acc + g
+            if boundary:
+                if self.avg:
+                    acc = acc / self.k_steps
+                p.grad = Tensor(acc)
+                self._acc.pop(id(p), None)
+            else:
+                self._acc[id(p)] = acc
+        if boundary:
+            self._inner_opt.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ....framework.core import backward
+
+        backward(loss)
+        self.step()
+        return None, []
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def state_dict(self):
+        return {"inner": self._inner_opt.state_dict(),
+                "step_count": self._step_count}
+
+    def set_state_dict(self, sd):
+        self._step_count = int(sd.get("step_count", 0))
+        if "inner" in sd:
+            self._inner_opt.set_state_dict(sd["inner"])
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

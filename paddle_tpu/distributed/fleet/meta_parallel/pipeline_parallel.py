@@ -3,13 +3,14 @@
 Parity: reference fleet/meta_parallel/pipeline_parallel.py:30
 (PipelineParallel.train_batch → forward_backward_pipeline, Megatron 1F1B).
 
-TPU-native semantics: the reference's 1F1B interleave exists to overlap
-stages across PROCESSES with p2p sends. Here one process owns all stages;
-``train_batch`` reproduces the exact math — microbatched forward/backward
-with gradient accumulation — while true cross-device pipelining is the
-compiled path (paddle_tpu.parallel.pipeline: shard_map over the "pipe" axis
-with ppermute-driven microbatch rotation, used by TrainStep when a
-PipelineLayer runs under a mesh).
+TPU-native semantics: ``train_batch`` routes to the compiled SPMD engine
+(fleet/engine.py → parallel.DistributedTrainStep): one jitted program in
+which stage params ride the "pipe" mesh axis and the microbatch rotation is
+a CollectivePermute (parallel/pipeline.py). The eager path below —
+sequential microbatch grad accumulation, exact 1F1B math but zero
+cross-device overlap — is kept as a DEBUG MODE, selected with
+``use_eager=True`` (or automatically when a GradScaler with dynamic loss
+scaling is passed, whose host-side control flow cannot live in the jit).
 """
 from __future__ import annotations
 
@@ -35,6 +36,8 @@ class PipelineParallel(Layer):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.total_loss = None
+        self._engine = None
+        self._engine_opt_id = None
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -68,14 +71,33 @@ class PipelineParallel(Layer):
         self.total_loss = total_loss
         return total_loss
 
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+    def _get_engine(self, optimizer):
+        from ..engine import FleetEngine
+        from ....parallel.mesh import get_mesh
+
+        if get_mesh() is None:
+            return None
+        if self._engine is None or self._engine_opt_id != id(optimizer):
+            self._engine = FleetEngine(self._layers, optimizer,
+                                       self._strategy, hcg=self._hcg)
+            self._engine_opt_id = id(optimizer)
+        return self._engine
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    use_eager=False):
         self._layers.train()
-        loss = self.forward_backward_pipeline(data, scaler)
-        if scaler is not None:
-            scaler.step(optimizer)
+        eager = use_eager or (scaler is not None and scaler._enable)
+        engine = None if eager else self._get_engine(optimizer)
+        if engine is not None:
+            loss = Tensor(engine.step(data))
         else:
-            optimizer.step()
-        optimizer.clear_grad()
+            # debug mode: sequential microbatch grad accumulation
+            loss = self.forward_backward_pipeline(data, scaler)
+            if scaler is not None:
+                scaler.step(optimizer)
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss

@@ -18,6 +18,8 @@ class MetaParallelBase(Layer):
         self._layers = layers
         self._hcg = hcg
         self._strategy = strategy
+        self._engine = None
+        self._engine_key = None
         self._prepare_for_model()
 
     def _prepare_for_model(self):
@@ -25,6 +27,25 @@ class MetaParallelBase(Layer):
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, loss_fn=None):
+        """Run one compiled SPMD training step (fleet/engine.py): forward +
+        backward + clip + sharded optimizer update in a single jit. The
+        eager forward()/backward()/opt.step() flow stays available for
+        debugging; this is the engine path the facade promises."""
+        from ..engine import FleetEngine
+        from ....framework.core import Tensor
+
+        key = (id(optimizer), id(loss_fn))
+        if self._engine is None or self._engine_key != key:
+            self._engine = FleetEngine(self._layers, optimizer,
+                                       self._strategy, hcg=self._hcg,
+                                       loss_fn=loss_fn)
+            self._engine_key = key
+        loss = self._engine.step(data)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)
@@ -38,4 +59,7 @@ class TensorParallel(MetaParallelBase):
 
 
 class ShardingParallel(MetaParallelBase):
+    """ZeRO wrapper (reference meta_parallel/sharding_parallel.py): under
+    GSPMD the param broadcast is unnecessary; train_batch compiles the step
+    with optimizer state sharded along the "sharding" axis (ZeRO-1)."""
     pass

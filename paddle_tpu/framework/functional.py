@@ -1,0 +1,70 @@
+"""Functionalise an eager Layer: run its forward with parameters taken from
+an external pytree instead of the layer's own storage.
+
+This is the bridge between the Paddle-style stateful ``nn.Layer`` world and
+the pure-function world jit/pjit compile (the reference never needs this —
+its executor interprets ops against mutable Scopes; under XLA the training
+step must be a pure function of (params, batch)).
+
+Used by the Fleet engine (distributed/fleet/engine.py) to compile
+facade-built models into one sharded XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from .core import Tensor, no_grad
+
+__all__ = ["layer_params", "functional_call"]
+
+
+def layer_params(layer, trainable_only: bool = True) -> Dict[str, Any]:
+    """Named parameter arrays of a Layer as a flat {name: jax.Array} dict."""
+    out = {}
+    for name, p in layer.named_parameters():
+        if trainable_only and p.stop_gradient:
+            continue
+        out[name] = p._data
+    return out
+
+
+def _wrap(x):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (tuple, list)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x)
+
+
+def _unwrap_out(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return type(x)(_unwrap_out(v) for v in x)
+    return x
+
+
+def functional_call(layer, params: Dict[str, Any], *args, **kwargs):
+    """Call ``layer(*args)`` with its parameters substituted by ``params``.
+
+    ``params`` maps named_parameters() names to (possibly traced) arrays.
+    The layer's own parameter storage is restored on exit, so this is safe
+    to trace with jax.jit/grad: the traced arrays never leak into eager
+    state. Inputs may be raw arrays or Tensors; the output is unwrapped to
+    raw arrays (matching how jit-able code consumes it).
+    """
+    named = dict(layer.named_parameters())
+    saved = {}
+    try:
+        for name, arr in params.items():
+            p = named[name]
+            saved[name] = p._data
+            p._data = arr
+        with no_grad():
+            out = layer(*_wrap(args), **{k: _wrap(v) for k, v in kwargs.items()})
+    finally:
+        for name, old in saved.items():
+            named[name]._data = old
+    return _unwrap_out(out)

@@ -1,0 +1,24 @@
+"""paddle.incubate parity surface (reference
+python/paddle/incubate/__init__.py:17-27): experimental optimizers and
+fused operators exported at the top level.
+"""
+from .optimizer import LookAhead, ModelAverage
+from .operators import (
+    softmax_mask_fuse,
+    softmax_mask_fuse_bool,
+    softmax_mask_fuse_upper_triangle,
+)
+from .tensor import segment_sum, segment_mean, segment_max, segment_min
+from . import operators, optimizer, tensor
+
+__all__ = [
+    "LookAhead",
+    "ModelAverage",
+    "softmax_mask_fuse",
+    "softmax_mask_fuse_bool",
+    "softmax_mask_fuse_upper_triangle",
+    "segment_sum",
+    "segment_mean",
+    "segment_max",
+    "segment_min",
+]

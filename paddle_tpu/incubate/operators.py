@@ -10,13 +10,17 @@ import jax.numpy as jnp
 
 from ..framework.core import apply_op
 
-__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_bool",
+           "softmax_mask_fuse_upper_triangle"]
 
 _NEG = -1e30
 
 
 def _mask_softmax(x, mask):
-    s = x.astype(jnp.float32) + mask.astype(jnp.float32) * _NEG
+    # Additive mask, matching the reference
+    # (incubate/operators/softmax_mask_fuse.py): callers pass 0 at kept
+    # positions and a large negative value (e.g. -10000) at masked ones.
+    s = x.astype(jnp.float32) + mask.astype(jnp.float32)
     return jax.nn.softmax(s, axis=-1).astype(x.dtype)
 
 
@@ -28,8 +32,20 @@ def _tri_softmax(x):
 
 
 def softmax_mask_fuse(x, mask, name=None):
-    """softmax(x + mask*-inf) over the last dim; mask 1 = masked out."""
+    """softmax(x + mask) over the last dim (additive mask, reference
+    semantics: masked positions carry a large negative mask value)."""
     return apply_op(_mask_softmax, x, mask)
+
+
+def _bool_mask_softmax(x, mask):
+    s = jnp.where(mask.astype(bool), _NEG, x.astype(jnp.float32))
+    return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse_bool(x, mask, name=None):
+    """Boolean-mask variant: mask 1/True = masked out (no reference
+    counterpart; kept because it is the common jax calling convention)."""
+    return apply_op(_bool_mask_softmax, x, mask)
 
 
 def softmax_mask_fuse_upper_triangle(x, name=None):

@@ -10,9 +10,7 @@ from ..framework.core import apply_op
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
 
 
-def _seg(reduction, data, ids):
-    n = None  # dynamic segment count is host-side: use max id + 1
-    num = int(ids.max()) + 1 if hasattr(ids, "max") else None
+def _seg(data, ids, reduction, num):
     fn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
           "min": jax.ops.segment_min}.get(reduction)
     if reduction == "mean":
@@ -22,17 +20,28 @@ def _seg(reduction, data, ids):
     return fn(data, ids, num_segments=num)
 
 
+def _num_segments(segment_ids):
+    # Segment count is a static shape parameter: resolve on host before
+    # tracing (int() on a traced array would fail inside jax.vjp).
+    arr = getattr(segment_ids, "_data", segment_ids)
+    return int(jnp.max(arr)) + 1 if arr.size else 0
+
+
 def segment_sum(data, segment_ids, name=None):
-    return apply_op(_seg, data, segment_ids, reduction="sum")
+    return apply_op(_seg, data, segment_ids, reduction="sum",
+                    num=_num_segments(segment_ids))
 
 
 def segment_mean(data, segment_ids, name=None):
-    return apply_op(_seg, data, segment_ids, reduction="mean")
+    return apply_op(_seg, data, segment_ids, reduction="mean",
+                    num=_num_segments(segment_ids))
 
 
 def segment_max(data, segment_ids, name=None):
-    return apply_op(_seg, data, segment_ids, reduction="max")
+    return apply_op(_seg, data, segment_ids, reduction="max",
+                    num=_num_segments(segment_ids))
 
 
 def segment_min(data, segment_ids, name=None):
-    return apply_op(_seg, data, segment_ids, reduction="min")
+    return apply_op(_seg, data, segment_ids, reduction="min",
+                    num=_num_segments(segment_ids))

@@ -121,6 +121,8 @@ def _flash_forward(q, k, v, causal=False, scale=None, block_q=512,
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
     sk = k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
@@ -222,6 +224,8 @@ def _flash_backward(q, k, v, o, lse, g, causal=False, scale=None,
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
     sk = k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)

@@ -108,6 +108,20 @@ class TestArena:
         with pytest.raises(ValueError):
             a.free(p)
 
+    def test_alloc_zero_gets_distinct_block(self):
+        # Regression: alloc(0) used to double-track the chosen free block
+        # (re-inserted at the same offset AND recorded in used_blocks).
+        a = ArenaAllocator(1 << 16)
+        p0 = a.alloc(0)
+        p1 = a.alloc(64)
+        assert p1 != p0
+        a.free(p0)
+        a.free(p1)
+        assert a.allocated == 0
+        assert a.stat(3) == 1
+        with pytest.raises(MemoryError):
+            a.alloc(-1)
+
     def test_best_fit_reuse(self):
         a = ArenaAllocator(1 << 16)
         p1 = a.alloc(256)
