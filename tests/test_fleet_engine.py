@@ -1,0 +1,216 @@
+"""Fleet facade → compiled SPMD engine routing (fleet/engine.py).
+
+VERDICT r2 item 2: fleet.distributed_model + distributed_optimizer with
+pp/mp/sharding degrees must build a DistributedTrainStep under the hood;
+facade-driven pp=2×sharding=2 training must produce identical losses to
+direct DistributedTrainStep use; the eager grad-accum path is a documented
+debug mode.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.parallel.mesh import set_mesh
+from paddle_tpu.parallel.pipeline import pipeline_forward
+from paddle_tpu.parallel.train_step import DistributedTrainStep
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    set_mesh(None)
+    from paddle_tpu.distributed import env
+
+    env.set_state(initialized=False, hcg=None, topology=None, mesh=None)
+
+
+def _strategy(dp=1, mp=1, pp=1, sharding=1, accumulate_steps=1):
+    s = DistributedStrategy()
+    s.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+        "sharding_degree": sharding,
+    }
+    s.pipeline_configs = {"accumulate_steps": accumulate_steps,
+                          "micro_batch_size": 1}
+    return s
+
+
+def _mse(out, label):
+    return paddle.mean((out - label) ** 2)
+
+
+def _uniform_pipe(seed, n_layers=4, dim=8, num_stages=2):
+    paddle.seed(seed)
+    return PipelineLayer(
+        layers=[LayerDesc(paddle.nn.Linear, dim, dim)
+                for _ in range(n_layers)],
+        num_stages=num_stages, loss_fn=_mse)
+
+
+def _data(steps, batch, dim=8):
+    rng = np.random.default_rng(3)
+    for _ in range(steps):
+        yield (rng.normal(size=(batch, dim)).astype("float32"),
+               rng.normal(size=(batch, dim)).astype("float32"))
+
+
+class TestFacadeMatchesDirectEngine:
+    def test_pp2_sharding2_identical_losses(self):
+        """Facade pp=2 × sharding=2 == hand-built DistributedTrainStep."""
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, sharding=2, dp=2,
+                                      accumulate_steps=4))
+        pipe = _uniform_pipe(31)
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()))
+
+        # hand-built direct engine over the SAME initial weights
+        stages = [pipe.get_stage_layers(s) for s in range(2)]
+        params = {}
+        for li in range(2):
+            params[f"w{li}"] = jnp.stack(
+                [stages[s][li].weight._data for s in range(2)])
+            params[f"b{li}"] = jnp.stack(
+                [stages[s][li].bias._data for s in range(2)])
+        specs = {"w0": P("pipe"), "b0": P("pipe"),
+                 "w1": P("pipe"), "b1": P("pipe")}
+
+        def stage_fn(sp, h):
+            h = h @ sp["w0"] + sp["b0"]
+            return h @ sp["w1"] + sp["b1"]
+
+        def loss_fn(p, batch):
+            x, y = batch
+            xm = x.reshape(4, x.shape[0] // 4, x.shape[1])
+            ym = y.reshape(4, y.shape[0] // 4, y.shape[1])
+            ys = pipeline_forward(stage_fn, p, xm, 2)
+            return jnp.mean(jax.vmap(
+                lambda o, t: jnp.mean((o - t) ** 2))(ys, ym))
+
+        direct = DistributedTrainStep(
+            loss_fn, params, specs, optimizer="sgd", lr=0.1, zero=True,
+            mesh=fleet.get_mesh())
+
+        for x, y in _data(3, batch=8):
+            got = model.train_batch(
+                (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+            want = direct((jnp.asarray(x), jnp.asarray(y)))
+            np.testing.assert_allclose(float(got._data), float(want),
+                                       rtol=1e-5, atol=1e-6)
+
+        # facade really used the SPMD pipeline: stacked stage params with
+        # a leading "pipe" spec
+        eng = model._engine
+        assert any(s == P("pipe") or (s and s[0] == "pipe")
+                   for s in eng.train_step.param_specs.values())
+
+    def test_compiled_matches_eager_debug_mode(self):
+        """Compiled train_batch == use_eager=True debug path (same math)."""
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, dp=4, accumulate_steps=2))
+        pipe_c = _uniform_pipe(7)
+        model_c = fleet.distributed_model(pipe_c)
+        opt_c = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model_c.parameters()))
+
+        pipe_e = _uniform_pipe(7)
+        model_e = fleet.distributed_model(pipe_e)
+        opt_e = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model_e.parameters()))
+
+        for x, y in _data(3, batch=8):
+            data_c = (paddle.to_tensor(x), paddle.to_tensor(y))
+            data_e = (paddle.to_tensor(x), paddle.to_tensor(y))
+            lc = model_c.train_batch(data_c, opt_c)
+            le = model_e.train_batch(data_e, opt_e, use_eager=True)
+            np.testing.assert_allclose(float(lc._data), float(le._data),
+                                       rtol=1e-4, atol=1e-5)
+
+        # trained weights agree between the two paths
+        for (n1, p1), (n2, p2) in zip(pipe_c.named_parameters(),
+                                      pipe_e.named_parameters()):
+            assert n1 == n2
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_nonuniform_stages_fall_back_to_flat_compile(self):
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, dp=4, accumulate_steps=2))
+
+        paddle.seed(13)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(paddle.nn.Linear, 16, 32),
+                    LayerDesc(paddle.nn.ReLU),
+                    LayerDesc(paddle.nn.Linear, 32, 8)],
+            num_stages=2, loss_fn=_mse)
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 16)).astype("float32")
+        y = rng.normal(size=(8, 8)).astype("float32")
+        with pytest.warns(UserWarning, match="not structurally uniform"):
+            loss = model.train_batch((paddle.to_tensor(x),
+                                      paddle.to_tensor(y)), opt)
+        assert np.isfinite(float(loss._data))
+        # flat fallback: no pipe-sharded specs
+        assert all(not (s and "pipe" in str(s))
+                   for s in model._engine.train_step.param_specs.values())
+
+
+class TestShardingParallel:
+    def test_sharding_facade_train_batch(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ShardingParallel)
+
+        fleet.init(is_collective=True, strategy=_strategy(sharding=2, dp=4))
+        paddle.seed(17)
+        net = paddle.nn.Sequential(paddle.nn.Linear(64, 128),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(128, 64))
+        model = fleet.distributed_model(net)
+        assert isinstance(model, ShardingParallel)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()))
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.normal(size=(8, 64)).astype("float32"))
+        y = paddle.to_tensor(rng.normal(size=(8, 64)).astype("float32"))
+        losses = []
+        for _ in range(5):
+            loss = model.train_batch((x, y), opt, loss_fn=_mse)
+            losses.append(float(loss._data))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+        # ZeRO-1: optimizer state carries a "sharding" axis somewhere
+        eng = model._engine
+        m_specs = jax.tree_util.tree_leaves(
+            eng.train_step.opt_specs["m"],
+            is_leaf=lambda s: isinstance(s, P))
+        assert any("sharding" in str(s) for s in m_specs)
+
+
+class TestPSDecision:
+    def test_ps_mode_raises_with_pointer(self):
+        with pytest.raises(NotImplementedError, match="Parameter"):
+            fleet.init(is_collective=False)
+
+    def test_a_sync_raises(self):
+        s = DistributedStrategy()
+        s.a_sync = True
+        with pytest.raises(NotImplementedError, match="a_sync"):
+            fleet.init(is_collective=True, strategy=s)

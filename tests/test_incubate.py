@@ -1,0 +1,216 @@
+"""Tests for paddle_tpu.incubate through the PUBLIC path
+(reference python/paddle/incubate/__init__.py exports)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+
+
+class TestSegmentOps:
+    def _data(self):
+        rng = np.random.RandomState(0)
+        data = rng.rand(10, 4).astype(np.float32)
+        ids = np.sort(rng.randint(0, 4, size=10)).astype(np.int64)
+        return data, ids
+
+    def test_segment_sum(self):
+        data, ids = self._data()
+        got = incubate.segment_sum(paddle.to_tensor(data),
+                                   paddle.to_tensor(ids)).numpy()
+        want = np.stack([data[ids == s].sum(0) for s in range(ids.max() + 1)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_segment_mean(self):
+        data, ids = self._data()
+        got = incubate.segment_mean(paddle.to_tensor(data),
+                                    paddle.to_tensor(ids)).numpy()
+        want = np.stack([data[ids == s].mean(0) for s in range(ids.max() + 1)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_segment_max_min(self):
+        data, ids = self._data()
+        got_max = incubate.segment_max(paddle.to_tensor(data),
+                                       paddle.to_tensor(ids)).numpy()
+        got_min = incubate.segment_min(paddle.to_tensor(data),
+                                       paddle.to_tensor(ids)).numpy()
+        want_max = np.stack([data[ids == s].max(0) for s in range(ids.max() + 1)])
+        want_min = np.stack([data[ids == s].min(0) for s in range(ids.max() + 1)])
+        np.testing.assert_allclose(got_max, want_max, rtol=1e-5)
+        np.testing.assert_allclose(got_min, want_min, rtol=1e-5)
+
+    def test_segment_sum_grad(self):
+        data, ids = self._data()
+        t = paddle.to_tensor(data)
+        t.stop_gradient = False
+        out = incubate.segment_sum(t, paddle.to_tensor(ids))
+        paddle.sum(out).backward()
+        np.testing.assert_allclose(t.grad.numpy(), np.ones_like(data))
+
+
+class TestSoftmaxMaskFuse:
+    def test_additive_mask_matches_reference_semantics(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 4, 8, 8).astype(np.float32)
+        mask = np.where(rng.rand(2, 1, 8, 8) > 0.5, -10000.0, 0.0).astype(np.float32)
+        got = incubate.softmax_mask_fuse(paddle.to_tensor(x),
+                                         paddle.to_tensor(mask)).numpy()
+        s = x + mask
+        e = np.exp(s - s.max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+        # masked positions get ~zero probability
+        assert got[np.broadcast_to(mask < 0, got.shape)].max() < 1e-4
+
+    def test_bool_mask_variant(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(2, 2, 4, 4).astype(np.float32)
+        mask = (rng.rand(2, 1, 4, 4) > 0.5).astype(np.float32)
+        got = incubate.softmax_mask_fuse_bool(paddle.to_tensor(x),
+                                              paddle.to_tensor(mask)).numpy()
+        assert got[np.broadcast_to(mask > 0, got.shape)].max() < 1e-4
+
+    def test_upper_triangle(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(1, 2, 6, 6).astype(np.float32)
+        got = incubate.softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(x)).numpy()
+        iu = np.triu_indices(6, k=1)
+        assert got[0, 0][iu].max() < 1e-6
+        np.testing.assert_allclose(got.sum(-1), np.ones((1, 2, 6)), rtol=1e-5)
+
+
+class TestLookAhead:
+    def test_slow_weights_update_every_k(self):
+        paddle.seed(7)
+        lin = paddle.nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+        w0 = lin.weight.numpy().copy()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+        def one_step():
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        one_step()
+        w_after_1_fast = lin.weight.numpy().copy()
+        assert not np.allclose(w_after_1_fast, w0)
+        one_step()
+        # after k=2 steps: fast == slow == w0 + alpha*(fast2 - w0)
+        w2 = lin.weight.numpy()
+        assert not np.allclose(w2, w_after_1_fast)
+
+    def test_matches_manual_lookahead(self):
+        paddle.seed(9)
+        lin = paddle.nn.Linear(3, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+
+        # manual replica
+        paddle.seed(9)
+        ref = paddle.nn.Linear(3, 1)
+        ref_opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=ref.parameters())
+        slow = {id(p): p.numpy().copy() for p in ref.parameters()}
+
+        x = paddle.to_tensor(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+        for step in range(1, 5):
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+            rloss = paddle.mean(ref(x) ** 2)
+            rloss.backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+            if step % 2 == 0:
+                for p in ref.parameters():
+                    s = slow[id(p)] + 0.5 * (p.numpy() - slow[id(p)])
+                    slow[id(p)] = s
+                    p.set_value(s.astype(np.float32))
+
+        for p, q in zip(lin.parameters(), ref.parameters()):
+            np.testing.assert_allclose(p.numpy(), q.numpy(), rtol=1e-5,
+                                       atol=1e-6)
+
+
+class TestModelAverage:
+    def test_apply_restore(self):
+        paddle.seed(11)
+        lin = paddle.nn.Linear(2, 2)
+        ma = incubate.ModelAverage(1.0, parameters=lin.parameters(),
+                                   min_average_window=2,
+                                   max_average_window=10)
+        snapshots = []
+        opt = paddle.optimizer.SGD(learning_rate=0.3,
+                                   parameters=lin.parameters())
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        for _ in range(3):
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+            snapshots.append(lin.weight.numpy().copy())
+
+        trained = lin.weight.numpy().copy()
+        with ma.apply():
+            avg = lin.weight.numpy()
+            # exact trailing-window mean of the visited weights
+            want = (snapshots[0] + snapshots[1] + snapshots[2]) / 3
+            np.testing.assert_allclose(avg, want, rtol=1e-5, atol=1e-7)
+            assert not np.allclose(avg, trained)
+        np.testing.assert_allclose(lin.weight.numpy(), trained)
+
+    def test_apply_without_restore(self):
+        paddle.seed(12)
+        lin = paddle.nn.Linear(2, 2)
+        ma = incubate.ModelAverage(1.0, parameters=lin.parameters(),
+                                   min_average_window=1,
+                                   max_average_window=100)
+        ma.step()
+        trained = lin.weight.numpy().copy()
+        ma.apply(need_restore=False)
+        np.testing.assert_allclose(lin.weight.numpy(), trained, rtol=1e-6)
+
+
+class TestGradientMerge:
+    def test_k_step_accumulation_matches_big_batch(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+
+        rng = np.random.RandomState(5)
+        x = rng.rand(8, 4).astype(np.float32)
+        y = rng.rand(8, 1).astype(np.float32)
+
+        paddle.seed(21)
+        m1 = paddle.nn.Linear(4, 1)
+        gm = GradientMergeOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m1.parameters()),
+            k_steps=2, avg=True)
+        # two half-batches through gradient merge
+        for lo, hi in ((0, 4), (4, 8)):
+            loss = paddle.mean(
+                (m1(paddle.to_tensor(x[lo:hi])) - paddle.to_tensor(y[lo:hi])) ** 2)
+            loss.backward()
+            gm.step()
+            gm.clear_grad()
+
+        paddle.seed(21)
+        m2 = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m2.parameters())
+        loss = paddle.mean((m2(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)

@@ -1,0 +1,129 @@
+"""Tests for paddle_tpu.text: viterbi_decode vs brute force, dataset
+loaders' structure (reference python/paddle/text/)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import text
+
+
+def _brute_force(pots, trans, length, include_tag):
+    """Enumerate all tag paths for one sequence; return (score, path)."""
+    L, n = pots.shape
+    best, best_path = -np.inf, None
+    start, stop = trans[n - 1], trans[n - 2]
+    for path in itertools.product(range(n), repeat=length):
+        s = pots[0, path[0]]
+        if include_tag:
+            s += start[path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pots[t, path[t]]
+        if include_tag:
+            s += stop[path[length - 1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("include_tag", [False, True])
+    def test_matches_brute_force(self, include_tag):
+        rng = np.random.RandomState(42)
+        b, L, n = 4, 5, 4
+        pots = rng.rand(b, L, n).astype(np.float32)
+        trans = rng.rand(n, n).astype(np.float32)
+        lens = np.array([5, 3, 1, 4], np.int64)
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_tag)
+        scores = scores.numpy()
+        paths = paths.numpy()
+        assert paths.shape == (b, 5)
+        for i in range(b):
+            want_s, want_p = _brute_force(pots[i], trans, int(lens[i]),
+                                          include_tag)
+            np.testing.assert_allclose(scores[i], want_s, rtol=1e-5)
+            assert list(paths[i][:lens[i]]) == want_p
+            assert all(paths[i][lens[i]:] == 0)
+
+    def test_layer_wrapper(self):
+        rng = np.random.RandomState(1)
+        trans = paddle.to_tensor(rng.rand(3, 3).astype(np.float32))
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pots = paddle.to_tensor(rng.rand(2, 4, 3).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 2], np.int64))
+        scores, paths = dec(pots, lens)
+        assert scores.shape == [2]
+        assert paths.shape == [2, 4]
+
+
+class TestTextDatasets:
+    def test_uci_housing(self):
+        train = text.UCIHousing(mode="train")
+        test = text.UCIHousing(mode="test")
+        assert len(train) > len(test) > 0
+        feat, target = train[0]
+        assert feat.shape == (13,) and target.shape == (1,)
+        assert feat.dtype == np.float32
+
+    def test_imdb(self):
+        ds = text.Imdb(mode="train")
+        assert len(ds) > 0
+        assert b"<unk>" in ds.word_idx
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and doc.ndim == 1
+        assert label.shape == (1,) and label[0] in (0, 1)
+
+    def test_imikolov_ngram(self):
+        ds = text.Imikolov(data_type="NGRAM", window_size=3, mode="train")
+        assert len(ds) > 0
+        gram = ds[0]
+        assert gram.shape == (3,)
+
+    def test_imikolov_seq(self):
+        ds = text.Imikolov(data_type="SEQ", mode="test")
+        cur, nxt = ds[0]
+        assert len(cur) == len(nxt)
+
+    def test_movielens(self):
+        train = text.Movielens(mode="train")
+        test = text.Movielens(mode="test")
+        assert len(train) > 0 and len(test) > 0
+        item = train[0]
+        assert len(item) == 7
+        assert item[-1].dtype == np.float32  # rating
+
+    def test_wmt14(self):
+        ds = text.WMT14(mode="train", dict_size=1000)
+        src, trg, trg_next = ds[0]
+        assert src.dtype == np.int64
+        assert trg[0] == 0          # <s>
+        assert trg_next[-1] == 1    # <e>
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+        sd, td = ds.get_dict()
+        assert len(sd) == 1000
+
+    def test_wmt16(self):
+        ds = text.WMT16(mode="val", src_dict_size=500, trg_dict_size=600)
+        src, trg, trg_next = ds[0]
+        assert len(trg) == len(trg_next)
+        assert len(ds.get_dict("en")) == 500
+
+    def test_conll05(self):
+        ds = text.Conll05st()
+        item = ds[0]
+        assert len(item) == 9
+        lens = {len(f) for f in item}
+        assert len(lens) == 1  # all sequences aligned
+        w, v, l = ds.get_dict()
+        assert len(l) == 59
+
+    def test_dataloader_integration(self):
+        ds = text.UCIHousing(mode="train")
+        loader = paddle.io.DataLoader(ds, batch_size=16, shuffle=False,
+                                      num_workers=0)
+        feats, targets = next(iter(loader))
+        assert list(feats.shape) == [16, 13]
+        assert list(targets.shape) == [16, 1]
