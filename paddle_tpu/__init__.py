@@ -53,6 +53,7 @@ from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
+from . import inference  # noqa: F401
 from . import distributed  # noqa: F401
 from . import device  # noqa: F401
 from . import utils  # noqa: F401

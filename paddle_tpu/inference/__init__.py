@@ -1,0 +1,126 @@
+"""Compiled-serve inference API.
+
+Parity: reference AnalysisPredictor
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:86 —
+load model → analysis/optimization passes → compiled program → zero-copy
+run) and the Config/create_predictor API (paddle_inference_api.h).
+
+TPU-native: the exported artifact (static/export.py) already IS optimized
+compiler IR (StableHLO), so the analysis-pass pipeline collapses into
+PJRT compilation: deserialize once, AOT-compile per input-shape signature
+(symbolic-dim exports compile once for all batch sizes), keep weights
+device-resident, and feed/fetch through dlpack-free jax device arrays —
+the functional analog of the reference's zero-copy tensors.
+
+No model-building Python is imported: a serving process needs only
+``paddle_tpu.inference`` and numpy.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """AnalysisConfig analog: points at the exported artifact."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.path_prefix = prog_file
+        self._device = None
+
+    # reference-API knobs that are automatic under PJRT: accepted, no-ops
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = ("gpu", device_id)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, enable=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _IOTensor:
+    """Zero-copy-style handle (reference ZeroCopyTensor): holds the array
+    slot for a named input/output."""
+
+    def __init__(self, owner, name):
+        self._owner = owner
+        self._name = name
+
+    def copy_from_cpu(self, arr):
+        self._owner._inputs[self._name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the array in copy_from_cpu
+
+    def copy_to_cpu(self):
+        return np.asarray(self._owner._outputs[self._name])
+
+
+class Predictor:
+    """Load an exported inference artifact and serve it.
+
+    ``Predictor(path).run([inputs...]) -> [outputs...]`` — AOT-compiles on
+    first call per shape signature; symbolic-dim exports compile once.
+    """
+
+    def __init__(self, path_or_config):
+        from ..static.export import (ExportedInference, is_stablehlo_model,
+                                     read_artifacts)
+
+        path = (path_or_config.path_prefix
+                if isinstance(path_or_config, Config) else path_or_config)
+        if path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        if not is_stablehlo_model(path):
+            raise ValueError(
+                f"{path}.pdmodel is not a versioned StableHLO export — "
+                "re-save with paddle_tpu.static.save_inference_model")
+        data, state, meta = read_artifacts(path)
+        self._exported = ExportedInference(data, state, meta)
+        self.meta = meta
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    # -- reference-style named IO -------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return self._exported.feed_names
+
+    def get_output_names(self) -> List[str]:
+        return [f"fetch_{i}" for i in range(self.meta["fetch_count"])]
+
+    def get_input_handle(self, name) -> _IOTensor:
+        return _IOTensor(self, name)
+
+    def get_output_handle(self, name) -> _IOTensor:
+        return _IOTensor(self, name)
+
+    # -- execution ------------------------------------------------------------
+    def run(self, inputs: Optional[Sequence] = None):
+        """inputs: list aligned with get_input_names(), or None to use
+        values staged via input handles. Returns list of np.ndarray."""
+        names = self._exported.feed_names
+        if inputs is not None:
+            feed = dict(zip(names, inputs))
+        else:
+            feed = dict(self._inputs)
+        vals = self._exported.run(feed)
+        out = [np.asarray(v) for v in vals]
+        self._outputs = {f"fetch_{i}": v for i, v in enumerate(out)}
+        return out
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

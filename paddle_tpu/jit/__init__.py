@@ -104,16 +104,36 @@ def _tree_array_to_tensor(x):
 
 
 class StaticFunction:
-    """Result of to_static: jit-compiled callable with .forward parity."""
+    """Result of to_static: jit-compiled callable with .forward parity.
+
+    Data-dependent Python control flow in the wrapped code is AST-converted
+    (dy2static.convert_to_static) to lax.cond/lax.while_loop before
+    tracing — the reference ProgramTranslator's role
+    (dygraph_to_static/program_translator.py:768). Conversion is best
+    effort per function: code without retrievable source traces as-is.
+    """
 
     def __init__(self, fn_or_layer, input_spec=None, build_strategy=None):
+        from .dy2static import convert_to_static
+
         self._input_spec = input_spec
         if isinstance(fn_or_layer, Layer):
             self._layer = fn_or_layer
             self._fn = None
+            try:
+                converted = convert_to_static(fn_or_layer.forward)
+                if converted is not type(fn_or_layer).forward:
+                    # bind converted forward on the instance (shadows the
+                    # class method for this layer only)
+                    object.__setattr__(fn_or_layer, "forward", converted)
+            except Exception:
+                pass  # conversion is best-effort; plain trace still works
         else:
             self._layer = None
-            self._fn = fn_or_layer
+            try:
+                self._fn = convert_to_static(fn_or_layer)
+            except Exception:
+                self._fn = fn_or_layer
         self._compiled = None
 
     def _make_compiled(self):
@@ -259,13 +279,45 @@ class TrainStep:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: persist state_dict + spec (compiled-module
-    export to StableHLO is provided by paddle_tpu.static.serialize)."""
+    """paddle.jit.save parity (reference jit/api.py save): persists
+    - ``path.pdparams`` — the state_dict (eager reload), and, when
+      ``input_spec`` is given,
+    - ``path.pdmodel`` / ``path.pdiparams`` / ``path.pdmeta.json`` — a
+      versioned StableHLO inference artifact (static/export.py) servable
+      by paddle_tpu.inference.Predictor with no model code."""
     from ..framework.io import save as _save
 
     if isinstance(layer, StaticFunction):
         layer = layer._layer
     _save(layer.state_dict(), path + ".pdparams")
+
+    if input_spec:
+        import numpy as np
+
+        from ..static.export import export_callable, write_artifacts
+
+        params, buffers = state(layer)
+        keys = sorted(params) + sorted(buffers)
+        n_params = len(params)
+        arrays = [params[k] for k in sorted(params)] + \
+                 [buffers[k] for k in sorted(buffers)]
+
+        def pure(state_list, *feeds):
+            p = dict(zip(sorted(params), state_list[:n_params]))
+            b = dict(zip(sorted(buffers), state_list[n_params:]))
+            out, _ = functional_call(layer, p, b, *[Tensor(f) for f in feeds],
+                                     training=False)
+            return _tree_tensor_to_array(out)
+
+        examples = [np.zeros(tuple(1 if (s is None or int(s) < 0) else int(s)
+                                   for s in spec.shape),
+                             dtype=spec.dtype)
+                    for spec in input_spec]
+        data, st, meta = export_callable(
+            pure, arrays, examples,
+            feed_names=[spec.name or f"x{i}"
+                        for i, spec in enumerate(input_spec)])
+        write_artifacts(path, data, st, meta)
 
 
 def load(path, **configs):

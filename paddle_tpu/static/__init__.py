@@ -32,6 +32,8 @@ import numpy as np
 
 from ..framework.core import Parameter, Tensor
 from ..jit import InputSpec  # noqa: F401
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from . import nn  # noqa: F401
 from .graph import (  # noqa: F401
     OpRecord, SymbolicTensor, SymExpr, collect_leaves, evaluate_exprs,
 )
@@ -40,7 +42,8 @@ __all__ = [
     "InputSpec", "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "CompiledProgram",
     "name_scope", "device_guard", "py_func", "save_inference_model",
-    "load_inference_model", "gradients", "append_backward",
+    "load_inference_model", "gradients", "append_backward", "nn",
+    "cond", "while_loop",
 ]
 
 _static_mode = [False]
@@ -51,6 +54,7 @@ class Program:
 
     def __init__(self):
         self.feed_vars: Dict[str, SymbolicTensor] = {}
+        self.feed_dynamic: Dict[str, List[int]] = {}  # name -> -1 dim indices
         self.ops: List[OpRecord] = []
         self.train_specs: List[tuple] = []   # (optimizer, loss SymbolicTensor)
         self.random_seed = None
@@ -111,18 +115,38 @@ def program_guard(main_program, startup_program=None):
         _default_main[0], _default_startup[0] = pm, ps
 
 
+class FeedTensor(SymbolicTensor):
+    """Feed placeholder: ``.shape`` reports -1 for runtime-determined dims
+    (reference Variable semantics) instead of a baked build-time constant;
+    internal shape inference uses 1 and the executor retraces per concrete
+    feed shape."""
+
+    __slots__ = ("_orig_shape",)
+
+    def __init__(self, expr, aval, orig_shape):
+        super().__init__(expr, aval)
+        self._orig_shape = tuple(orig_shape)
+
+    @property
+    def shape(self):
+        return list(self._orig_shape)
+
+
 def data(name, shape, dtype="float32", lod_level=0):
     """Feed placeholder (reference paddle.static.data). dim -1/None means
-    runtime-determined (shown as 1 in build-time shape inference; the
-    executor retraces per concrete shape)."""
+    runtime-determined: reported as -1 in ``.shape``, exported as a
+    symbolic dimension by save_inference_model."""
     from ..framework import dtype as dtypes
 
     dt = dtypes.convert_dtype(dtype)
-    shape = tuple(1 if (s is None or int(s) < 0) else int(s) for s in shape)
-    aval = jax.ShapeDtypeStruct(shape, dt)
-    t = SymbolicTensor(SymExpr("feed", name=name, aval=aval), aval)
+    orig = tuple(-1 if (s is None or int(s) < 0) else int(s) for s in shape)
+    build = tuple(1 if s == -1 else s for s in orig)
+    aval = jax.ShapeDtypeStruct(build, dt)
+    t = FeedTensor(SymExpr("feed", name=name, aval=aval), aval, orig)
     t.name = name
-    default_main_program().feed_vars[name] = t
+    prog = default_main_program()
+    prog.feed_vars[name] = t
+    prog.feed_dynamic[name] = [i for i, s in enumerate(orig) if s == -1]
     return t
 
 
@@ -251,6 +275,14 @@ class Executor:
         program = program if program is not None else default_main_program()
         if isinstance(program, CompiledProgram):
             program = program.program
+        if isinstance(program, InferenceProgram):
+            vals = program.exported.run(feed or {})
+            want = fetch_list or []
+            out = [vals[f.index] if isinstance(f, _FetchHandle) else vals[int(f)]
+                   for f in want] if want else vals
+            if return_numpy:
+                return [np.asarray(v) for v in out]
+            return [Tensor(v) for v in out]
         if not isinstance(program, Program):
             raise TypeError(f"cannot run {type(program)}")
         if not program.ops and not program.train_specs and not fetch_list:
@@ -308,15 +340,31 @@ class Executor:
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    """Serialize the inference graph: params + a replayable closure
-    (reference fluid/io.py save_inference_model)."""
-    import pickle
+                         legacy_format=False, program=None, **kwargs):
+    """Serialize the inference graph (reference fluid/io.py
+    save_inference_model).
 
+    Default: versioned StableHLO artifact via jax.export (static/export.py
+    — the TPU analog of the reference's ProgramDesc proto,
+    framework.proto:234), loadable with zero model-building Python.
+    ``legacy_format=True`` writes the round-2 cloudpickle closure instead
+    (version-fragile; kept for migration)."""
     if not isinstance(fetch_vars, (list, tuple)):
         fetch_vars = [fetch_vars]
     if not isinstance(feed_vars, (list, tuple)):
         feed_vars = [feed_vars]
+
+    if not legacy_format:
+        from .export import export_fetches, write_artifacts
+
+        prog = program or default_main_program()
+        data_bytes, state, meta = export_fetches(
+            feed_vars, fetch_vars, dynamic_dims=prog.feed_dynamic)
+        write_artifacts(path_prefix, data_bytes, state, meta)
+        return
+
+    import pickle
+
     exprs = [t._expr for t in fetch_vars]
     feeds, tensors = collect_leaves(exprs)
     state = {f"__t{i}": np.asarray(t._data) for i, t in enumerate(tensors)}
@@ -389,9 +437,38 @@ def _rebind(e, arrays, memo=None, op_memo=None):
     return e
 
 
+class InferenceProgram(Program):
+    """Loaded StableHLO inference artifact; Executor.run executes it
+    directly (no symbolic replay — the program is already compiled IR)."""
+
+    def __init__(self, exported):
+        super().__init__()
+        self.exported = exported
+
+
+class _FetchHandle:
+    """Fetch placeholder for a loaded inference program output index."""
+
+    __slots__ = ("index", "name")
+
+    def __init__(self, index):
+        self.index = index
+        self.name = f"fetch_{index}"
+
+
 def load_inference_model(path_prefix, executor, **kwargs):
     """Returns (program, feed_names, fetch_symbols) runnable via
-    Executor.run."""
+    Executor.run. Understands both the versioned StableHLO format and the
+    legacy cloudpickle one."""
+    from .export import ExportedInference, is_stablehlo_model, read_artifacts
+
+    if is_stablehlo_model(path_prefix):
+        data_bytes, state, meta = read_artifacts(path_prefix)
+        exported = ExportedInference(data_bytes, state, meta)
+        prog = InferenceProgram(exported)
+        fetches = [_FetchHandle(i) for i in range(meta["fetch_count"])]
+        return prog, exported.feed_names, fetches
+
     import pickle
 
     from ..framework.io import load as _load

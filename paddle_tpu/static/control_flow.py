@@ -1,0 +1,230 @@
+"""Static control-flow ops: cond, while_loop, case, switch_case.
+
+Parity: reference python/paddle/fluid/layers/control_flow.py (`cond`
+:2325-ish, `while_loop`, `case`, `switch_case` over ConditionalBlock /
+While ops interpreted by the executor).
+
+TPU-native: XLA *is* the interpreter, so these lower directly to
+jax.lax.cond / jax.lax.while_loop inside whatever trace is active:
+
+- eager mode: executes immediately (lax primitives run op-by-op);
+- to_static / jit tracing: becomes a real HLO While/Conditional;
+- symbolic static-graph mode (program_guard capture): ``while_loop``
+  records ONE op whose body re-enters the user's cond/body functions on
+  traced arrays at replay time; ``cond`` records both branches and selects
+  (branches in a paddle static program are pure by construction, so
+  evaluating both is semantics-preserving — the same trade XLA itself makes
+  when it flattens small conditionals).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_symbolic(*vals):
+    from .graph import SymbolicTensor
+
+    def walk(v):
+        if isinstance(v, SymbolicTensor):
+            return True
+        if isinstance(v, (tuple, list)):
+            return any(walk(x) for x in v)
+        return False
+
+    return any(walk(v) for v in vals)
+
+
+def _unwrap(v):
+    return v._data if isinstance(v, Tensor) else v
+
+
+def _tree_unwrap(tree):
+    return jax.tree_util.tree_map(
+        _unwrap, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _tree_wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if not isinstance(v, Tensor) else v, tree)
+
+
+def _check_struct(t_out, f_out, what="cond"):
+    ts = jax.tree_util.tree_structure(t_out)
+    fs = jax.tree_util.tree_structure(f_out)
+    if ts != fs:
+        raise ValueError(
+            f"{what}: branch outputs must have identical structure, got "
+            f"{ts} vs {fs}")
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """Run true_fn() or false_fn() depending on scalar boolean ``pred``.
+
+    Both branch callables take no arguments (they close over outer
+    tensors) and must return matching structures of Tensors.
+    """
+    if _is_symbolic(pred):
+        # symbolic build: record both branch subgraphs, then select.
+        t_out = true_fn()
+        f_out = false_fn()
+        _check_struct(t_out, f_out)
+        flat_t, treedef = jax.tree_util.tree_flatten(
+            t_out, is_leaf=lambda x: isinstance(x, Tensor))
+        flat_f = treedef.flatten_up_to(f_out)
+
+        def select(p, *branches):
+            n = len(branches) // 2
+            p = jnp.reshape(p, ()).astype(bool)
+            return tuple(jnp.where(p, a, b)
+                         for a, b in zip(branches[:n], branches[n:]))
+
+        out = apply_op(select, pred, *flat_t, *flat_f)
+        out = out if isinstance(out, tuple) else (out,)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    p = jnp.reshape(_unwrap(pred), ()).astype(bool)
+
+    # Trace both branches through lax.cond; closed-over Tensors become
+    # implicit operands. Outputs are unwrapped arrays (wrapped back after).
+    res_struct = []
+
+    def tf(_):
+        out = true_fn()
+        res_struct.append(jax.tree_util.tree_structure(
+            out, is_leaf=lambda x: isinstance(x, Tensor)))
+        return _tree_unwrap(out)
+
+    def ff(_):
+        out = false_fn()
+        res_struct.append(jax.tree_util.tree_structure(
+            out, is_leaf=lambda x: isinstance(x, Tensor)))
+        return _tree_unwrap(out)
+
+    out = jax.lax.cond(p, tf, ff, 0)
+    if len(res_struct) == 2 and res_struct[0] != res_struct[1]:
+        raise ValueError("cond: branch outputs must have identical structure")
+    return _tree_wrap(out)
+
+
+def _closure_symbolics(fn, exclude_ids):
+    """Symbolic tensors captured in fn's closure cells: they must become
+    explicit operands of the recorded while op, because at replay time
+    their build-time avals are swapped for the live traced arrays."""
+    from .graph import SymbolicTensor
+
+    found = []
+    for f in (fn,):
+        cells = getattr(f, "__closure__", None) or ()
+        for cell in cells:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, SymbolicTensor) and id(v) not in exclude_ids:
+                exclude_ids.add(id(v))
+                found.append(v)
+    return found
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """paddle.static.nn.while_loop parity: run ``body_fn(*vars)`` while
+    ``cond_fn(*vars)`` holds; returns the final loop vars.
+
+    Lowers to jax.lax.while_loop (an XLA While op). Note XLA's constraint,
+    shared with the reference's While op: loop vars must keep shape/dtype
+    across iterations.
+    """
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+
+    n = len(loop_vars)
+    seen = {id(v) for v in loop_vars}
+    extras = (_closure_symbolics(cond_fn, seen) +
+              _closure_symbolics(body_fn, seen))
+
+    def run(*arrays):
+        from .graph import suspend_symbolic
+
+        loop_arrays, extra_arrays = arrays[:n], arrays[n:]
+        saved = [(t, t._data) for t in extras]
+        try:
+            with suspend_symbolic():
+                for t, a in zip(extras, extra_arrays):
+                    t._data = a  # bind live value over the build-time aval
+
+                def c(vs):
+                    r = cond_fn(*[Tensor(v) for v in vs])
+                    return jnp.reshape(_unwrap(r), ()).astype(bool)
+
+                def b(vs):
+                    out = body_fn(*[Tensor(v) for v in vs])
+                    if not isinstance(out, (tuple, list)):
+                        out = (out,)
+                    if len(out) != n:
+                        raise ValueError(
+                            f"while_loop: body returned {len(out)} vars, "
+                            f"expected {n}")
+                    return tuple(_unwrap(o).astype(v.dtype).reshape(v.shape)
+                                 for o, v in zip(out, vs))
+
+                return jax.lax.while_loop(c, b, tuple(loop_arrays))
+        finally:
+            for t, d in saved:
+                t._data = d
+
+    out = apply_op(run, *loop_vars, *extras)
+    if n == 1 and not isinstance(out, (tuple, list)):
+        return [out]
+    out = list(out) if isinstance(out, (tuple, list)) else [out]
+    return out[:n]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins chain of (pred, fn) pairs (reference
+    control_flow.py case): nested cond."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return fn()
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on integer ``branch_index`` (reference switch_case).
+
+    branch_fns: list of callables or list of (index, callable) pairs.
+    """
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    if default is None:
+        default = pairs[-1][1]
+
+    chain = default
+    for idx, fn in reversed(pairs):
+        chain = (lambda chain=chain, idx=idx, fn=fn: cond(
+            _eq_scalar(branch_index, idx), fn, chain))
+    return chain()
+
+
+def _eq_scalar(x, i):
+    from .. import tensor as T
+
+    if isinstance(x, Tensor):
+        return T.equal(x, Tensor(jnp.asarray(i, _unwrap(x).dtype)))
+    return x == i

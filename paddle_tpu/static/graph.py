@@ -95,9 +95,27 @@ def _fake_aval(x):
     return x
 
 
+_suspended = [0]
+
+
+class suspend_symbolic:
+    """Context: execute ops directly even if SymbolicTensor instances are
+    reachable (used by control-flow op bodies at replay time, where
+    build-time symbolic tensors have live arrays bound into ``_data``)."""
+
+    def __enter__(self):
+        _suspended[0] += 1
+
+    def __exit__(self, *exc):
+        _suspended[0] -= 1
+        return False
+
+
 def _symbolic_dispatch(fn, args, attrs, op_name):
     """Installed into framework.core.apply_op: record instead of execute
     when any arg is symbolic."""
+    if _suspended[0]:
+        return NotImplemented
     if not any(isinstance(a, SymbolicTensor) for a in args):
         return NotImplemented
 

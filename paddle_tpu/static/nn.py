@@ -1,0 +1,43 @@
+"""paddle.static.nn parity surface: control flow + static layer helpers.
+
+Reference: python/paddle/static/nn/__init__.py (fc + control_flow ops from
+fluid/layers/control_flow.py).
+"""
+from __future__ import annotations
+
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "fc"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Static fully-connected helper (reference static/nn/common.py fc):
+    flattens trailing dims, applies xW+b and optional activation."""
+    import numpy as np
+
+    from .. import tensor as T
+    from ..framework.core import Parameter
+    from ..nn import functional as F
+    from ..nn import initializer as I
+
+    shape = list(x.shape)
+    in_features = int(np.prod(shape[num_flatten_dims:]))
+    if num_flatten_dims != len(shape) - 1 or len(shape) > 2:
+        x = T.reshape(x, shape[:num_flatten_dims] + [in_features])
+    w = Parameter(I.XavierNormal()((in_features, size), "float32"),
+                  name=(name or "fc") + ".w")
+    out = T.matmul(x, w)
+    if bias_attr is not False:
+        b = Parameter(I.Constant(0.0)((size,), "float32"),
+                      name=(name or "fc") + ".b")
+        out = out + b
+    if activation == "relu":
+        out = F.relu(out)
+    elif activation == "tanh":
+        out = T.tanh(out)
+    elif activation == "sigmoid":
+        out = F.sigmoid(out)
+    elif activation is not None:
+        raise ValueError(f"unsupported activation {activation}")
+    return out

@@ -1,0 +1,172 @@
+"""to_static AST control-flow translation (jit/dy2static.py) and static
+control-flow ops (static/control_flow.py).
+
+Reference patterns: dygraph_to_static tests
+(test_program_translator.py, test_ifelse.py, test_loop.py) and
+control_flow op tests (test_cond.py, test_while_loop_op.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.jit.dy2static import Dy2StaticError
+
+
+# module-level so inspect.getsource works
+@paddle.jit.to_static
+def _loop_fn(x, n):
+    s = x * 0
+    i = paddle.to_tensor(np.array(0, np.int32))
+    while i < n:
+        s = s + x
+        i = i + 1
+    if paddle.sum(s) > 100.0:
+        out = s * 2
+    else:
+        out = s
+    return out
+
+
+@paddle.jit.to_static
+def _bool_ops_fn(x):
+    if paddle.mean(x) > 0 and paddle.max(x) < 100:
+        y = x * 2
+    else:
+        y = x - 1
+    if not (paddle.min(x) > 1e9):
+        y = y + 1
+    return y
+
+
+@paddle.jit.to_static
+def _range_fn(x, n):
+    s = x
+    for _ in range(n):
+        s = s + 1
+    return s
+
+
+@paddle.jit.to_static
+def _one_branch_fn(x):
+    if paddle.sum(x) > 0:
+        y = x * 2
+    return y + x  # noqa: F821 — intentionally one-branch
+
+
+class TestToStaticControlFlow:
+    def test_traced_while_and_if(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out = _loop_fn(x, paddle.to_tensor(np.array(4, np.int32)))
+        np.testing.assert_allclose(out.numpy(), np.full((2, 2), 4.0))
+        # same compiled function, other branch+trip-count
+        out = _loop_fn(x, paddle.to_tensor(np.array(30, np.int32)))
+        np.testing.assert_allclose(out.numpy(), np.full((2, 2), 60.0))
+
+    def test_bool_ops(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out = _bool_ops_fn(x)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 2), 3.0))
+
+    def test_layer_forward_converted(self):
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            def forward(self, x):
+                y = self.lin(x)
+                if paddle.mean(y) > 1e9:
+                    y = y * 0
+                else:
+                    y = y + 1
+                return y
+
+        paddle.seed(3)
+        net = Net()
+        ref = net(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy()
+        sf = paddle.jit.to_static(net)
+        got = sf(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_range_over_traced_value_raises(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with pytest.raises(Dy2StaticError, match="range"):
+            _range_fn(x, paddle.to_tensor(np.array(3, np.int32)))
+
+    def test_one_branch_assignment_raises(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with pytest.raises(Dy2StaticError, match="branch"):
+            _one_branch_fn(x)
+
+
+class TestStaticControlFlowEager:
+    def test_cond(self):
+        x = paddle.to_tensor(np.array(3.0, np.float32))
+        out = static.nn.cond(paddle.to_tensor(True),
+                             lambda: x * 2, lambda: x - 1)
+        assert float(out.numpy()) == 6.0
+        out = static.nn.cond(paddle.to_tensor(False),
+                             lambda: x * 2, lambda: x - 1)
+        assert float(out.numpy()) == 2.0
+
+    def test_cond_mismatched_structure_raises(self):
+        x = paddle.to_tensor(np.array(3.0, np.float32))
+        with pytest.raises(Exception):
+            static.nn.cond(paddle.to_tensor(True),
+                           lambda: (x, x), lambda: x)
+
+    def test_while_loop(self):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        iv, sv = static.nn.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + 2.0),
+            [i, s])
+        assert int(iv.numpy()) == 5
+        assert float(sv.numpy()) == 10.0
+
+    def test_case_and_switch_case(self):
+        x = paddle.to_tensor(np.array(1.0, np.float32))
+        out = static.case(
+            [(paddle.to_tensor(False), lambda: x + 10),
+             (paddle.to_tensor(True), lambda: x + 20)],
+            default=lambda: x)
+        assert float(out.numpy()) == 21.0
+        idx = paddle.to_tensor(np.array(1, np.int32))
+        out = static.switch_case(idx, [lambda: x * 1, lambda: x * 5,
+                                       lambda: x * 9])
+        assert float(out.numpy()) == 5.0
+
+
+class TestStaticControlFlowSymbolic:
+    def test_while_loop_in_program(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            n = static.data("n", [], "int32")
+            i = paddle.to_tensor(np.array(0, np.int32))
+
+            iv, acc = static.nn.while_loop(
+                lambda i, acc: i < n,
+                lambda i, acc: (i + 1, acc + x),
+                [i, x * 0])
+        exe = static.Executor()
+        out = exe.run(prog, feed={"x": np.arange(4, dtype=np.float32),
+                                  "n": np.int32(3)},
+                      fetch_list=[acc])
+        np.testing.assert_allclose(out[0], 3 * np.arange(4, dtype=np.float32))
+
+    def test_cond_in_program(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            pred = paddle.sum(x) > 1.0
+            out = static.nn.cond(pred, lambda: x * 10, lambda: x - 5)
+        exe = static.Executor()
+        hi = exe.run(prog, feed={"x": np.ones(2, np.float32)},
+                     fetch_list=[out])[0]
+        np.testing.assert_allclose(hi, np.full(2, 10.0))
+        lo = exe.run(prog, feed={"x": np.zeros(2, np.float32)},
+                     fetch_list=[out])[0]
+        np.testing.assert_allclose(lo, np.full(2, -5.0))
