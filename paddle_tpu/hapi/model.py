@@ -186,8 +186,15 @@ class Model:
         loader = self._make_loader(test_data, batch_size, False)
         outputs = []
         for batch in loader:
-            ins = batch if isinstance(batch, (list, tuple)) else (batch,)
-            outputs.append(self.predict_batch(list(ins)))
+            ins = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+            # match reference input-arity handling: an explicit inputs spec
+            # wins; otherwise a loss-prepared model treats the trailing
+            # element of an (x, ..., y) dataset item as the label
+            if self._inputs is not None:
+                ins = ins[:len(_to_list(self._inputs))]
+            elif self._loss is not None and len(ins) > 1:
+                ins = ins[:-1]
+            outputs.append(self.predict_batch(ins))
         if stack_outputs:
             n_out = len(outputs[0])
             return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]

@@ -1,0 +1,118 @@
+"""hapi.Model end-to-end (reference python/paddle/tests/test_model.py
+pattern: fit on a small dataset, loss falls, metrics update, checkpoint
+callback writes, predict shapes)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi import callbacks as cbks
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _ToyClassify(Dataset):
+    """Linearly separable 2-class set: loss must fall fast."""
+
+    def __init__(self, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.normal(size=(n, 8)).astype(np.float32)
+        w = rng.normal(size=(8,)).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)[:, None]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    paddle.seed(7)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 2))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(optimizer=opt,
+                  loss=paddle.nn.CrossEntropyLoss(),
+                  metrics=Accuracy())
+    return model
+
+
+class TestModelFit:
+    def test_fit_loss_falls_and_metrics_update(self):
+        model = _model()
+        ds = _ToyClassify()
+        first_losses, last_losses = [], []
+
+        class Recorder(cbks.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                self.epoch = epoch
+
+            def on_train_batch_end(self, step, logs=None):
+                (first_losses if self.epoch == 0 else last_losses).append(
+                    logs["loss"])
+
+        model.fit(ds, batch_size=32, epochs=4, verbose=0,
+                  callbacks=[Recorder()])
+        assert np.mean(last_losses) < 0.5 * np.mean(first_losses)
+
+        res = model.evaluate(ds, batch_size=32, verbose=0)
+        acc = model._metrics[0].accumulate()
+        assert acc > 0.9
+
+    def test_fit_checkpoint_callback_writes(self, tmp_path):
+        model = _model()
+        ds = _ToyClassify(n=64)
+        model.fit(ds, batch_size=32, epochs=2, verbose=0,
+                  save_dir=str(tmp_path))
+        written = sorted(os.listdir(tmp_path))
+        assert any("final" in w or "0" in w for w in written), written
+
+    def test_predict_shapes(self):
+        model = _model()
+        ds = _ToyClassify(n=40)
+        out = model.predict(ds, batch_size=8)
+        assert isinstance(out, list)
+        arr = np.concatenate([np.asarray(o[0] if isinstance(o, (list, tuple))
+                                         else o) for o in out])
+        assert arr.shape == (40, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _model()
+        ds = _ToyClassify(n=64)
+        model.fit(ds, batch_size=32, epochs=1, verbose=0)
+        path = str(tmp_path / "m")
+        model.save(path)
+
+        model2 = _model()
+        model2.load(path)
+        x = paddle.to_tensor(ds.x[:4])
+        got = model2.predict_batch([x])[0]
+        want = model.predict_batch([x])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_train_batch_eager_vs_jit_match(self):
+        ds = _ToyClassify(n=32)
+        m1 = _model()
+        m1._use_jit = True
+        m2 = _model()
+        m2._use_jit = False
+        x = paddle.to_tensor(ds.x[:16])
+        y = paddle.to_tensor(ds.y[:16])
+        for _ in range(3):
+            l1 = m1.train_batch([x], [y])[0]
+            l2 = m2.train_batch([x], [y])[0]
+            np.testing.assert_allclose(l1, l2, rtol=1e-4, atol=1e-5)
+
+    def test_early_stopping(self):
+        model = _model()
+        ds = _ToyClassify(n=64)
+        stopper = cbks.EarlyStopping(monitor="loss", patience=0,
+                                     min_delta=1e9, verbose=0)
+        model.fit(ds, eval_data=ds, batch_size=32, epochs=10, verbose=0,
+                  callbacks=[stopper])
+        # min_delta huge → never an improvement → stops after patience
+        assert model.stop_training
