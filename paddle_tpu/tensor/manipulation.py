@@ -246,7 +246,7 @@ def index_select(x, index, axis=0, name=None):
     return apply_op(_gather, x, index, axis=_ax(axis))
 
 
-def _index_add(x, index, axis, value):
+def _index_add(x, index, value, axis):
     x_m = jnp.moveaxis(x, axis, 0)
     v_m = jnp.moveaxis(value, axis, 0)
     out = x_m.at[index].add(v_m)
@@ -265,12 +265,21 @@ def index_sample(x, index, name=None):
     return apply_op(_index_sample, x, index)
 
 
+def _masked_take(x, flat_idx):
+    return jnp.take(x.reshape(-1), flat_idx)
+
+
 def masked_select(x, mask, name=None):
-    # dynamic-shaped: eager only (not jittable) — mirrors reference semantics
+    # dynamic-shaped: eager only (not jittable) — mirrors reference
+    # semantics. The mask resolves to host indices eagerly; the gather
+    # itself goes through apply_op so gradients flow back to x.
+    import numpy as np
+
     xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     ma = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
-    ma = jnp.broadcast_to(ma, xa.shape)
-    return Tensor(xa[ma])
+    ma = np.broadcast_to(np.asarray(ma), xa.shape)
+    flat_idx = jnp.asarray(np.nonzero(ma.reshape(-1))[0])
+    return apply_op(_masked_take, x, flat_idx=flat_idx)
 
 
 def _where(cond, x, y):
@@ -289,10 +298,13 @@ def where(condition, x=None, y=None, name=None):
     return apply_op(_where, condition, x, y)
 
 
+_py_slice = slice  # the builtin — shadowed below by the paddle op
+
+
 def _slice_op(x, axes, starts, ends):
-    idx = [slice(None)] * x.ndim
+    idx = [_py_slice(None)] * x.ndim
     for ax, st, en in zip(axes, starts, ends):
-        idx[ax] = slice(st, en)
+        idx[ax] = _py_slice(st, en)
     return x[tuple(idx)]
 
 
@@ -303,9 +315,9 @@ def slice(x, axes, starts, ends, name=None):  # noqa: A001
 
 
 def _strided_slice(x, axes, starts, ends, strides):
-    idx = [slice(None)] * x.ndim
+    idx = [_py_slice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
-        idx[ax] = slice(st, en, sd)
+        idx[ax] = _py_slice(st, en, sd)
     return x[tuple(idx)]
 
 
@@ -445,7 +457,7 @@ def as_complex(x, name=None):
 
 
 def _crop(x, offsets, shape):
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    idx = tuple(_py_slice(o, o + s) for o, s in zip(offsets, shape))
     return x[idx]
 
 
