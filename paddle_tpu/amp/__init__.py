@@ -39,6 +39,36 @@ class _AmpState(threading.local):
 _amp_state = _AmpState()
 
 
+def maybe_autocast(*tensors):
+    """O1 white-list cast: when auto_cast is active, cast floating inputs
+    of matmul/conv/linear-class ops to the AMP dtype (bf16 on TPU) so the
+    MXU runs them at full rate. Non-float inputs and disabled state pass
+    through untouched. Returns the inputs as a tuple.
+
+    This is the funnel the reference implements in C++
+    (imperative/amp_auto_cast.cc AmpAutoCasts): called by the compute-heavy
+    functional entry points (linear, conv*, matmul family)."""
+    if not _amp_state.enabled:
+        return tensors
+    dt = _amp_state.dtype
+    out = []
+    for t in tensors:
+        if isinstance(t, Tensor) and jnp.issubdtype(t._data.dtype, jnp.floating) \
+                and t._data.dtype != dt:
+            out.append(_cast_tracked(t, dt))
+        else:
+            out.append(t)
+    return tuple(out)
+
+
+def _cast_tracked(t, dt):
+    """Cast through the op funnel so the tape records the cast (grads come
+    back in the original dtype)."""
+    from ..tensor.manipulation import cast
+
+    return cast(t, dt)
+
+
 def amp_state():
     return _amp_state
 

@@ -174,7 +174,11 @@ def _attention(cfg: GPTConfig, q, k, v):
         return ring_attention_sharded(q, k, v, causal=True, scale=scale,
                                       seq_axis=cfg.seq_axis,
                                       batch_axis="data", head_axis="model")
-    use_flash = cfg.use_flash if cfg.use_flash is not None else _on_tpu()
+    # auto: measured crossover on v5e — XLA's fused attention wins at seq
+    # 512 (219 vs 214 sps BERT-base), the Pallas flash kernel wins at 2048
+    # (38.1 vs 26.0 sps, +47%); see bench.py flash_ab
+    use_flash = (cfg.use_flash if cfg.use_flash is not None
+                 else (_on_tpu() and q.shape[2] >= 1024))
     if use_flash:
         from ..ops.flash_attention import flash_attention_arrays
         return flash_attention_arrays(q, k, v, causal=True, scale=scale)
@@ -225,11 +229,15 @@ def _embed(cfg: GPTConfig, params, tokens):
     return emb + pos[None, :, :]
 
 
-def _logits(params, x):
-    # tied head — fp32 logits for a stable softmax (single source of truth:
-    # used by gpt_forward, gpt_loss, and the chunked CE)
-    return jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
-                      params["wte"].astype(jnp.float32))
+def _logits(params, x, compute_dtype=jnp.bfloat16):
+    # tied head. The matmul runs in bf16 on the MXU with fp32 ACCUMULATION
+    # (preferred_element_type) — fp32 operands would run at 1/4 the MXU
+    # rate for the single biggest matmul in the model (B·S×H×V), while the
+    # fp32 accumulator keeps the softmax numerically stable. The returned
+    # logits are fp32.
+    return jnp.einsum("bsh,vh->bsv", x.astype(compute_dtype),
+                      params["wte"].astype(compute_dtype),
+                      preferred_element_type=jnp.float32)
 
 
 def _head(cfg: GPTConfig, params, x):

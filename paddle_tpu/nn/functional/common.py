@@ -29,6 +29,9 @@ def _linear(x, w, b=None):
 
 
 def linear(x, weight, bias=None, name=None):
+    from ...amp import maybe_autocast
+
+    x, weight = maybe_autocast(x, weight)
     if bias is None:
         return apply_op(_linear, x, weight)
     return apply_op(_linear, x, weight, bias)

@@ -62,10 +62,12 @@ def _conv(x, w, b, strides, padding_kind, pads, dils, groups, n_spatial):
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, n):
+    from ...amp import maybe_autocast
+
+    x, weight = maybe_autocast(x, weight)
     strides = _ntuple(stride, n)
     dils = _ntuple(dilation, n)
     kind, pads = _norm_padding(padding, n)
-    args = (x, weight) if bias is None else (x, weight, bias)
     if bias is None:
         return apply_op(_conv_nobias, x, weight, strides=strides, padding_kind=kind,
                         pads=pads, dils=dils, groups=int(groups), n_spatial=n)

@@ -20,30 +20,34 @@ def _channel_shape(ndim, c, data_format):
 
 
 def _bn_infer(x, mean, var, weight, bias, epsilon, axis):
+    # statistics math in fp32 even for bf16 activations (AMP black-list
+    # semantics: normalization is precision-sensitive); output in x.dtype
+    x32 = x.astype(jnp.float32)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
-    y = (x - mean.reshape(shape)) * inv
+    inv = jax.lax.rsqrt(var.astype(jnp.float32).reshape(shape) + epsilon)
+    y = (x32 - mean.astype(jnp.float32).reshape(shape)) * inv
     if weight is not None:
-        y = y * weight.reshape(shape)
+        y = y * weight.astype(jnp.float32).reshape(shape)
     if bias is not None:
-        y = y + bias.reshape(shape)
-    return y
+        y = y + bias.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype)
 
 
 def _bn_train(x, weight, bias, epsilon, axis):
+    x32 = x.astype(jnp.float32)
     axes = tuple(i for i in range(x.ndim) if i != axis)
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
+    mean = jnp.mean(x32, axis=axes)
+    var = jnp.var(x32, axis=axes)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
-    y = (x - mean.reshape(shape)) * inv
+    y = (x32 - mean.reshape(shape)) * inv
     if weight is not None:
-        y = y * weight.reshape(shape)
+        y = y * weight.astype(jnp.float32).reshape(shape)
     if bias is not None:
-        y = y + bias.reshape(shape)
-    return y, mean, var
+        y = y + bias.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype), mean, var
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

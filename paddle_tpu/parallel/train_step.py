@@ -31,8 +31,12 @@ __all__ = ["DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
 # -- pure optimizers (tree-level) ------------------------------------------
 
 def pure_adamw_init(params):
-    zeros = lambda t: jax.tree_util.tree_map(jnp.zeros_like, t)
-    return {"m": zeros(params), "v": zeros(params),
+    # m/v live in fp32 regardless of the param dtype (the update math is
+    # fp32; allocating them as e.g. bf16 would silently change type at the
+    # first update and break scan carries)
+    zeros32 = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.float32), t)
+    return {"m": zeros32(params), "v": zeros32(params),
             "count": jnp.zeros((), jnp.int32)}
 
 

@@ -153,6 +153,9 @@ def _matmul(x, y, transpose_x=False, transpose_y=False):
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from ..amp import maybe_autocast
+
+    x, y = maybe_autocast(x, y)
     return apply_op(_matmul, x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y))
 
 
@@ -160,6 +163,9 @@ mm = matmul
 
 
 def bmm(x, y, name=None):
+    from ..amp import maybe_autocast
+
+    x, y = maybe_autocast(x, y)
     return apply_op(jnp.matmul, x, y)
 
 
