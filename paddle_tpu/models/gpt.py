@@ -264,7 +264,12 @@ def _pipeline_hidden(cfg: GPTConfig, params, tokens, n_micro):
     if B % n_micro != 0:
         raise ValueError(f"batch {B} not divisible by n_micro {n_micro}")
     x = _embed(cfg, params, tokens)
-    x_micro = x.reshape(n_micro, B // n_micro, S, cfg.hidden)
+    # microbatch index on the INNER dim (x[i] interleaves the batch):
+    # the batch's data/sharding tiling stays on the major dim through the
+    # reshape, so forward and backward layouts cross the pipeline scan
+    # without the SPMD partitioner's replicate-and-repartition fallback.
+    mb = B // n_micro
+    x_micro = x.reshape(mb, n_micro, S, cfg.hidden).transpose(1, 0, 2, 3)
     stage_params = params["blocks"]
     if stage_params["qkv_w"].ndim == 3:  # flat (L, H, 3H) — not yet staged
         stage_params = stack_stages(stage_params, cfg.n_stages)
@@ -273,7 +278,7 @@ def _pipeline_hidden(cfg: GPTConfig, params, tokens, n_micro):
         return _block_stack(cfg, sp, h)
 
     h = pipeline_forward(stage_fn, stage_params, x_micro, cfg.n_stages)
-    return h.reshape(B, S, cfg.hidden)
+    return h.transpose(1, 0, 2, 3).reshape(B, S, cfg.hidden)
 
 
 def _chunked_ce(params, x, labels, chunk: int):

@@ -34,10 +34,11 @@ standard TPU-side bargain (HBM is the binding constraint, MXU is not).
 """
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 __all__ = ["stack_stages", "pipeline_forward"]
 
@@ -59,7 +60,8 @@ def stack_stages(block_params, n_stages: int):
 
 
 def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
-                     n_stages: int, remat: bool = True):
+                     n_stages: int, remat: bool = True,
+                     batch_spec=P(("data", "sharding"))):
     """Run the pipeline schedule; returns per-microbatch outputs.
 
     Args:
@@ -69,9 +71,33 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
         ...) — shard dim 0 over the "pipe" mesh axis.
       x_micro: (n_micro, micro_batch, ...) stage-0 inputs.
       n_stages: pipeline depth (mesh "pipe" size).
+      batch_spec: sharding of the per-microbatch batch dim. The scan CARRY
+        is pinned to P("pipe", batch, ...) — without that, the
+        batch→microbatch reshape leaves the data/sharding tiling on the
+        time axis and every scan-boundary transition forces the
+        partitioner's "involuntary full rematerialization"
+        replicate-and-repartition fallback. (Only the carry is pinned:
+        constraining x_micro/ys too injects transpose-side constraints
+        that conflict with the backward scan's layouts and reintroduce
+        the fallback.)
 
     Returns: (n_micro, micro_batch, ...) final-stage outputs.
     """
+    from .mesh import get_mesh
+    from .sharding import constraint
+
+    have_mesh = get_mesh() is not None
+    batch_entry = tuple(batch_spec)[0] if len(batch_spec) else None
+    trailing = (None,) * (x_micro.ndim - 2)
+    act_spec = P("pipe", batch_entry, *trailing)        # stage dim on "pipe"
+
+    def pin(x, spec):
+        # constraints only make sense inside a jit trace over the mesh;
+        # eager/pure-numpy use (tests, CPU debugging) passes through
+        if not have_mesh or not isinstance(x, jax.core.Tracer):
+            return x
+        return constraint(x, spec)
+
     n_micro = x_micro.shape[0]
     if n_stages == 1:
         return jax.vmap(lambda x: stage_fn(
@@ -82,21 +108,31 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
 
     vstage = jax.vmap(stage_fn)
 
-    def tick(acts, t):
-        # inject microbatch t at stage 0 (clamped read; masked write)
-        inj = jax.lax.dynamic_index_in_dim(
-            x_micro, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
-        inj = jnp.where(t < n_micro, inj, jnp.zeros_like(inj))
-        acts = acts.at[0].set(inj.astype(acts.dtype))
+    # Microbatches ride the scan's xs, zero-padded to T for the drain
+    # ticks. Concatenate is used (not a clamped gather): its transpose is
+    # a plain slice, so the backward keeps scan-native layouts — a gather
+    # here left a scatter-add cotangent whose sharding GSPMD could only
+    # fix with the replicate-and-repartition fallback.
+    pad = jnp.zeros((n_stages - 1,) + x_micro.shape[1:], x_micro.dtype)
+    xs = jnp.concatenate([x_micro, pad], axis=0)
+
+    def tick(acts, xt):
+        xt = pin(xt, P(batch_entry, *trailing))
+        acts = acts.at[0].set(xt.astype(acts.dtype))
+        acts = pin(acts, act_spec)
         # all stages compute in parallel on their held activation
         y = vstage(stage_params, acts)
         # rotate activations one stage forward (XLA: CollectivePermute);
         # emit the last stage's output as this tick's y (scan-stacked, NOT
-        # part of the carry — keeps the carry O(n_stages))
-        return jnp.roll(y, shift=1, axis=0), y[-1]
+        # part of the carry — keeps the carry O(n_stages)). The emitted
+        # slice leaves the pipe-sharded buffer: pin it to the batch layout
+        # so the partitioner reshards directly instead of via its
+        # replicate-and-repartition fallback.
+        out = pin(y[-1], P(batch_entry, *trailing))
+        return pin(jnp.roll(y, shift=1, axis=0), act_spec), out
 
-    acts0 = jnp.zeros(act_shape, x_micro.dtype)
+    acts0 = pin(jnp.zeros(act_shape, x_micro.dtype), act_spec)
     body = jax.checkpoint(tick) if remat else tick
-    _, ys = jax.lax.scan(body, acts0, jnp.arange(T))
+    _, ys = jax.lax.scan(body, acts0, xs)
     # drain: tick t >= n_stages-1 emitted microbatch t-(n_stages-1)
     return ys[n_stages - 1:].astype(x_micro.dtype)

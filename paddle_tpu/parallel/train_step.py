@@ -165,6 +165,14 @@ class DistributedTrainStep:
 
         def step(params, opt_state, batch, lr):
             loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+            # pin grads to the PARAM layout: the ZeRO reshard (m/v carry
+            # the "sharding" axis) then happens at this boundary as a
+            # reduce-scatter, instead of GSPMD propagating the opt-state
+            # sharding backward through the loss (which forces
+            # replicate-and-repartition inside the pipeline scan)
+            grads = jax.tree_util.tree_map(
+                lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                grads, self._param_sh)
             if self._clip is not None:
                 grads, _ = global_norm_clip(grads, self._clip)
             new_params, new_opt = self._update_fn(
