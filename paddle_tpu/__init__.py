@@ -41,6 +41,23 @@ from .device import (
     is_compiled_with_xpu, is_compiled_with_npu, is_compiled_with_tpu,
 )
 
+
+def set_flags(flags):
+    """paddle.set_flags parity (reference pybind global_value_getter_setter):
+    dict of FLAGS_* names → values, stored in the native registry."""
+    from .core import set_flag
+
+    for k, v in dict(flags).items():
+        set_flag(k, v)
+
+
+def get_flags(flags):
+    from .core import get_flag
+
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: get_flag(k) for k in flags}
+
 from . import tensor  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401

@@ -84,7 +84,15 @@ _py_flags = {}
 _py_flags_lock = threading.Lock()
 
 
+# Fast-path mirror of FLAGS_check_nan_inf, read per-op by apply_op (the
+# analog of the reference's post-kernel CheckOpHasNanOrInf gate,
+# operator.cc:1199); a list so importers share the mutable cell.
+check_nan_inf = [False]
+
+
 def set_flag(name: str, value) -> None:
+    if name.endswith("check_nan_inf"):
+        check_nan_inf[0] = str(value).lower() in ("1", "true", "yes", "on")
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

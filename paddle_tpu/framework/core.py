@@ -396,6 +396,21 @@ def set_symbolic_dispatch(fn):
     _symbolic_dispatch_hook[0] = fn
 
 
+# FLAGS_check_nan_inf post-op sanitizer (reference operator.cc:1199-1200 →
+# CheckOpHasNanOrInf after every kernel run). The shared cell lives in
+# core.native so `paddle.set_flags({"FLAGS_check_nan_inf": 1})` flips it.
+from ..core.native import check_nan_inf as _nan_check  # noqa: E402
+
+
+def _check_finite(op_name, outs):
+    for i, o in enumerate(outs):
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
+            if not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: output {i} of op '{op_name}' "
+                    "contains NaN/Inf")
+
+
 def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
     """Run pure function ``fn(*arrays, **attrs)`` on Tensor/array args.
 
@@ -427,6 +442,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
         out, vjp_fn = jax.vjp(f, *arrays)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)
+        if _nan_check[0]:
+            _check_finite(op_name or getattr(fn, "__name__", "op"), outs)
         node = GradNode(
             vjp_fn,
             input_tensors,
@@ -447,6 +464,9 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
     else:
         j = _jitted(fn, attrs) if EAGER_JIT else None
         out = j(*arrays) if j is not None else fn(*arrays, **attrs)
+        if _nan_check[0]:
+            _check_finite(op_name or getattr(fn, "__name__", "op"),
+                          out if isinstance(out, (tuple, list)) else (out,))
     if isinstance(out, (tuple, list)):
         return tuple(Tensor(o) for o in out)
     return Tensor(out)

@@ -1,0 +1,119 @@
+"""Sequence ops.
+
+Parity: reference sequence op family
+(paddle/fluid/operators/sequence_ops/ — 20+ LoD-based ops). TPU-native
+redesign: LoD (level-of-detail offset vectors over a packed buffer) does
+not map to XLA's static shapes; the equivalents here use PADDED dense
+tensors + explicit ``lengths`` arrays — the layout every jax/TPU pipeline
+uses — and cover the ops with meaningful dense analogs:
+
+  sequence_mask     (sequence_mask_op.cc — identical semantics)
+  sequence_pad      (sequence_pad_op.cc: ragged rows → padded + lengths)
+  sequence_unpad    (sequence_unpad_op.cc: padded + lengths → list of rows)
+  sequence_reverse  (sequence_reverse_op.h: per-sequence reversal)
+  sequence_softmax  (sequence_softmax_op.cc: masked softmax over time)
+  sequence_expand   (sequence_expand_op.cc: repeat rows per ref lengths)
+
+Pure-LoD bookkeeping ops (lod_reset, lod_append) have no dense analog and
+are intentionally absent.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
+           "sequence_reverse", "sequence_softmax", "sequence_expand"]
+
+
+def _mask(lengths, maxlen, dtype):
+    r = jnp.arange(maxlen)
+    return (r[None, :] < lengths.reshape(-1, 1)).astype(dtype)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[b] lengths → [b, maxlen] 0/1 mask (reference sequence_mask_op)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(arr)) if arr.size else 0
+    from ...framework import dtype as dtypes
+
+    return apply_op(_mask, x, maxlen=int(maxlen),
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
+    """Pack a list of variable-length rows (or a padded tensor + lengths)
+    into (padded [b, maxlen, ...], lengths [b]).
+
+    Accepts the natural dense-world input: a python list of arrays (the
+    ragged form the reference expressed as LoD).
+    """
+    if isinstance(x, (list, tuple)):
+        seqs = [s._data if isinstance(s, Tensor) else jnp.asarray(s)
+                for s in x]
+        lens = np.array([s.shape[0] for s in seqs], np.int64)
+        m = int(maxlen) if maxlen is not None else int(lens.max())
+        pv = float(pad_value._data) if isinstance(pad_value, Tensor) \
+            else float(pad_value)
+        rows = []
+        for s in seqs:
+            pad_width = [(0, m - s.shape[0])] + [(0, 0)] * (s.ndim - 1)
+            rows.append(jnp.pad(s[:m], pad_width, constant_values=pv))
+        return Tensor(jnp.stack(rows)), Tensor(jnp.asarray(lens))
+    if lengths is None:
+        raise ValueError("sequence_pad on a dense tensor needs lengths")
+    return x, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [b, maxlen, ...] + lengths → list of per-sequence Tensors
+    (dynamic shapes: eager only, like every dense ragged view)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    lens = length._data if isinstance(length, Tensor) else jnp.asarray(length)
+    return [Tensor(arr[i, : int(lens[i])]) for i in range(arr.shape[0])]
+
+
+def _seq_reverse(x, lengths):
+    b, t = x.shape[0], x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    L = lengths.reshape(-1, 1)
+    rev = jnp.where(idx < L, L - 1 - idx, idx)
+    return jnp.take_along_axis(
+        x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1) if x.ndim > 2 else jnp.take_along_axis(x, rev, axis=1)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each row's first ``lengths[i]`` steps, keep padding in place
+    (reference sequence_reverse_op; lengths=None reverses fully)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if lengths is None:
+        lengths = jnp.full((arr.shape[0],), arr.shape[1], jnp.int32)
+    return apply_op(_seq_reverse, x, lengths)
+
+
+def _seq_softmax(x, lengths):
+    t = x.shape[1]
+    mask = jnp.arange(t)[None, :] < lengths.reshape(-1, 1)
+    s = jnp.where(mask, x.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(s, axis=1)
+    return (p * mask).astype(x.dtype)
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Per-sequence softmax over the time dim; padded steps get 0."""
+    return apply_op(_seq_softmax, x, lengths)
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Repeat row i ``ref_lengths[i]`` times (reference sequence_expand
+    with ref_level=0). Host-resolved repeats (static output shape)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    reps = np.asarray(ref_lengths._data if isinstance(ref_lengths, Tensor)
+                      else ref_lengths).astype(np.int64)
+    idx = jnp.asarray(np.repeat(np.arange(arr.shape[0]), reps))
+    return apply_op(lambda a, i: jnp.take(a, i, axis=0), x, idx)

@@ -43,7 +43,7 @@ __all__ = [
     "default_startup_program", "data", "Executor", "CompiledProgram",
     "name_scope", "device_guard", "py_func", "save_inference_model",
     "load_inference_model", "gradients", "append_backward", "nn",
-    "cond", "while_loop",
+    "cond", "while_loop", "BuildStrategy", "ExecutionStrategy", "ParallelEnv",
 ]
 
 _static_mode = [False]
@@ -208,14 +208,98 @@ def _params_for(loss: SymbolicTensor):
             and not t.stop_gradient]
 
 
+class BuildStrategy:
+    """reference details/build_strategy.h surface; knobs that map to XLA
+    decisions are accepted and recorded (fusion/memory-optimize happen in
+    the compiler), the rest are inert parity fields."""
+
+    def __init__(self):
+        self.reduce_strategy = "AllReduce"
+        self.gradient_scale_strategy = "CoeffNumDevice"
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+
+
 class CompiledProgram:
+    """reference compiler.py CompiledProgram: program + build/exec strategy.
+
+    ``with_data_parallel`` marks the program for batch-dim sharding over
+    the "data" axis of the active mesh — the GSPMD replacement for the
+    reference's per-device graph replication (multi_devices_graph_pass);
+    Executor.run shards feeds accordingly when a mesh is active.
+    """
+
     def __init__(self, program, build_strategy=None):
         self.program = program
-        self.build_strategy = build_strategy
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = None
+        self._data_parallel = False
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        self.exec_strategy = exec_strategy
+        return self
 
 
 class ParallelEnv:
-    pass
+    """reference dygraph ParallelEnv: rank / world-size / device info from
+    the distributed environment (fleet.init or the launcher's env)."""
+
+    def __init__(self):
+        from ..distributed import env as _env
+
+        self._rank = _env.get_rank()
+        st = _env.get_state()
+        topo = st.get("topology")
+        self._world_size = topo.world_size() if topo else int(
+            __import__("os").environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    local_rank = rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return self._rank
+
+    @property
+    def current_endpoint(self):
+        import os
+
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        import os
+
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
 
 
 class Executor:
@@ -273,7 +357,9 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
         program = program if program is not None else default_main_program()
+        shard_feeds = False
         if isinstance(program, CompiledProgram):
+            shard_feeds = program._data_parallel
             program = program.program
         if isinstance(program, InferenceProgram):
             vals = program.exported.run(feed or {})
@@ -293,6 +379,21 @@ class Executor:
             k: (v._data if isinstance(v, Tensor) else np.asarray(v))
             for k, v in feed.items()
         }
+        if shard_feeds:
+            from ..parallel.mesh import get_mesh
+
+            mesh = get_mesh()
+            if mesh is not None and "data" in mesh.axis_names:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as PSpec
+
+                sh = NamedSharding(mesh, PSpec("data"))
+                feed_arrays = {
+                    k: jax.device_put(v, sh)
+                    if getattr(v, "ndim", 0) >= 1
+                    and v.shape[0] % mesh.shape["data"] == 0 else v
+                    for k, v in feed_arrays.items()
+                }
         fetch_list = fetch_list or []
         fetch_exprs = []
         for f in fetch_list:

@@ -1,0 +1,133 @@
+"""Custom-op extension API.
+
+Parity: reference ``PD_BUILD_OP`` (paddle/fluid/extension/ — user C++/CUDA
+kernels compiled against installed headers, loaded by
+framework/custom_operator.cc and exposed through
+paddle.utils.cpp_extension.load).
+
+TPU-native redesign: a user "kernel" is a jax-traceable function — most
+usefully a Pallas TPU kernel — registered with an optional custom VJP.
+Registration returns a Tensor-in/Tensor-out callable wired through the
+eager autograd tape AND usable under jit/to_static (the function body is
+pure jax), so one registration covers both worlds the reference needed
+separate op + grad-op registrations for.
+
+    import jax.numpy as jnp
+    from paddle_tpu.utils.custom_op import register_op
+
+    @register_op("my_scale")
+    def my_scale(x, *, factor=2.0):
+        return x * factor            # or a pl.pallas_call kernel
+
+    # custom gradient (optional — default is jax autodiff through the body)
+    @my_scale.def_vjp
+    def my_scale_vjp(residuals, g, *, factor=2.0):
+        (x,) = residuals
+        return (g * factor,)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["register_op", "get_op", "registered_ops", "CustomOp"]
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered custom op: callable on Tensors, differentiable."""
+
+    def __init__(self, name: str, fn: Callable):
+        self.name = name
+        self._raw_fn = fn
+        self._fwd: Optional[Callable] = None
+        self._vjp: Optional[Callable] = None
+        self._impl = fn  # plain body until a custom vjp is attached
+        functools.update_wrapper(self, fn)
+
+    # -- optional custom gradient ------------------------------------------
+    def def_fwd(self, fwd: Callable):
+        """Forward returning (out, residuals) for the custom VJP."""
+        self._fwd = fwd
+        self._rebuild()
+        return fwd
+
+    def def_vjp(self, vjp: Callable):
+        """``vjp(residuals, cotangent, **attrs) -> input cotangents``.
+
+        Without def_fwd, residuals default to the primal inputs tuple.
+        """
+        self._vjp = vjp
+        self._rebuild()
+        return vjp
+
+    def _rebuild(self):
+        if self._vjp is None:
+            self._impl = self._raw_fn
+            return
+        raw, fwd, vjp = self._raw_fn, self._fwd, self._vjp
+
+        # attrs are static for the custom_vjp: build one wrapped fn per
+        # attrs signature (cached) so jax.custom_vjp sees array-only args
+        @functools.lru_cache(maxsize=None)
+        def for_attrs(attr_items):
+            attrs = dict(attr_items)
+
+            @jax.custom_vjp
+            def op(*arrays):
+                return raw(*arrays, **attrs)
+
+            def op_fwd(*arrays):
+                if fwd is not None:
+                    return fwd(*arrays, **attrs)
+                return raw(*arrays, **attrs), arrays
+
+            def op_bwd(residuals, g):
+                return tuple(vjp(residuals, g, **attrs))
+
+            op.defvjp(op_fwd, op_bwd)
+            return op
+
+        def impl(*arrays, **attrs):
+            return for_attrs(tuple(sorted(attrs.items())))(*arrays)
+
+        functools.update_wrapper(impl, raw)
+        self._impl = impl
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **attrs):
+        return apply_op(self._impl, *args, op_name=self.name, **attrs)
+
+
+def register_op(name: str, fn: Optional[Callable] = None) -> CustomOp:
+    """Register a custom op (decorator or direct call).
+
+    Raises on duplicate names, like the reference's op registry
+    (OpInfoMap::Insert PADDLE_ENFORCE on duplicates).
+    """
+    def do(f):
+        if name in _REGISTRY:
+            raise ValueError(f"custom op '{name}' already registered")
+        op = CustomOp(name, f)
+        _REGISTRY[name] = op
+        return op
+
+    if fn is not None:
+        return do(fn)
+    return do
+
+
+def get_op(name: str) -> CustomOp:
+    if name not in _REGISTRY:
+        raise KeyError(f"no custom op named '{name}' "
+                       f"(registered: {sorted(_REGISTRY)})")
+    return _REGISTRY[name]
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
