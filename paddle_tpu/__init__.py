@@ -77,6 +77,7 @@ from . import utils  # noqa: F401
 from . import ops  # noqa: F401
 from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
+from . import quantization  # noqa: F401
 
 from .hapi.model import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401

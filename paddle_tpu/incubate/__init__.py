@@ -9,7 +9,7 @@ from .operators import (
     softmax_mask_fuse_upper_triangle,
 )
 from .tensor import segment_sum, segment_mean, segment_max, segment_min
-from . import operators, optimizer, tensor
+from . import asp, operators, optimizer, tensor
 
 __all__ = [
     "LookAhead",

