@@ -2,6 +2,7 @@
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
 from .datasets import MNIST, FashionMNIST, Cifar10, Cifar100  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
 

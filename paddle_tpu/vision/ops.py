@@ -1,0 +1,263 @@
+"""Detection ops (reference python/paddle/vision/ops.py + the detection op
+family, paddle/fluid/operators/detection/).
+
+TPU-native: every op is pure jnp/lax (vmapped bilinear sampling instead of
+per-ROI CUDA kernels; sigmoid/exp decode as fused elementwise). NMS keeps
+its data-dependent loop on host via a fixed-iteration lax.while formulation
+when traced sizes allow, else eager numpy — dynamic output shapes are
+inherently host-side, as in the reference's CPU kernel.
+
+deform_conv2d / read_file / decode_jpeg are intentionally absent: modulated
+deformable sampling is a gather-heavy op with no TPU-efficient layout (the
+reference only ships CUDA kernels), and file IO ops belong to the input
+pipeline (paddle_tpu.io + PIL/numpy), not the graph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["yolo_box", "roi_align", "roi_pool", "nms", "box_iou",
+           "RoIAlign", "RoIPool"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- yolo_box ---------------------------------------------------------------
+
+def _yolo_box(x, img_size, anchors, class_num, conf_thresh,
+              downsample_ratio, clip_bbox, scale_x_y):
+    n, c, h, w = x.shape
+    s = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(s, 2)
+    x = x.reshape(n, s, 5 + class_num, h, w)
+
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[:, None]
+    alpha = scale_x_y
+    beta = -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * alpha + beta + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * alpha + beta + grid_y) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * w)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:])
+
+    img_h = img_size[:, 0].astype(jnp.float32).reshape(n, 1, 1, 1)
+    img_w = img_size[:, 1].astype(jnp.float32).reshape(n, 1, 1, 1)
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, s * h * w, 4)
+    score = conf[:, :, None] * probs                      # [n,s,cls,h,w]
+    keep = (conf > conf_thresh).astype(score.dtype)[:, :, None]
+    score = (score * keep).transpose(0, 1, 3, 4, 2).reshape(
+        n, s * h * w, class_num)
+    return boxes, score
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output → (boxes [N,S·H·W,4], scores
+    [N,S·H·W,class_num]) (reference vision/ops.py yolo_box over
+    detection/yolo_box_op)."""
+    if iou_aware:
+        raise NotImplementedError("yolo_box: iou_aware not supported")
+    return apply_op(_yolo_box, x, img_size,
+                    anchors=tuple(int(a) for a in anchors),
+                    class_num=int(class_num),
+                    conf_thresh=float(conf_thresh),
+                    downsample_ratio=int(downsample_ratio),
+                    clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+# -- roi align / pool -------------------------------------------------------
+
+def _bilinear(feat, y, x):
+    """feat [C,H,W]; y/x scalar float coords → [C]."""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def at(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = feat[:, yi, xi]
+        ok = (yy >= -1) & (yy <= H) & (xx >= -1) & (xx <= W)
+        return jnp.where(ok, v, 0.0)
+
+    return (at(y0, x0) * wy0 * wx0 + at(y0, x1) * wy0 * wx1 +
+            at(y1, x0) * wy1 * wx0 + at(y1, x1) * wy1 * wx1)
+
+
+def _roi_align(x, boxes, box_image, output_size, spatial_scale,
+               sampling_ratio, aligned):
+    oh, ow = output_size
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(img_idx, box):
+        feat = x[img_idx]
+        x1, y1, x2, y2 = box * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+        bin_h, bin_w = rh / oh, rw / ow
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        iy = (jnp.arange(sr) + 0.5) / sr
+        gy = y1 + (jnp.arange(oh)[:, None] + iy[None, :]) * bin_h  # [oh,sr]
+        gx = x1 + (jnp.arange(ow)[:, None] + iy[None, :]) * bin_w  # [ow,sr]
+        sample = jax.vmap(lambda yy: jax.vmap(
+            lambda xx: _bilinear(feat, yy, xx))(gx.reshape(-1)))(
+                gy.reshape(-1))                      # [oh*sr, ow*sr, C]
+        sample = sample.reshape(oh, sr, ow, sr, -1)
+        return jnp.mean(sample, axis=(1, 3)).transpose(2, 0, 1)  # [C,oh,ow]
+
+    return jax.vmap(one_roi)(box_image, boxes)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference vision/ops.py roi_align over roi_align_op):
+    x [N,C,H,W]; boxes [R,4] (x1,y1,x2,y2); boxes_num [N] rois per image.
+    Returns [R, C, output_size, output_size]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = np.asarray(_arr(boxes_num))
+    box_image = jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+    return apply_op(_roi_align, x, boxes, box_image,
+                    output_size=tuple(int(s) for s in output_size),
+                    spatial_scale=float(spatial_scale),
+                    sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+def _roi_pool(x, boxes, box_image, output_size, spatial_scale):
+    oh, ow = output_size
+
+    def one_roi(img_idx, box):
+        feat = x[img_idx]
+        C, H, W = feat.shape
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        # max over each bin via masked reduction (dense, static-shaped)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        ybin = jnp.clip(jnp.floor((ys - y1) / (rh / oh)), -1, oh).astype(jnp.int32)
+        xbin = jnp.clip(jnp.floor((xs - x1) / (rw / ow)), -1, ow).astype(jnp.int32)
+        inside_y = (ys >= y1) & (ys <= y2)
+        inside_x = (xs >= x1) & (xs <= x2)
+
+        out = jnp.full((C, oh, ow), -jnp.inf, feat.dtype)
+        ymask = (ybin[None, :] == jnp.arange(oh)[:, None]) & inside_y[None, :]
+        xmask = (xbin[None, :] == jnp.arange(ow)[:, None]) & inside_x[None, :]
+        # [oh, H] x [ow, W] masks → per-bin max: einsum-style masked max
+        big_neg = jnp.asarray(-1e30, feat.dtype)
+        f = feat[None, None]                      # [1,1,C,H,W]
+        m = (ymask[:, None, None, :, None] & xmask[None, :, None, None, :])
+        vals = jnp.where(m, f, big_neg)           # [oh,ow,C,H,W]
+        out = jnp.max(vals, axis=(3, 4)).transpose(2, 0, 1)
+        empty = ~(m.any(axis=(3, 4)))             # [oh,ow,C]
+        return jnp.where(empty.transpose(2, 0, 1), 0.0, out)
+
+    return jax.vmap(one_roi)(box_image, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (reference roi_pool_op): max-pool each ROI bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = np.asarray(_arr(boxes_num))
+    box_image = jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+    return apply_op(_roi_pool, x, boxes, box_image,
+                    output_size=tuple(int(s) for s in output_size),
+                    spatial_scale=float(spatial_scale))
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+# -- box utilities ----------------------------------------------------------
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [R1, R2] for (x1,y1,x2,y2) boxes."""
+    a = _arr(boxes1).astype(jnp.float32)
+    b = _arr(boxes2).astype(jnp.float32)
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / (area1[:, None] + area2[None, :] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (reference vision/ops.py nms, eager host semantics —
+    dynamic output size). With category_idxs, NMS is per category."""
+    b = np.asarray(_arr(boxes), np.float32)
+    n = len(b)
+    sc = (np.asarray(_arr(scores), np.float32) if scores is not None
+          else np.arange(n, 0, -1, dtype=np.float32))
+
+    def nms_one(idxs):
+        order = idxs[np.argsort(-sc[idxs])]
+        keep = []
+        iou = np.asarray(box_iou(b, b)._data)
+        alive = list(order)
+        while alive:
+            i = alive.pop(0)
+            keep.append(i)
+            alive = [j for j in alive if iou[i, j] <= iou_threshold]
+        return keep
+
+    if category_idxs is None:
+        keep = nms_one(np.arange(n))
+    else:
+        cats = np.asarray(_arr(category_idxs))
+        keep = []
+        for c in (categories if categories is not None else np.unique(cats)):
+            keep.extend(nms_one(np.where(cats == c)[0]))
+        keep = sorted(keep, key=lambda i: -sc[i])
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep, np.int64)))

@@ -1,0 +1,104 @@
+"""Detection ops (paddle_tpu.vision.ops — reference vision/ops.py +
+operators/detection/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+class TestBoxIoU:
+    def test_known_values(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10],
+                                       [5, 5, 15, 15],
+                                       [20, 20, 30, 30]], np.float32))
+        iou = np.asarray(ops.box_iou(a, b)._data)[0]
+        np.testing.assert_allclose(iou, [1.0, 25 / 175, 0.0], atol=1e-6)
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = paddle.to_tensor(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = np.asarray(ops.nms(boxes, 0.5, scores)._data)
+        np.testing.assert_array_equal(sorted(keep), [0, 2])
+
+    def test_categories(self):
+        boxes = paddle.to_tensor(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+        cats = paddle.to_tensor(np.array([0, 1], np.int64))
+        keep = np.asarray(ops.nms(boxes, 0.5, scores, cats)._data)
+        assert len(keep) == 2  # different categories: both kept
+
+
+class TestRoIAlign:
+    def test_constant_feature(self):
+        x = paddle.to_tensor(np.full((1, 3, 8, 8), 5.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], np.float32))
+        num = paddle.to_tensor(np.array([1], np.int32))
+        out = ops.roi_align(x, boxes, num, output_size=2).numpy()
+        assert out.shape == (1, 3, 2, 2)
+        np.testing.assert_allclose(out, np.full((1, 3, 2, 2), 5.0), atol=1e-5)
+
+    def test_gradient_flows(self):
+        x = paddle.to_tensor(np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32))
+        x.stop_gradient = False
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+        num = paddle.to_tensor(np.array([1], np.int32))
+        out = ops.roi_align(x, boxes, num, output_size=4)
+        paddle.sum(out).backward()
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_linear_ramp(self):
+        # feature = x coordinate; aligned ROI average ≈ bin centers
+        feat = np.tile(np.arange(8, dtype=np.float32)[None, None, None, :],
+                       (1, 1, 8, 1))
+        x = paddle.to_tensor(feat)
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], np.float32))
+        num = paddle.to_tensor(np.array([1], np.int32))
+        out = ops.roi_align(x, boxes, num, output_size=2,
+                            aligned=True).numpy()[0, 0]
+        # 2 bins over [0,8): centers at 1.5 and 5.5 (minus 0.5 align offset)
+        np.testing.assert_allclose(out[0], [1.5, 5.5], atol=0.1)
+
+
+class TestRoIPool:
+    def test_max_per_bin(self):
+        feat = np.zeros((1, 1, 4, 4), np.float32)
+        feat[0, 0] = np.arange(16).reshape(4, 4)
+        x = paddle.to_tensor(feat)
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 3.0, 3.0]], np.float32))
+        num = paddle.to_tensor(np.array([1], np.int32))
+        out = ops.roi_pool(x, boxes, num, output_size=2).numpy()[0, 0]
+        np.testing.assert_allclose(out, [[5, 7], [13, 15]])
+
+
+class TestYoloBox:
+    def test_shapes_and_decode(self):
+        rng = np.random.RandomState(0)
+        n, class_num, h = 2, 3, 4
+        anchors = [10, 13, 16, 30]
+        s = len(anchors) // 2
+        x = paddle.to_tensor(rng.rand(n, s * (5 + class_num), h, h).astype(np.float32))
+        img_size = paddle.to_tensor(np.array([[128, 128], [64, 96]], np.int32))
+        boxes, scores = ops.yolo_box(x, img_size, anchors, class_num,
+                                     conf_thresh=0.0, downsample_ratio=32)
+        assert list(boxes.shape) == [n, s * h * h, 4]
+        assert list(scores.shape) == [n, s * h * h, class_num]
+        b = boxes.numpy()
+        assert (b[0, :, 2] <= 127.0 + 1e-4).all()  # clipped to img_w-1
+        assert (b[:, :, 2] >= b[:, :, 0] - 1e-4).all()
+        sc = scores.numpy()
+        assert (sc >= 0).all() and (sc <= 1).all()
+
+    def test_conf_thresh_zeroes_scores(self):
+        rng = np.random.RandomState(1)
+        anchors = [10, 13]
+        x = paddle.to_tensor((rng.rand(1, 1 * 8, 2, 2) * 0.1 - 3.0).astype(np.float32))
+        img_size = paddle.to_tensor(np.array([[64, 64]], np.int32))
+        _, scores = ops.yolo_box(x, img_size, anchors, 3,
+                                 conf_thresh=0.9, downsample_ratio=32)
+        np.testing.assert_allclose(scores.numpy(), 0.0)
