@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ...framework.core import Tensor
-from ...framework.functional import functional_call
+from ...framework.functional import functional_call, layer_buffers
 from ...nn.clip import ClipGradByGlobalNorm
 from ...nn.layer.layers import Layer
 from ...parallel.mesh import get_mesh, mesh_shape
@@ -37,8 +37,38 @@ __all__ = ["FleetEngine", "build_engine"]
 
 
 def _optimizer_config(optimizer) -> Dict[str, Any]:
-    """Extract (kind, lr, clip_norm, opt_kwargs) from an eager Optimizer."""
-    inner = getattr(optimizer, "_inner_opt", optimizer)
+    """Extract (kind, lr, clip_norm, opt_kwargs) from an eager Optimizer.
+
+    Unwraps meta-optimizer wrappers recursively (HybridParallelOptimizer,
+    GradientMergeOptimizer, ...) to the leaf optimizer. GradientMerge
+    k_steps/avg are surfaced so the engine can fold them into its
+    microbatch accumulation (same math: the engine's batch IS the k merged
+    micro-steps). Unsupported leaf kinds raise — silently training with
+    different math than the user's optimizer is worse than an error."""
+    inner = optimizer
+    merge_k, merge_avg = 1, True
+    seen = set()
+    while hasattr(inner, "_inner_opt") and id(inner) not in seen:
+        seen.add(id(inner))
+        if type(inner).__name__ == "GradientMergeOptimizer":
+            merge_k = int(getattr(inner, "k_steps", 1))
+            merge_avg = bool(getattr(inner, "avg", True))
+        inner = inner._inner_opt
+    from ...regularizer import L1Decay, L2Decay
+
+    def _l2_coeff(o):
+        """Grad-side L2 coefficient of a non-decoupled optimizer."""
+        wd = getattr(o, "_weight_decay", None)
+        if wd is None:
+            return 0.0
+        if isinstance(wd, L2Decay):
+            return float(wd.coeff)
+        if isinstance(wd, L1Decay):
+            raise NotImplementedError(
+                "FleetEngine does not compile L1Decay regularization; "
+                "use the eager train loop.")
+        return float(wd)
+
     kind = type(inner).__name__.lower()
     if "adamw" in kind or "adam" in kind:
         opt = "adamw"
@@ -46,18 +76,43 @@ def _optimizer_config(optimizer) -> Dict[str, Any]:
             "beta1": float(getattr(inner, "_beta1", 0.9)),
             "beta2": float(getattr(inner, "_beta2", 0.999)),
             "eps": float(getattr(inner, "_epsilon", 1e-8)),
-            "weight_decay": float(getattr(inner, "_weight_decay", 0.01) or 0.0)
+            # AdamW: decoupled decay lives in _coeff (optimizer.py:291);
+            # Adam: L2Decay folds into the grad before the moments
+            "weight_decay": float(getattr(inner, "_coeff", 0.0) or 0.0)
             if "adamw" in kind else 0.0,
+            "l2_coeff": 0.0 if "adamw" in kind else _l2_coeff(inner),
         }
-    else:
+        if getattr(inner, "_apply_decay_param_fun", None) is not None:
+            warnings.warn("FleetEngine applies AdamW weight decay uniformly; "
+                          "apply_decay_param_fun is ignored in the compiled "
+                          "step.")
+    elif "momentum" in kind:  # Momentum / LarsMomentum (LARS coeff dropped)
+        opt = "momentum"
+        kwargs = {
+            "momentum": float(getattr(inner, "_momentum", 0.9)),
+            "use_nesterov": bool(getattr(inner, "_use_nesterov", False)),
+            "weight_decay": _l2_coeff(inner),
+        }
+        if type(inner).__name__ == "LarsMomentum":
+            warnings.warn("FleetEngine compiles LarsMomentum as plain "
+                          "momentum (LARS trust-ratio scaling not applied); "
+                          "use Momentum or the eager path for exact LARS.")
+    elif kind == "sgd":
         opt = "sgd"
-        kwargs = {}
+        kwargs = {"weight_decay": _l2_coeff(inner)}
+    else:
+        raise NotImplementedError(
+            f"FleetEngine cannot faithfully compile optimizer "
+            f"{type(inner).__name__}; supported: SGD, Momentum, Adam, "
+            f"AdamW (optionally wrapped in HybridParallelOptimizer/"
+            f"GradientMergeOptimizer). Use the eager train loop for others.")
     clip = getattr(inner, "_grad_clip", None)
     # unwrap HybridParallelClipGrad
     clip = getattr(clip, "_clip", clip)
     clip_norm = float(clip.clip_norm) if isinstance(clip, ClipGradByGlobalNorm) else None
     return {"opt": opt, "opt_kwargs": kwargs, "clip_norm": clip_norm,
-            "lr": lambda _step: float(inner.get_lr()), "inner": inner}
+            "lr": lambda _step: float(inner.get_lr()), "inner": inner,
+            "merge_k": merge_k, "merge_avg": merge_avg}
 
 
 def _named_trainable(layer: Layer):
@@ -147,7 +202,13 @@ class FleetEngine:
         shard_deg = shape.get("sharding", 1)
 
         pcfg = getattr(strategy, "pipeline_configs", {}) or {}
-        self.accumulate_steps = int(pcfg.get("accumulate_steps", 1))
+        # GradientMerge folds into microbatch accumulation: the engine's
+        # batch is the k merged micro-steps, applied in one compiled step.
+        # Composition with pipeline accumulation is multiplicative, like
+        # the eager nesting (k merge boundaries × acc microbatches each).
+        self.accumulate_steps = int(pcfg.get("accumulate_steps", 1)) * \
+            cfg["merge_k"]
+        self._merge_avg = cfg["merge_avg"]
 
         loss_layer = loss_fn
         if loss_layer is None and isinstance(inner_model, PipelineLayer):
@@ -172,35 +233,40 @@ class FleetEngine:
                     "for true SPMD pipelining.")
         if built is None:
             built = self._build_flat(inner_model, loss_arrays)
-        params, specs, step_loss = built
+        params, specs, step_loss, buffers = built
 
         self._write_back_names = list(params)
         self._step = DistributedTrainStep(
             step_loss, params, specs, optimizer=cfg["opt"], lr=cfg["lr"],
             clip_norm=cfg["clip_norm"], zero=shard_deg > 1, mesh=self.mesh,
-            opt_kwargs=cfg["opt_kwargs"])
+            opt_kwargs=cfg["opt_kwargs"], aux=buffers)
 
     # -- builders ------------------------------------------------------------
     def _micro_loss(self, one_loss: Callable):
         """Wrap a per-batch loss into the accumulate_steps scan (identical
         math to eager PipelineParallel.forward_backward_pipeline: mean of
-        per-microbatch mean losses)."""
+        per-microbatch mean losses; sum when GradientMerge avg=False).
+        Buffers (BatchNorm stats) are carried through the scan so each
+        microbatch sees the previous one's updates — eager-loop order."""
         acc = self.accumulate_steps
+        avg = self._merge_avg
 
         if acc <= 1:
             return one_loss
 
-        def scan_loss(params, batch):
+        def scan_loss(params, buffers, batch):
             x, y = batch
             xm = x.reshape(acc, x.shape[0] // acc, *x.shape[1:])
             ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
 
-            def body(total, xy):
-                return total + one_loss(params, xy), None
+            def body(carry, xy):
+                total, buf = carry
+                loss, new_buf = one_loss(params, buf, xy)
+                return (total + loss, new_buf), None
 
-            total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0.0),
-                                    (xm, ym))
-            return total / acc
+            (total, buf), _ = jax.lax.scan(
+                jax.checkpoint(body), (jnp.float32(0.0), buffers), (xm, ym))
+            return (total / acc if avg else total), buf
 
         return scan_loss
 
@@ -208,14 +274,16 @@ class FleetEngine:
         named = _named_trainable(model)
         params = {n: p._data for n, p in named}
         specs = {n: _spec_of(p) for n, p in named}
+        buffers = layer_buffers(model)
         self._write_back = lambda new: self._assign(model, new)
+        self._write_back_buffers = lambda new: self._assign_buffers(model, new)
 
-        def one_loss(params, batch):
+        def one_loss(params, buffers, batch):
             x, y = batch
-            out = functional_call(model, params, x)
-            return loss_arrays(out, y)
+            out, new_buf = functional_call(model, params, x, buffers=buffers)
+            return loss_arrays(out, y), new_buf
 
-        return params, specs, self._micro_loss(one_loss)
+        return params, specs, self._micro_loss(one_loss), buffers
 
     def _build_pipelined(self, pp_layer, loss_arrays, pipe_deg):
         from ...parallel.pipeline import pipeline_forward
@@ -242,6 +310,16 @@ class FleetEngine:
 
         self._pp_meta = (stages, per_stage, layer_count)
         self._write_back = self._assign_pipelined
+        self._write_back_buffers = lambda new: None
+
+        buffers = layer_buffers(pp_layer)
+        if buffers:
+            warnings.warn(
+                "PipelineLayer stages carry buffers (e.g. BatchNorm running "
+                "stats); the SPMD pipeline runs them frozen — updates inside "
+                "the schedule are discarded (fill/drain ticks would pollute "
+                "them). Use LayerNorm/GroupNorm in pipelined models.")
+        buffers = {}
 
         def stage_fn(sp, h):
             for li, layer in enumerate(stage0):
@@ -251,23 +329,31 @@ class FleetEngine:
 
         acc = max(self.accumulate_steps, n_stages)
 
-        def step_loss(params, batch):
+        def step_loss(params, buffers, batch):
             x, y = batch
             xm = x.reshape(acc, x.shape[0] // acc, *x.shape[1:])
             ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
             ys = pipeline_forward(stage_fn, params, xm, n_stages)
             # mean over microbatches of the per-micro loss — identical math
-            # to eager train_batch's accumulation
+            # to eager train_batch's accumulation (sum when GradientMerge
+            # avg=False, matching _micro_loss)
             losses = jax.vmap(lambda o, t: loss_arrays(o, t))(ys, ym)
-            return jnp.mean(losses)
+            return (jnp.mean(losses) if self._merge_avg
+                    else jnp.sum(losses)), buffers
 
-        return stacked, specs, step_loss
+        return stacked, specs, step_loss, buffers
 
     # -- write-back ----------------------------------------------------------
     @staticmethod
     def _assign(model: Layer, new_params: Dict[str, Any]):
         named = dict(model.named_parameters())
         for n, arr in new_params.items():
+            named[n]._data = arr
+
+    @staticmethod
+    def _assign_buffers(model: Layer, new_buffers: Dict[str, Any]):
+        named = {n: b for n, b in model.named_buffers() if b is not None}
+        for n, arr in (new_buffers or {}).items():
             named[n]._data = arr
 
     def _assign_pipelined(self, new_params: Dict[str, Any]):
@@ -289,6 +375,7 @@ class FleetEngine:
         y = y._data if isinstance(y, Tensor) else jnp.asarray(y)
         loss = self._step((x, y))
         self._write_back(self._step.params)
+        self._write_back_buffers(self._step.aux)
         return loss
 
 

@@ -17,7 +17,7 @@ import jax
 
 from .core import Tensor, no_grad
 
-__all__ = ["layer_params", "functional_call"]
+__all__ = ["layer_params", "layer_buffers", "functional_call"]
 
 
 def layer_params(layer, trainable_only: bool = True) -> Dict[str, Any]:
@@ -46,25 +46,48 @@ def _unwrap_out(x):
     return x
 
 
-def functional_call(layer, params: Dict[str, Any], *args, **kwargs):
+def layer_buffers(layer) -> Dict[str, Any]:
+    """Named buffer arrays of a Layer as a flat {name: jax.Array} dict."""
+    return {n: b._data for n, b in layer.named_buffers() if b is not None}
+
+
+def functional_call(layer, params: Dict[str, Any], *args,
+                    buffers: Dict[str, Any] = None, **kwargs):
     """Call ``layer(*args)`` with its parameters substituted by ``params``.
 
     ``params`` maps named_parameters() names to (possibly traced) arrays.
-    The layer's own parameter storage is restored on exit, so this is safe
-    to trace with jax.jit/grad: the traced arrays never leak into eager
-    state. Inputs may be raw arrays or Tensors; the output is unwrapped to
-    raw arrays (matching how jit-able code consumes it).
+    The layer's own parameter AND buffer storage is restored on exit, so
+    this is safe to trace with jax.jit/grad: traced arrays never leak into
+    eager state even when the forward mutates buffers in place (BatchNorm
+    running stats). Inputs may be raw arrays or Tensors; the output is
+    unwrapped to raw arrays (matching how jit-able code consumes it).
+
+    When ``buffers`` is given (a {name: array} dict like
+    :func:`layer_buffers`), those arrays are substituted before the call
+    and the post-forward values are returned alongside the output as
+    ``(out, new_buffers)`` — the functional analog of the reference's
+    in-place persistable-variable updates.
     """
     named = dict(layer.named_parameters())
+    named_buf = {n: b for n, b in layer.named_buffers() if b is not None}
     saved = {}
+    saved_buf = {n: b._data for n, b in named_buf.items()}
     try:
         for name, arr in params.items():
             p = named[name]
             saved[name] = p._data
             p._data = arr
+        if buffers:
+            for name, arr in buffers.items():
+                named_buf[name]._data = arr
         with no_grad():
             out = layer(*_wrap(args), **{k: _wrap(v) for k, v in kwargs.items()})
+        if buffers is not None:
+            new_buffers = {name: named_buf[name]._data for name in buffers}
+            return _unwrap_out(out), new_buffers
+        return _unwrap_out(out)
     finally:
         for name, old in saved.items():
             named[name]._data = old
-    return _unwrap_out(out)
+        for name, old in saved_buf.items():
+            named_buf[name]._data = old

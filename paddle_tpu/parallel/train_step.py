@@ -25,7 +25,8 @@ from .mesh import get_mesh, mesh_shape
 from .sharding import zero_shard_specs
 
 __all__ = ["DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
-           "pure_sgd_init", "pure_sgd_update", "global_norm_clip"]
+           "pure_sgd_init", "pure_sgd_update", "pure_momentum_init",
+           "pure_momentum_update", "global_norm_clip"]
 
 
 # -- pure optimizers (tree-level) ------------------------------------------
@@ -41,7 +42,10 @@ def pure_adamw_init(params):
 
 
 def pure_adamw_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
-                      eps=1e-8, weight_decay=0.01):
+                      eps=1e-8, weight_decay=0.01, l2_coeff=0.0):
+    """weight_decay is AdamW's decoupled decay; l2_coeff is classic Adam's
+    grad-side L2 (added before the moments, reference Optimizer
+    _regularized_grad path)."""
     count = state["count"] + 1
     c = count.astype(jnp.float32)
     bc1 = 1.0 - beta1 ** c
@@ -49,6 +53,8 @@ def pure_adamw_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
 
     def upd(p, g, m, v):
         g32 = g.astype(jnp.float32)
+        if l2_coeff:
+            g32 = g32 + l2_coeff * p.astype(jnp.float32)
         m = beta1 * m + (1 - beta1) * g32
         v = beta2 * v + (1 - beta2) * (g32 * g32)
         step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
@@ -71,11 +77,48 @@ def pure_sgd_init(params):
     return {"count": jnp.zeros((), jnp.int32)}
 
 
-def pure_sgd_update(params, grads, state, lr, **_):
-    new_p = jax.tree_util.tree_map(
-        lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(p.dtype),
-        params, grads)
+def pure_sgd_update(params, grads, state, lr, weight_decay=0.0, **_):
+    def upd(p, g):
+        g32 = g.astype(jnp.float32)
+        if weight_decay:
+            g32 = g32 + weight_decay * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - lr * g32).astype(p.dtype)
+
+    new_p = jax.tree_util.tree_map(upd, params, grads)
     return new_p, {"count": state["count"] + 1}
+
+
+def pure_momentum_init(params):
+    # velocity in fp32, like adamw's m/v (see pure_adamw_init)
+    return {"velocity": jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.float32), params),
+        "count": jnp.zeros((), jnp.int32)}
+
+
+def pure_momentum_update(params, grads, state, lr, momentum=0.9,
+                         use_nesterov=False, weight_decay=0.0):
+    """SGD with (Nesterov) momentum — matches Momentum._pure_update
+    (reference operators/optimizers/momentum_op.h velocity recurrence)."""
+
+    def upd(p, g, v):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if weight_decay:
+            g32 = g32 + weight_decay * p32
+        nv = momentum * v + g32
+        if use_nesterov:
+            p32 = p32 - lr * (g32 + momentum * nv)
+        else:
+            p32 = p32 - lr * nv
+        return p32.astype(p.dtype), nv
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_v = treedef.flatten_up_to(state["velocity"])
+    out = [upd(p, g, v) for p, g, v in zip(flat_p, flat_g, flat_v)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_v = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return new_p, {"velocity": new_v, "count": state["count"] + 1}
 
 
 def global_norm_clip(grads, clip_norm: float):
@@ -92,6 +135,7 @@ def global_norm_clip(grads, clip_norm: float):
 _OPTS = {
     "adamw": (pure_adamw_init, pure_adamw_update),
     "sgd": (pure_sgd_init, pure_sgd_update),
+    "momentum": (pure_momentum_init, pure_momentum_update),
 }
 
 
@@ -112,13 +156,20 @@ class DistributedTrainStep:
         hybrid-dp mode (sharding_optimizer.py, hybrid with dp).
       clip_norm: optional global-norm clip.
       zero: shard optimizer state along "sharding" (ZeRO-1). Default True.
+      aux: optional non-trainable state pytree (buffers: BatchNorm running
+        stats, quant scales) threaded through the step. When given,
+        ``loss_fn`` is ``(params, aux, batch) -> (loss, new_aux)`` and the
+        step keeps ``self.aux`` updated — the functional analog of the
+        reference's in-place persistable-variable mutation. Default
+        replicated; pass aux_specs to shard.
     """
 
     def __init__(self, loss_fn: Callable, params, param_specs,
                  optimizer="adamw", lr: float = 1e-3,
                  batch_spec: P = P(("data", "sharding")),
                  clip_norm: Optional[float] = None, zero: bool = True,
-                 mesh=None, opt_kwargs: Optional[dict] = None):
+                 mesh=None, opt_kwargs: Optional[dict] = None,
+                 aux=None, aux_specs=None):
         self.mesh = mesh or get_mesh()
         if self.mesh is None:
             raise RuntimeError("DistributedTrainStep needs a mesh "
@@ -141,11 +192,19 @@ class DistributedTrainStep:
             zspecs = zero_shard_specs(param_specs, shapes, shard_deg)
         else:
             zspecs = param_specs
-        # m/v mirror the (zero-)sharded param layout; count replicated
-        self.opt_specs = {
-            "m": zspecs, "v": zspecs, "count": P(),
-        } if "m" in opt_state else jax.tree_util.tree_map(
-            lambda _: P(), opt_state, is_leaf=lambda x: hasattr(x, "shape"))
+        # per-param moment trees (m/v/velocity/...) mirror the
+        # (zero-)sharded param layout; scalars (count) replicated
+        param_treedef = jax.tree_util.tree_structure(params)
+
+        def _state_spec(v):
+            try:
+                if jax.tree_util.tree_structure(v) == param_treedef:
+                    return zspecs
+            except Exception:
+                pass
+            return jax.tree_util.tree_map(lambda _: P(), v)
+
+        self.opt_specs = {k: _state_spec(v) for k, v in opt_state.items()}
 
         ns = lambda tree: jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), tree,
@@ -161,10 +220,25 @@ class DistributedTrainStep:
         self.params = jax.device_put(params_copy, self._param_sh)
         self.opt_state = jax.device_put(opt_state, self._opt_sh)
 
+        self._has_aux = aux is not None
+        if self._has_aux:
+            if aux_specs is None:
+                aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
+            self._aux_sh = ns(aux_specs)
+            aux_copy = jax.tree_util.tree_map(lambda x: jnp.array(x), aux)
+            self.aux = jax.device_put(aux_copy, self._aux_sh)
+        else:
+            self.aux = None
+
         batch_sh = NamedSharding(self.mesh, batch_spec)
 
-        def step(params, opt_state, batch, lr):
-            loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+        def step(params, opt_state, aux, batch, lr):
+            if self._has_aux:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(params, aux, batch)
+            else:
+                loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+                new_aux = aux
             # pin grads to the PARAM layout: the ZeRO reshard (m/v carry
             # the "sharding" axis) then happens at this boundary as a
             # reduce-scatter, instead of GSPMD propagating the opt-state
@@ -177,14 +251,15 @@ class DistributedTrainStep:
                 grads, _ = global_norm_clip(grads, self._clip)
             new_params, new_opt = self._update_fn(
                 params, grads, opt_state, lr, **self._opt_kwargs)
-            return new_params, new_opt, loss
+            return new_params, new_opt, new_aux, loss
 
         repl = NamedSharding(self.mesh, P())
+        aux_sh = self._aux_sh if self._has_aux else None
         self._step = jax.jit(
             step,
-            in_shardings=(self._param_sh, self._opt_sh, batch_sh, repl),
-            out_shardings=(self._param_sh, self._opt_sh, repl),
-            donate_argnums=(0, 1),
+            in_shardings=(self._param_sh, self._opt_sh, aux_sh, batch_sh, repl),
+            out_shardings=(self._param_sh, self._opt_sh, aux_sh, repl),
+            donate_argnums=(0, 1, 2) if self._has_aux else (0, 1),
         )
         self._step_count = 0
 
@@ -196,8 +271,8 @@ class DistributedTrainStep:
     def __call__(self, batch):
         lr = jnp.float32(self.current_lr())
         with self.mesh:
-            self.params, self.opt_state, loss = self._step(
-                self.params, self.opt_state, batch, lr)
+            self.params, self.opt_state, self.aux, loss = self._step(
+                self.params, self.opt_state, self.aux, batch, lr)
         self._step_count += 1
         return loss
 
@@ -205,5 +280,5 @@ class DistributedTrainStep:
         """Expose the lowered/compiled artifact (assert-on-HLO testing —
         the TPU analog of the reference's assert-on-op-list meta-optimizer
         tests, SURVEY.md §4.6)."""
-        return self._step.lower(self.params, self.opt_state, batch,
+        return self._step.lower(self.params, self.opt_state, self.aux, batch,
                                 jnp.float32(self.current_lr()))
