@@ -137,7 +137,12 @@ class QuantizedLinear(Layer):
         from ..nn import functional as NF
 
         cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
-        if self.training and not isinstance(x._data, jax.core.Tracer):
+        if self.training:
+            # buffer mutation: under TrainStep/to_static/FleetEngine the
+            # functional_call buffer threading captures this (the moving
+            # average calibrates inside the compiled step); in eager it
+            # updates in place. Either way no tracer leaks — functional_call
+            # snapshots and restores all buffers.
             new = jnp.where(self.act_scale._data == 0.0, cur,
                             self._rate * self.act_scale._data
                             + (1 - self._rate) * cur)
@@ -232,8 +237,10 @@ class _FrozenInt8Linear(Layer):
         self.register_buffer(
             "xscale", Tensor(jnp.asarray(max(act_absmax, 1e-8) / 127.0,
                                          jnp.float32)))
-        self._bias = getattr(layer, "bias", None)
+        # keep the bias as a registered parameter so state_dict/save carry it
+        if getattr(layer, "bias", None) is not None:
+            self.bias = layer.bias
 
     def forward(self, x):
         return quantized_linear(x, self.wq, self.wscale, self.xscale,
-                                self._bias)
+                                getattr(self, "bias", None))

@@ -109,9 +109,11 @@ def _bilinear(feat, y, x):
 
 
 def _roi_align(x, boxes, box_image, output_size, spatial_scale,
-               sampling_ratio, aligned):
+               sampling_ratio, aligned, sr_max):
     oh, ow = output_size
     off = 0.5 if aligned else 0.0
+    adaptive = sampling_ratio <= 0
+    sr = sr_max if adaptive else sampling_ratio
 
     def one_roi(img_idx, box):
         feat = x[img_idx]
@@ -119,15 +121,27 @@ def _roi_align(x, boxes, box_image, output_size, spatial_scale,
         rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
         rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
         bin_h, bin_w = rh / oh, rw / ow
-        sr = sampling_ratio if sampling_ratio > 0 else 2
-        iy = (jnp.arange(sr) + 0.5) / sr
+        if adaptive:
+            # reference roi_align_op: ceil(roi_size / pooled_size) samples
+            # per bin, per ROI. Counts are traced; the grid is padded to
+            # the static sr_max and masked, so shapes stay XLA-static.
+            sry = jnp.clip(jnp.ceil(bin_h), 1, sr).astype(jnp.float32)
+            srx = jnp.clip(jnp.ceil(bin_w), 1, sr).astype(jnp.float32)
+        else:
+            sry = srx = jnp.float32(sr)
+        j = jnp.arange(sr, dtype=jnp.float32)
+        iy, my = (j + 0.5) / sry, j < sry
+        ix, mx = (j + 0.5) / srx, j < srx
         gy = y1 + (jnp.arange(oh)[:, None] + iy[None, :]) * bin_h  # [oh,sr]
-        gx = x1 + (jnp.arange(ow)[:, None] + iy[None, :]) * bin_w  # [ow,sr]
+        gx = x1 + (jnp.arange(ow)[:, None] + ix[None, :]) * bin_w  # [ow,sr]
         sample = jax.vmap(lambda yy: jax.vmap(
             lambda xx: _bilinear(feat, yy, xx))(gx.reshape(-1)))(
                 gy.reshape(-1))                      # [oh*sr, ow*sr, C]
         sample = sample.reshape(oh, sr, ow, sr, -1)
-        return jnp.mean(sample, axis=(1, 3)).transpose(2, 0, 1)  # [C,oh,ow]
+        w = (my.astype(sample.dtype)[None, :, None, None, None]
+             * mx.astype(sample.dtype)[None, None, None, :, None])
+        return (jnp.sum(sample * w, axis=(1, 3)) / (sry * srx)
+                ).transpose(2, 0, 1)                 # [C,oh,ow]
 
     return jax.vmap(one_roi)(box_image, boxes)
 
@@ -136,15 +150,27 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (reference vision/ops.py roi_align over roi_align_op):
     x [N,C,H,W]; boxes [R,4] (x1,y1,x2,y2); boxes_num [N] rois per image.
-    Returns [R, C, output_size, output_size]."""
+    Returns [R, C, output_size, output_size]. sampling_ratio<=0 uses the
+    reference's adaptive ceil(roi_size/output_size) per-ROI sample count
+    (grid padded to the batch max so shapes stay static)."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     bn = np.asarray(_arr(boxes_num))
     box_image = jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+    sr_max = int(sampling_ratio)
+    if sampling_ratio <= 0:
+        b = np.asarray(_arr(boxes), dtype=np.float64)
+        oh, ow = output_size
+        floor = 1e-6 if aligned else 1.0
+        rw = np.maximum((b[:, 2] - b[:, 0]) * spatial_scale, floor)
+        rh = np.maximum((b[:, 3] - b[:, 1]) * spatial_scale, floor)
+        sr_max = int(max(1, np.max(np.ceil(np.concatenate(
+            [rh / oh, rw / ow]))))) if len(b) else 1
     return apply_op(_roi_align, x, boxes, box_image,
                     output_size=tuple(int(s) for s in output_size),
                     spatial_scale=float(spatial_scale),
-                    sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+                    sampling_ratio=int(sampling_ratio), aligned=bool(aligned),
+                    sr_max=sr_max)
 
 
 def _roi_pool(x, boxes, box_image, output_size, spatial_scale):

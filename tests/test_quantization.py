@@ -139,3 +139,51 @@ class TestASP:
         for _, p in model.named_parameters():
             if len(p._data.shape) == 2:
                 assert asp.check_sparsity(p)
+
+
+class TestQATCompiled:
+    """ADVICE r3: QAT act_scale must calibrate inside compiled steps
+    (buffer threading), and PTQ bias must live in state_dict."""
+
+    def test_act_scale_calibrates_under_jit_train_step(self):
+        import paddle_tpu.jit as pjit
+        from paddle_tpu.quantization import ImperativeQuantAware
+
+        paddle.seed(11)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+        qnet = ImperativeQuantAware().quantize(net)
+        ql = qnet[0]
+        assert float(ql.act_scale._data) == 0.0
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=qnet.parameters())
+
+        def loss_fn(run, x, y):
+            out = run(x)
+            return paddle.mean((out - y) ** 2)
+
+        step = pjit.TrainStep(qnet, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32) * 3)
+        y = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        step(x, y)
+        import jax
+        assert not isinstance(ql.act_scale._data, jax.core.Tracer)
+        s1 = float(ql.act_scale._data)
+        assert s1 > 0.0  # calibrated inside the compiled step
+        step(x, y)
+        assert float(ql.act_scale._data) > 0.0
+
+    def test_ptq_bias_in_state_dict(self):
+        from paddle_tpu.quantization import PostTrainingQuantization
+
+        paddle.seed(13)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+        ptq = PostTrainingQuantization(net)
+        x = paddle.to_tensor(np.random.RandomState(1).rand(4, 8)
+                             .astype(np.float32))
+        ptq.collect(x)
+        qnet = ptq.convert()
+        sd = qnet.state_dict()
+        assert any(k.endswith("bias") for k in sd)
+        out = qnet(x)
+        assert np.all(np.isfinite(np.asarray(out._data)))

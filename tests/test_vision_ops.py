@@ -102,3 +102,70 @@ class TestYoloBox:
         _, scores = ops.yolo_box(x, img_size, anchors, 3,
                                  conf_thresh=0.9, downsample_ratio=32)
         np.testing.assert_allclose(scores.numpy(), 0.0)
+
+
+class TestRoIAlignAdaptiveSampling:
+    """ADVICE r3: sampling_ratio<=0 must use the reference's adaptive
+    ceil(roi_size/output_size) sample count, not a fixed 2x2 grid."""
+
+    def _numpy_roi_align(self, feat, box, out_size, sampling=-1):
+        """Scalar-loop reference: aligned=True, one image, one ROI."""
+        C, H, W = feat.shape
+        oh = ow = out_size
+        x1, y1, x2, y2 = box - 0.5
+        rw = max(x2 - x1, 1e-6)
+        rh = max(y2 - y1, 1e-6)
+        bh, bw = rh / oh, rw / ow
+        sry = sampling if sampling > 0 else max(1, int(np.ceil(bh)))
+        srx = sampling if sampling > 0 else max(1, int(np.ceil(bw)))
+
+        def bil(c, y, x):
+            if y < -1 or y > H or x < -1 or x > W:
+                return 0.0
+            y0, x0 = int(np.floor(y)), int(np.floor(x))
+            wy1, wx1 = y - y0, x - x0
+
+            def at(yy, xx):
+                return feat[c, min(max(yy, 0), H - 1), min(max(xx, 0), W - 1)]
+
+            return (at(y0, x0) * (1 - wy1) * (1 - wx1)
+                    + at(y0, x0 + 1) * (1 - wy1) * wx1
+                    + at(y0 + 1, x0) * wy1 * (1 - wx1)
+                    + at(y0 + 1, x0 + 1) * wy1 * wx1)
+
+        out = np.zeros((C, oh, ow), np.float64)
+        for c in range(C):
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for sy in range(sry):
+                        for sx in range(srx):
+                            yy = y1 + (i + (sy + 0.5) / sry) * bh
+                            xx = x1 + (j + (sx + 0.5) / srx) * bw
+                            acc += bil(c, yy, xx)
+                    out[c, i, j] = acc / (sry * srx)
+        return out
+
+    def test_large_roi_matches_adaptive_reference(self):
+        rng = np.random.RandomState(7)
+        feat = rng.rand(2, 16, 16).astype(np.float32)
+        box = np.array([0.0, 0.0, 15.0, 15.0], np.float32)  # bin 7.5 -> sr 8
+        want = self._numpy_roi_align(feat, box.astype(np.float64), 2)
+        x = paddle.to_tensor(feat[None])
+        got = ops.roi_align(x, paddle.to_tensor(box[None]),
+                            paddle.to_tensor(np.array([1], np.int32)),
+                            output_size=2, sampling_ratio=-1).numpy()[0]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_mixed_roi_sizes_each_use_own_count(self):
+        rng = np.random.RandomState(3)
+        feat = rng.rand(1, 16, 16).astype(np.float32)
+        boxes = np.array([[0.0, 0.0, 15.0, 15.0],      # sr 8
+                          [2.0, 2.0, 5.0, 5.0]], np.float32)  # sr 2
+        x = paddle.to_tensor(feat[None])
+        got = ops.roi_align(x, paddle.to_tensor(boxes),
+                            paddle.to_tensor(np.array([2], np.int32)),
+                            output_size=2, sampling_ratio=-1).numpy()
+        for r in range(2):
+            want = self._numpy_roi_align(feat, boxes[r].astype(np.float64), 2)
+            np.testing.assert_allclose(got[r], want, rtol=1e-4, atol=1e-5)
