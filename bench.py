@@ -21,23 +21,35 @@ MFU: 6*N*T model FLOPs over the v5e bf16 peak of 197 TFLOP/s/chip (Cloud
 TPU v5e spec: 197 TFLOPs bf16, 394 TOPs int8 — round-2 used the int8
 number as the denominator, understating MFU 2x).
 
-Baseline: the reference publishes no numbers (BASELINE.md); the driver's
-stated target is >=90% of Paddle A100+NCCL throughput. We use 250
-samples/sec/chip as the ASSUMED A100 BERT-base (seq 512, AMP) pretraining
-figure — the emitted JSON carries "baseline": "assumed" to mark that
-vs_baseline is not a measured comparison.
+Baseline (derived — the reference repo publishes no numbers, BASELINE.md):
+the driver's target is >=90% of Paddle A100+NCCL throughput for the same
+config. Derivation from the public record: NVIDIA DeepLearningExamples
+BERT pretraining phase 2 (seq 512, fp16, DGX A100 8x A100-80GB) reports
+~600 sequences/s for BERT-large => ~75 seq/s per A100. That implies
+MFU = 6*336e6*512*75 / 312e12 = 0.248 of A100's 312 TFLOP/s bf16 peak.
+Transferring the same MFU to BERT-base shapes (110M params):
+0.248 * 312e12 / (6*110e6*512) = 229 seq/s per A100. PaddlePaddle's A100
+BERT implementation (also shipped in DeepLearningExamples) tracks the
+PyTorch one, so 229 samples/sec/chip is the derived A100 Paddle-equivalent
+baseline; the JSON carries baseline: "derived: ..." with this provenance.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 "baseline", "mfu", "flash_ab", "configs"}.
 """
 from __future__ import annotations
 
+import functools
 import json
 import time
 
 import numpy as np
 
-A100_BASELINE_SAMPLES_PER_SEC = 250.0
+# derived A100 BERT-base pretraining figure — see module docstring
+A100_BASELINE_SAMPLES_PER_SEC = 229.0
+BASELINE_PROVENANCE = (
+    "derived: NVIDIA DeepLearningExamples BERT-large phase-2 (seq 512, "
+    "fp16, DGX A100) ~75 seq/s/GPU => MFU 0.248 of 312 TF; same-MFU "
+    "BERT-base (110M) equivalent = 229 seq/s per A100")
 V5E_PEAK_BF16_FLOPS = 197e12  # Cloud TPU v5e: 197 TFLOPs bf16 per chip
 
 
@@ -66,7 +78,10 @@ def _device_step_seconds(cfg, batch, K=10, reps=2, loss_chunk=None,
     labels = jnp.asarray(
         rng.integers(0, cfg.vocab_size, (batch, cfg.seq_len)), jnp.int32)
 
-    @jax.jit
+    # donation matters: without it params+opt live twice (input and
+    # output buffers) — AdamW at >=760M params OOMs a 16GB chip on the
+    # duplicate alone
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def k_steps(params, opt):
         def body(_, carry):
             p, o = carry
@@ -156,10 +171,35 @@ def bench_gpt_1p3b(on_accel):
                                  optimizer="sgd")
     sps = batch / dt
     return {"sps": round(sps, 2), "mfu": round(_mfu(n, cfg.seq_len, sps), 4),
-            "note": "bf16 params + flash + chunked CE, SGD: AdamW state for "
-                    "1.3B (10.6GB fp32 m/v) exceeds one 16GB chip — the "
-                    "ZeRO 'sharding' axis exists for exactly this; hybrid "
-                    "multi-chip path validated by dryrun_multichip"}
+            "note": "bf16 params + flash + chunked CE, SGD: AdamW fp32 m/v "
+                    "for 1.3B (10.6GB) exceeds one 16GB chip even with "
+                    "donation; with ZeRO over 8 chips the per-chip state is "
+                    "2.6GB bf16 params + 1.9GB m/v shard — the dryrun's "
+                    "AdamW+ZeRO hybrid mesh validates exactly that path. "
+                    "See gpt_760m_adamw for the real-optimizer number at "
+                    "the largest single-chip-feasible scale."}
+
+
+def bench_gpt_760m_adamw(on_accel):
+    """Largest GPT config whose FULL AdamW state fits one chip: the
+    real-optimizer counterpart to gpt_1p3b's SGD constraint (VERDICT r3
+    item 9 — report the target optimizer's number, not just SGD's)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import GPTConfig
+
+    if not on_accel:
+        return None
+    cfg = GPTConfig(vocab_size=50304, hidden=1536, n_layers=24, n_heads=16,
+                    seq_len=2048, remat=True, use_flash=True,
+                    param_dtype=jnp.bfloat16)
+    batch = 4
+    dt, n = _device_step_seconds(cfg, batch, K=4, loss_chunk=256,
+                                 optimizer="adamw")
+    sps = batch / dt
+    return {"sps": round(sps, 2), "mfu": round(_mfu(n, cfg.seq_len, sps), 4),
+            "note": "GPT-3 760M, AdamW (fp32 m/v) + bf16 params + flash + "
+                    "chunked CE on one chip"}
 
 
 # -- eager-TrainStep configs (dispatch included: the eager user's view) ----
@@ -252,7 +292,8 @@ def main():
         except Exception as e:  # noqa: BLE001 — auxiliary config must not kill the bench
             configs[name] = f"error: {type(e).__name__}: {e}"
     for name, fn in (("ernie_large_bf16", bench_ernie_large),
-                     ("gpt_1p3b", bench_gpt_1p3b)):
+                     ("gpt_1p3b", bench_gpt_1p3b),
+                     ("gpt_760m_adamw", bench_gpt_760m_adamw)):
         try:
             r = fn(on_accel)
             if r is not None:
@@ -266,7 +307,7 @@ def main():
         "value": round(bert_sps, 2),
         "unit": "samples/sec",
         "vs_baseline": round(bert_sps / A100_BASELINE_SAMPLES_PER_SEC, 4),
-        "baseline": "assumed",
+        "baseline": BASELINE_PROVENANCE,
         "mfu": round(mfu, 4) if mfu else None,
         "peak_flops_note": "MFU = 6NT / 197e12 (v5e bf16 peak; r2 used the "
                            "394e12 int8 figure, understating MFU 2x)",

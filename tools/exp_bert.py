@@ -1,0 +1,33 @@
+"""Experiment: BERT-base xla_512 throughput vs (batch, remat, loss_chunk)."""
+import sys
+import time
+
+import numpy as np
+
+
+def run(batch, remat, loss_chunk, K=10):
+    import jax
+
+    from paddle_tpu.models import bert_base_config
+    from bench import _device_step_seconds, _mfu
+
+    cfg = bert_base_config(remat=remat, use_flash=False, seq_len=512)
+    try:
+        dt, n = _device_step_seconds(cfg, batch, K=K, loss_chunk=loss_chunk)
+    except Exception as e:
+        print(f"b{batch} remat={remat} chunk={loss_chunk}: FAIL {type(e).__name__}: {str(e)[:120]}")
+        return
+    sps = batch / dt
+    print(f"b{batch} remat={remat} chunk={loss_chunk}: {sps:.2f} sps  mfu={_mfu(n, 512, sps):.4f}")
+
+
+if __name__ == "__main__":
+    for batch, remat, chunk in [
+        (16, True, None),
+        (16, False, None),
+        (32, True, None),
+        (32, False, None),
+        (64, True, 256),
+        (32, False, 256),
+    ]:
+        run(batch, remat, chunk)

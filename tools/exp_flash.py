@@ -1,0 +1,76 @@
+"""Sweep flash block sizes on the BERT-base bench config (seq 512 + 2048)."""
+import time
+
+import numpy as np
+
+
+def run(seq, batch, bq, bk, bb, K=8):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.models.gpt as G
+    from bench import _mfu
+    from paddle_tpu.models import bert_base_config, gpt_init, gpt_loss
+    from paddle_tpu.parallel.train_step import pure_adamw_init, pure_adamw_update
+
+    cfg = bert_base_config(remat=True, use_flash=True, seq_len=seq)
+
+    # override attention blocks for this run
+    import sys
+    import paddle_tpu.ops.flash_attention  # noqa: F401
+    FA = sys.modules["paddle_tpu.ops.flash_attention"]
+    orig = G._attention
+
+    def patched(c, q, k, v):
+        import math
+        return FA.flash_attention_arrays(
+            q, k, v, causal=True, scale=1.0 / math.sqrt(c.head_dim),
+            block_q=bq, block_k=bk, block_b=bb)
+
+    G._attention = patched
+    try:
+        rng = np.random.default_rng(0)
+        params = jax.device_put(gpt_init(cfg, seed=0))
+        opt = pure_adamw_init(params)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, cfg.seq_len)), jnp.int32)
+        labels = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, cfg.seq_len)), jnp.int32)
+
+        @jax.jit
+        def k_steps(params, opt):
+            def body(_, carry):
+                p, o = carry
+                _, grads = jax.value_and_grad(
+                    lambda pp: gpt_loss(cfg, pp, (tokens, labels)))(p)
+                return pure_adamw_update(p, grads, o, 1e-4)
+            return jax.lax.fori_loop(0, K, body, (params, opt))
+
+        p2, o2 = k_steps(params, opt)
+        jax.block_until_ready(p2)
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            p2, o2 = k_steps(p2, o2)
+            jax.block_until_ready(p2)
+            best = min(best, (time.perf_counter() - t0) / K)
+        n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+        sps = batch / best
+        print(f"seq{seq} b{batch} bq{bq} bk{bk} bb{bb}: {sps:.2f} sps mfu={_mfu(n, seq, sps):.4f}", flush=True)
+    except Exception as e:
+        print(f"seq{seq} b{batch} bq{bq} bk{bk} bb{bb}: FAIL {type(e).__name__}: {str(e)[:100]}", flush=True)
+    finally:
+        G._attention = orig
+
+
+if __name__ == "__main__":
+    import sys
+    which = sys.argv[1] if len(sys.argv) > 1 else "512"
+    if which == "512":
+        for bq, bk, bb in [(512, 512, 2), (512, 512, 8), (512, 512, 16),
+                           (512, 512, 12), (256, 512, 8)]:
+            run(512, 16, bq, bk, bb)
+    else:
+        for bq, bk, bb in [(2048, 2048, 2), (2048, 1024, None), (1024, 2048, None),
+                           (1024, 2048, 2), (2048, 2048, None)]:
+            run(2048, 4, bq, bk, bb)
