@@ -15,6 +15,10 @@ from .collective import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from .sharding_utils import P, shard_constraint, named_sharding, current_mesh  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, shard_tensor, shard_op, set_shard_mask, set_offload_device,
+    set_pipeline_stage)
+from . import auto_parallel  # noqa: F401
 from . import fleet  # noqa: F401
 
 

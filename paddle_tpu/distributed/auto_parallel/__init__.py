@@ -1,0 +1,337 @@
+"""Semi-auto parallel API — ProcessMesh / shard_tensor / shard_op.
+
+Parity: reference python/paddle/distributed/auto_parallel/interface.py:71
+(ProcessMesh), :295 (shard_tensor), :383 (shard_op), :331/:440/:468
+(set_shard_mask / set_offload_device / set_pipeline_stage), routed through
+``strategy.semi_auto`` (reference fleet_base.py:1423-1430).
+
+TPU-native design: the reference's whole auto-parallel stack — dist-attr
+completion (completion.py), partitioner.py program rewriting, reshard.py
+send/recv insertion — IS the GSPMD partitioner. Here an annotation becomes
+a ``jax.sharding.PartitionSpec``:
+
+- ``ProcessMesh`` wraps the topology as a 4-axis ``jax.sharding.Mesh``
+  (singleton axes padded), so every existing engine path (ZeRO, TP,
+  pipeline, DP batch split) works unchanged on top of it.
+- ``shard_tensor(x, mesh, dim_mapping)`` stores the PartitionSpec on the
+  tensor (``x.sharding``); eager Parameters carry it into
+  DistributedTrainStep/FleetEngine, and traced arrays get a
+  ``with_sharding_constraint`` so XLA inserts exactly the collectives the
+  reference's reshard pass would have coded by hand.
+- ``shard_op(op_fn, mesh, dim_mapping_dict)`` constrains the op's inputs /
+  outputs; the "completion" of every unannotated tensor is GSPMD's sharding
+  propagation, which is the same fixed-point algorithm completion.py
+  approximates.
+"""
+from __future__ import annotations
+
+import warnings
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...framework.core import Tensor
+from ...parallel.mesh import AXES, get_mesh, set_mesh
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "set_shard_mask",
+           "set_offload_device", "set_pipeline_stage", "get_default_mesh"]
+
+# dim-name defaults by mesh arity; chosen so the data axis always exists
+# (DistributedTrainStep shards batches over ("data", "sharding")) and a 2-D
+# mesh matches the common dp x mp usage of the reference examples
+_DEFAULT_DIM_NAMES = {
+    1: ("data",),
+    2: ("data", "model"),
+    3: ("data", "sharding", "model"),
+    4: ("data", "sharding", "pipe", "model"),
+}
+
+# the root (first-created) ProcessMesh — what fleet's semi_auto init adopts
+_root_mesh: Optional["ProcessMesh"] = None
+
+# id(tensor) -> {"mesh": ProcessMesh, "dim_mapping": [...], ...}; Tensor has
+# __slots__ (no attr bag) and elementwise __eq__ (no WeakKeyDictionary), so
+# dist attrs live here keyed by id with a weakref finalizer for cleanup
+_dist_attrs: Dict[int, dict] = {}
+
+
+def _attrs_for(x: Tensor) -> dict:
+    key = id(x)
+    if key not in _dist_attrs:
+        _dist_attrs[key] = {}
+        try:
+            weakref.finalize(x, _dist_attrs.pop, key, None)
+        except TypeError:
+            pass
+    return _dist_attrs[key]
+
+
+def get_dist_attr(x: Tensor) -> dict:
+    """Distributed attributes previously attached by shard_tensor & co."""
+    return dict(_dist_attrs.get(id(x), {}))
+
+
+class ProcessMesh:
+    """Topology of logical processes (reference interface.py:71).
+
+    ``mesh`` is an N-D nested list of unique process ids, e.g.
+    ``ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])`` is a [2, 4] topology.
+    ``dim_names`` (TPU extension, matches later reference versions) names
+    each topology dim with one of the Fleet mesh axes
+    ("data"/"sharding"/"pipe"/"model"); defaults by arity so dim 0 is
+    always the data axis.
+    """
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 parent: Optional["ProcessMesh"] = None):
+        global _root_mesh
+        if mesh is None or not isinstance(mesh, (list, tuple)):
+            raise ValueError("mesh must be a (nested) list of process ids")
+        arr = np.array(mesh)
+        self._topology: List[int] = list(arr.shape)
+        self._processes: List[int] = [int(v) for v in arr.flatten()]
+        if min(self._processes) < 0:
+            raise ValueError("all elements of mesh must be >= 0")
+        if len(set(self._processes)) != len(self._processes):
+            raise ValueError("all elements of mesh must be unique")
+        self.parent = parent
+        if parent is None and min(self._processes) == 0 and \
+                max(self._processes) != len(self._processes) - 1:
+            raise ValueError(
+                "for a root ProcessMesh, process ids must be a permutation "
+                "of range(N)")
+        if dim_names is None:
+            dim_names = _DEFAULT_DIM_NAMES.get(len(self._topology))
+            if dim_names is None:
+                raise ValueError(f"mesh rank {len(self._topology)} > 4; "
+                                 "pass dim_names explicitly")
+        if len(dim_names) != len(self._topology):
+            raise ValueError("dim_names must match mesh rank")
+        bad = [d for d in dim_names if d not in AXES]
+        if bad:
+            raise ValueError(f"dim_names must be from {AXES}, got {bad}")
+        if len(set(dim_names)) != len(dim_names):
+            raise ValueError("dim_names must be unique")
+        self._dim_names = tuple(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+        if _root_mesh is None and parent is None:
+            _root_mesh = self
+
+    # -- reference surface ---------------------------------------------------
+    @property
+    def topology(self) -> List[int]:
+        return list(self._topology)
+
+    shape = topology
+
+    @property
+    def process_group(self) -> List[int]:
+        return list(self._processes)
+
+    processes = process_group
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self) -> int:
+        return len(self._topology)
+
+    def set_placement(self, order: Sequence[int]):
+        """Map logical process ids to physical device indices (reference
+        interface.py set_placement): order[i] is the physical device for
+        logical process i."""
+        if sorted(order) != sorted(self._processes):
+            raise ValueError("placement must be a permutation of the mesh's "
+                             "process ids")
+        self._placement = list(order)
+        self._jax_mesh = None
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._topology == other._topology
+                and self._processes == other._processes)
+
+    def __hash__(self):
+        return hash((tuple(self._topology), tuple(self._processes)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(topology={self._topology}, "
+                f"dim_names={self._dim_names})")
+
+    # -- jax bridge ----------------------------------------------------------
+    def as_jax_mesh(self, devices=None) -> Mesh:
+        """The 4-axis Fleet jax Mesh (singleton axes padded) over the
+        devices selected by this mesh's process ids."""
+        if self._jax_mesh is not None and devices is None:
+            return self._jax_mesh
+        all_devs = list(devices if devices is not None else jax.devices())
+        placement = getattr(self, "_placement", None)
+        ids = [placement[p] for p in self._processes] if placement \
+            else self._processes
+        if max(ids) >= len(all_devs):
+            raise RuntimeError(
+                f"ProcessMesh needs device id {max(ids)} but only "
+                f"{len(all_devs)} devices are available")
+        sel = np.array([all_devs[i] for i in ids]).reshape(self._topology)
+        # expand to the canonical 4-axis order with singleton padding
+        full_shape = [1] * len(AXES)
+        src_axes = []
+        for name, size in zip(self._dim_names, self._topology):
+            full_shape[AXES.index(name)] = size
+            src_axes.append(AXES.index(name))
+        # transpose source dims into AXES order, then pad
+        order = np.argsort(src_axes)
+        sel = sel.transpose(order).reshape(full_shape)
+        mesh = Mesh(sel, AXES)
+        if devices is None:
+            self._jax_mesh = mesh
+        return mesh
+
+    def install(self, devices=None) -> Mesh:
+        """Make this the process-global mesh (parallel.mesh.set_mesh)."""
+        mesh = self.as_jax_mesh(devices)
+        set_mesh(mesh)
+        return mesh
+
+
+def get_default_mesh() -> Optional[ProcessMesh]:
+    """The root ProcessMesh (first created), if any."""
+    return _root_mesh
+
+
+def reset_auto_parallel_state():
+    """Test hook: forget the root mesh and all dist attrs."""
+    global _root_mesh
+    _root_mesh = None
+    _dist_attrs.clear()
+
+
+def _spec_from_mapping(mesh: ProcessMesh, dim_mapping: Sequence[int],
+                       ndim: int) -> P:
+    if len(dim_mapping) != ndim:
+        raise ValueError(
+            f"dim_mapping {list(dim_mapping)} must have one entry per "
+            f"tensor dim ({ndim})")
+    entries = []
+    used = set()
+    for m in dim_mapping:
+        if m == -1:
+            entries.append(None)
+            continue
+        if not (0 <= m < mesh.ndim):
+            raise ValueError(f"dim_mapping entry {m} out of range for "
+                             f"mesh rank {mesh.ndim}")
+        name = mesh.dim_names[m]
+        if name in used:
+            raise ValueError(f"mesh dim {m} used for more than one tensor "
+                             "dim")
+        used.add(name)
+        entries.append(name)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def shard_tensor(x, mesh: ProcessMesh, dim_mapping: Sequence[int]):
+    """Annotate tensor ``x``: tensor dim i is split over mesh dim
+    ``dim_mapping[i]`` (-1 = replicated on that dim). Reference
+    interface.py:295.
+
+    Eager Tensors/Parameters keep the PartitionSpec on ``.sharding`` (the
+    engine reads it); arrays inside a jit trace additionally get a
+    ``with_sharding_constraint`` so GSPMD pins the layout at this point.
+    """
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    spec = _spec_from_mapping(mesh, dim_mapping, x.ndim)
+    attrs = _attrs_for(x)
+    attrs["mesh"] = mesh
+    attrs["dim_mapping"] = list(dim_mapping)
+    x.sharding = spec
+    data = x._data
+    if isinstance(data, jax.core.Tracer):
+        jmesh = get_mesh() or mesh.as_jax_mesh()
+        x._data = jax.lax.with_sharding_constraint(
+            data, jax.sharding.NamedSharding(jmesh, spec))
+    return x
+
+
+def shard_op(op_fn, mesh: ProcessMesh, dim_mapping_dict=None, **kwargs):
+    """Run ``op_fn(**kwargs)`` with sharding annotations (reference
+    interface.py:383).
+
+    ``dim_mapping_dict`` maps *kwarg names* to dim_mappings (annotating the
+    op's inputs) and/or integer output indices to dim_mappings (annotating
+    the op's outputs). With None, the op runs unannotated and GSPMD
+    propagates shardings through it — the analog of the reference's
+    completion pass filling in unspecified dist attrs.
+    """
+    dim_mapping_dict = dict(dim_mapping_dict or {})
+    for name, arg in list(kwargs.items()):
+        if name in dim_mapping_dict and isinstance(arg, Tensor):
+            kwargs[name] = shard_tensor(arg, mesh, dim_mapping_dict[name])
+    out = op_fn(**kwargs)
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    for i, o in enumerate(outs):
+        if i in dim_mapping_dict and isinstance(o, Tensor):
+            outs[i] = shard_tensor(o, mesh, dim_mapping_dict[i])
+    if isinstance(out, tuple):
+        return tuple(outs)
+    if isinstance(out, list):
+        return outs
+    return outs[0]
+
+
+def set_shard_mask(x, mask):
+    """Reference interface.py:331 keeps a tensor off some processes of its
+    mesh. GSPMD has no per-device placement mask — a PartitionSpec either
+    shards or replicates a dim — so the mask is recorded as metadata and
+    placement stays with the partitioner. Recorded, advisory only."""
+    if not isinstance(x, Tensor):
+        raise TypeError("set_shard_mask expects a Tensor")
+    attrs = _attrs_for(x)
+    if "mesh" not in attrs:
+        raise RuntimeError("set process mesh for the tensor first "
+                           "(shard_tensor)")
+    np_mask = np.array(mask)
+    if list(np_mask.shape) != attrs["mesh"].topology:
+        raise ValueError("mask shape must equal the mesh topology")
+    if not np.isin(np_mask, (0, 1)).all():
+        raise ValueError("mask values must be 0 or 1")
+    attrs["mask"] = np_mask.tolist()
+    warnings.warn("set_shard_mask is advisory on TPU: GSPMD decides "
+                  "physical placement; the mask is recorded in the "
+                  "tensor's dist attrs only")
+    return x
+
+
+def set_offload_device(x, device):
+    """Reference interface.py:440 pins a tensor to an offload device
+    ("cpu"). Recorded as metadata; the TPU runtime keeps persistent state
+    in HBM (host offload is a jax.device_put decision at checkpoint time,
+    framework/checkpoint.py)."""
+    if not isinstance(x, Tensor):
+        raise TypeError("set_offload_device expects a Tensor")
+    _attrs_for(x)["offload_device"] = str(device)
+    return x
+
+
+def set_pipeline_stage(stage):
+    """Reference interface.py:468 sets the current pipeline stage for
+    subsequently created ops. Here it tags the global context; PipelineLayer
+    / LayerDesc stage assignment is the mechanism that actually places
+    layers (fleet/meta_parallel/pp_layers.py)."""
+    global _current_pipeline_stage
+    _current_pipeline_stage = int(stage)
+
+
+_current_pipeline_stage = 0
+
+
+def get_pipeline_stage() -> int:
+    return _current_pipeline_stage

@@ -51,25 +51,47 @@ class Fleet:
                 "DistributedStrategy.a_sync (async parameter server) is "
                 "not supported on TPU — see README 'Parameter server "
                 "decision'")
-        hc = self._strategy.hybrid_configs
-        dp = int(hc.get("dp_degree", 1))
-        mp = int(hc.get("mp_degree", 1))
-        pp = int(hc.get("pp_degree", 1))
-        sh = int(hc.get("sharding_degree", 1))
-        n_needed = dp * mp * pp * sh
-        devs = np.array(jax.devices())
-        if n_needed <= 1:
-            # pure DP over all devices
-            dp = len(devs)
-            n_needed = dp
-        if len(devs) < n_needed:
-            raise RuntimeError(
-                f"hybrid_configs needs {n_needed} devices, have {len(devs)}")
-        devs = devs[:n_needed].reshape(dp, pp, sh, mp)
-        self._mesh = jax.sharding.Mesh(devs, ("data", "pipe", "sharding", "model"))
-        from ....parallel.mesh import set_mesh
+        if getattr(self._strategy, "semi_auto", False) or \
+                getattr(self._strategy, "auto", False):
+            # semi-auto route (reference fleet_base.py:1423-1430): the mesh
+            # comes from the user's ProcessMesh annotations, not
+            # hybrid_configs; GSPMD is the parallelizer
+            from ...auto_parallel import get_default_mesh
 
-        set_mesh(self._mesh)
+            pm = get_default_mesh()
+            if pm is not None:
+                self._mesh = pm.install()
+            else:
+                devs = np.array(jax.devices())
+                self._mesh = jax.sharding.Mesh(
+                    devs.reshape(len(devs), 1, 1, 1),
+                    ("data", "pipe", "sharding", "model"))
+                from ....parallel.mesh import set_mesh
+
+                set_mesh(self._mesh)
+            ms = dict(self._mesh.shape)
+            dp, pp = ms["data"], ms["pipe"]
+            sh, mp = ms["sharding"], ms["model"]
+        else:
+            hc = self._strategy.hybrid_configs
+            dp = int(hc.get("dp_degree", 1))
+            mp = int(hc.get("mp_degree", 1))
+            pp = int(hc.get("pp_degree", 1))
+            sh = int(hc.get("sharding_degree", 1))
+            n_needed = dp * mp * pp * sh
+            devs = np.array(jax.devices())
+            if n_needed <= 1:
+                # pure DP over all devices
+                dp = len(devs)
+                n_needed = dp
+            if len(devs) < n_needed:
+                raise RuntimeError(
+                    f"hybrid_configs needs {n_needed} devices, have {len(devs)}")
+            devs = devs[:n_needed].reshape(dp, pp, sh, mp)
+            self._mesh = jax.sharding.Mesh(devs, ("data", "pipe", "sharding", "model"))
+            from ....parallel.mesh import set_mesh
+
+            set_mesh(self._mesh)
         self._topology = CommunicateTopology(("data", "pipe", "sharding", "model"),
                                              (dp, pp, sh, mp))
         self._hcg = HybridCommunicateGroup(self._topology, env.get_rank())
@@ -109,12 +131,16 @@ class Fleet:
         sharded step); else eager DataParallel."""
         from ..meta_parallel.pp_layers import PipelineLayer
         from ..meta_parallel.pipeline_parallel import PipelineParallel
-        from ..meta_parallel.tensor_parallel import (ShardingParallel,
+        from ..meta_parallel.tensor_parallel import (SemiAutoParallel,
+                                                     ShardingParallel,
                                                      TensorParallel)
         from ...parallel import DataParallel
 
         if self._hcg is None:
             self.init()
+        if getattr(self._strategy, "semi_auto", False) or \
+                getattr(self._strategy, "auto", False):
+            return SemiAutoParallel(model, self._hcg, self._strategy)
         if self._hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
             return PipelineParallel(model, self._hcg, self._strategy)
         if self._hcg.get_model_parallel_world_size() > 1:

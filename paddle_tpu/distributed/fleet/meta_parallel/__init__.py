@@ -7,4 +7,4 @@ from .parallel_layers.random import (  # noqa: F401
 )
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer, SegmentLayers  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
-from .tensor_parallel import TensorParallel, ShardingParallel, MetaParallelBase  # noqa: F401
+from .tensor_parallel import TensorParallel, ShardingParallel, SemiAutoParallel, MetaParallelBase  # noqa: F401

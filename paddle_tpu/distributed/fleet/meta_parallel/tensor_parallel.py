@@ -63,3 +63,12 @@ class ShardingParallel(MetaParallelBase):
     GSPMD the param broadcast is unnecessary; train_batch compiles the step
     with optimizer state sharded along the "sharding" axis (ZeRO-1)."""
     pass
+
+
+class SemiAutoParallel(MetaParallelBase):
+    """strategy.semi_auto wrapper: the model's shard_tensor annotations
+    (distributed/auto_parallel) carry the placement; train_batch compiles
+    one GSPMD step where every unannotated tensor's layout is completed by
+    the partitioner — the TPU analog of the reference's
+    completion.py + partitioner.py + reshard.py pipeline."""
+    pass

