@@ -124,51 +124,113 @@ def _spec_of(p) -> P:
     return s if isinstance(s, P) else P()
 
 
-def _stage_layer_lists(pp_layer) -> Optional[List[List[Layer]]]:
-    """Per-stage sublayer lists, or None if any stage holds a bare callable
-    (no parameters to stack)."""
-    stages: List[List[Layer]] = [[] for _ in range(pp_layer.get_num_stages())]
+def _stage_layer_lists(pp_layer) -> List[list]:
+    """Per-stage unit lists. Units are Layers or bare callables (e.g.
+    SharedLayerDesc forward_func partials); the uniformity analysis
+    decides what can ride the SPMD pipeline."""
+    stages: List[list] = [[] for _ in range(pp_layer.get_num_stages())]
     for fn, s in zip(pp_layer.run_function, pp_layer._stage_of_layer):
-        if not isinstance(fn, Layer):
-            return None
         stages[s].append(fn)
     return stages
 
 
-def _uniform_stages(stages: List[List[Layer]]):
-    """If every stage's param tree matches stage 0 structurally, return
-    (per_stage_param_lists, shapes_ok). Shared layers (tied weights across
-    stages) break uniformity — their params appear in several stages."""
-    seen = set()
-    per_stage = []
-    for st in stages:
-        trees = []
-        for layer in st:
-            d = {}
-            for n, p in layer.named_parameters():
-                if p.stop_gradient:
-                    continue
-                if id(p) in seen:
-                    return None  # tied weight spans stages
-                d[n] = p
-            trees.append(d)
-        for d in trees:
-            seen.update(id(p) for p in d.values())
-        per_stage.append(trees)
-    ref = per_stage[0]
-    for other in per_stage[1:]:
-        if len(other) != len(ref):
+def _underlying_layer(unit) -> Optional[Layer]:
+    """The Layer carrying a unit's params (the unit itself, or the layer
+    captured by a SharedLayerDesc forward_func partial)."""
+    from functools import partial as _partial
+
+    if isinstance(unit, Layer):
+        return unit
+    if isinstance(unit, _partial):
+        for a in list(unit.args) + list(unit.keywords.values()):
+            if isinstance(a, Layer):
+                return a
+    return None
+
+
+def _unit_params(unit):
+    layer = _underlying_layer(unit)
+    if layer is None:
+        return {}
+    return {n: p for n, p in layer.named_parameters() if not p.stop_gradient}
+
+
+def _unit_signature(unit):
+    """Structural signature for middle-stage matching; None = cannot sit in
+    the vmapped middle (bare callable)."""
+    if not isinstance(unit, Layer):
+        return None
+    return tuple(sorted(
+        (n, tuple(p._data.shape), str(p._data.dtype), str(_spec_of(p)))
+        for n, p in unit.named_parameters() if not p.stop_gradient))
+
+
+def _split_stages(stages: List[list]):
+    """Decompose stages into (prologue, middle_per_stage, epilogue).
+
+    The SPMD pipeline vmaps one stage body over the stage dim, which needs
+    structurally identical per-stage unit stacks. Real models break that
+    only at the edges — embedding on stage 0, tied-head/loss prep on the
+    last stage (reference SharedLayerDesc, pp_layers.py:208-280). Those
+    edge units are peeled off: the prologue runs on the full batch before
+    microbatching, the epilogue per microbatch after the drain, and a
+    weight shared between them appears ONCE in the param tree so autodiff
+    sums its gradient contributions — the same math as the reference's
+    allreduce over the tied stages' grads (pp_layers.py:268-281).
+
+    Returns None when no uniform middle exists (engine falls back to the
+    microbatch-scan compile).
+    """
+    n = len(stages)
+
+    def match(a_units, b_units):
+        if len(a_units) != len(b_units):
+            return False
+        for a, b in zip(a_units, b_units):
+            sa, sb = _unit_signature(a), _unit_signature(b)
+            if sa is None or sb is None or sa != sb:
+                return False
+        return True
+
+    def try_m(m):
+        if m < 1 or len(stages[0]) < m or len(stages[-1]) < m:
             return None
-        for a, b in zip(ref, other):
-            if sorted(a) != sorted(b):
+        mids = [stages[0][len(stages[0]) - m:]] + \
+            [stages[s] for s in range(1, n - 1)] + [stages[-1][:m]]
+        ref = mids[0]
+        if any(_unit_signature(u) is None for u in ref):
+            return None
+        for other in mids[1:]:
+            if not match(ref, other):
                 return None
-            for k in a:
-                if tuple(a[k]._data.shape) != tuple(b[k]._data.shape) or \
-                        a[k]._data.dtype != b[k]._data.dtype:
+        # tied weights must not touch the middle (a weight shared between
+        # a middle stage and anything else cannot be stage-stacked)
+        mid_ids = set()
+        for st in mids:
+            for u in st:
+                for p in _unit_params(u).values():
+                    if id(p) in mid_ids:
+                        return None
+                    mid_ids.add(id(p))
+        prologue = stages[0][:len(stages[0]) - m]
+        epilogue = stages[-1][m:]
+        for u in list(prologue) + list(epilogue):
+            for p in _unit_params(u).values():
+                if id(p) in mid_ids:
                     return None
-                if _spec_of(a[k]) != _spec_of(b[k]):
-                    return None
-    return per_stage
+        return prologue, mids, epilogue
+
+    if n > 2:
+        # middle stages fix m
+        inner_lens = {len(stages[s]) for s in range(1, n - 1)}
+        if len(inner_lens) != 1:
+            return None
+        return try_m(inner_lens.pop())
+    for m in range(min(len(stages[0]), len(stages[-1])), 0, -1):
+        got = try_m(m)
+        if got is not None:
+            return got
+    return None
 
 
 class FleetEngine:
@@ -289,18 +351,19 @@ class FleetEngine:
         from ...parallel.pipeline import pipeline_forward
 
         stages = _stage_layer_lists(pp_layer)
-        if stages is None:
+        split = _split_stages(stages)
+        if split is None:
             return None
-        per_stage = _uniform_stages(stages)
-        if per_stage is None:
-            return None
+        prologue, mids, epilogue = split
 
         n_stages = len(stages)
-        # stack stage s's params along a new leading "pipe" dim
+        per_stage = [[_unit_params(u) for u in st] for st in mids]
+        layer_count = len(per_stage[0])
+        mid0 = mids[0]
+
+        # stack middle stage s's params along a new leading "pipe" dim
         stacked: Dict[str, Any] = {}
         specs: Dict[str, Any] = {}
-        layer_count = len(per_stage[0])
-        stage0 = stages[0]
         for li in range(layer_count):
             for pname in per_stage[0][li]:
                 key = f"stage.{li}.{pname}"
@@ -308,7 +371,22 @@ class FleetEngine:
                     [per_stage[s][li][pname]._data for s in range(n_stages)])
                 specs[key] = P("pipe", *_spec_of(per_stage[0][li][pname]))
 
-        self._pp_meta = (stages, per_stage, layer_count)
+        # edge (prologue/epilogue) params: one entry per PARAM OBJECT, so a
+        # weight tied across the edges (SharedLayerDesc) appears once and
+        # its gradient contributions sum through autodiff
+        outer_key_of: Dict[int, str] = {}
+        outer_params_t: Dict[str, Any] = {}
+        for ui, unit in enumerate(list(prologue) + list(epilogue)):
+            for pname, p in _unit_params(unit).items():
+                if id(p) not in outer_key_of:
+                    key = f"edge.{ui}.{pname}"
+                    outer_key_of[id(p)] = key
+                    outer_params_t[key] = p
+        for key, p in outer_params_t.items():
+            stacked[key] = p._data
+            specs[key] = _spec_of(p)
+
+        self._pp_meta = (mids, per_stage, layer_count, outer_params_t)
         self._write_back = self._assign_pipelined
         self._write_back_buffers = lambda new: None
 
@@ -321,23 +399,66 @@ class FleetEngine:
                 "them). Use LayerNorm/GroupNorm in pipelined models.")
         buffers = {}
 
+        def apply_edge(units, params, h):
+            for unit in units:
+                layer = _underlying_layer(unit)
+                if layer is None:
+                    out = unit(Tensor(h))
+                    h = out._data if isinstance(out, Tensor) else out
+                    continue
+                pdict = {pn: params[outer_key_of[id(p)]]
+                         for pn, p in _unit_params(unit).items()}
+                if isinstance(unit, Layer):
+                    h = functional_call(unit, pdict, h)
+                else:
+                    # SharedLayerDesc forward_func partial: bind the shared
+                    # layer's params, then call the partial
+                    named = dict(layer.named_parameters())
+                    saved = {}
+                    try:
+                        for pn, arr in pdict.items():
+                            saved[pn] = named[pn]._data
+                            named[pn]._data = arr
+                        from ...framework.core import no_grad
+
+                        with no_grad():
+                            out = unit(Tensor(h))
+                        h = out._data if isinstance(out, Tensor) else out
+                    finally:
+                        for pn, old in saved.items():
+                            named[pn]._data = old
+            return h
+
         def stage_fn(sp, h):
-            for li, layer in enumerate(stage0):
+            for li, unit in enumerate(mid0):
                 lp = {pn: sp[f"stage.{li}.{pn}"] for pn in per_stage[0][li]}
-                h = functional_call(layer, lp, h)
+                h = functional_call(unit, lp, h)
             return h
 
         acc = max(self.accumulate_steps, n_stages)
 
         def step_loss(params, buffers, batch):
             x, y = batch
-            xm = x.reshape(acc, x.shape[0] // acc, *x.shape[1:])
+            h = apply_edge(prologue, params, x)
+            xm = h.reshape(acc, h.shape[0] // acc, *h.shape[1:])
             ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
-            ys = pipeline_forward(stage_fn, params, xm, n_stages)
-            # mean over microbatches of the per-micro loss — identical math
-            # to eager train_batch's accumulation (sum when GradientMerge
-            # avg=False, matching _micro_loss)
-            losses = jax.vmap(lambda o, t: loss_arrays(o, t))(ys, ym)
+            mid_params = {k: v for k, v in params.items()
+                          if k.startswith("stage.")}
+            ys = pipeline_forward(stage_fn, mid_params, xm, n_stages)
+            # epilogue + loss per microbatch, sequenced (lax.map) with
+            # remat so one microbatch of head activations is live at a
+            # time — then mean over microbatches, identical math to eager
+            # train_batch accumulation (sum when GradientMerge avg=False)
+            if epilogue:
+                @jax.checkpoint
+                def per_micro(args):
+                    o, t = args
+                    o = apply_edge(epilogue, params, o)
+                    return loss_arrays(o, t)
+
+                losses = jax.lax.map(per_micro, (ys, ym))
+            else:
+                losses = jax.vmap(lambda o, t: loss_arrays(o, t))(ys, ym)
             return (jnp.mean(losses) if self._merge_avg
                     else jnp.sum(losses)), buffers
 
@@ -357,12 +478,14 @@ class FleetEngine:
             named[n]._data = arr
 
     def _assign_pipelined(self, new_params: Dict[str, Any]):
-        stages, per_stage, layer_count = self._pp_meta
+        mids, per_stage, layer_count, outer_params = self._pp_meta
         for li in range(layer_count):
             for pname in per_stage[0][li]:
                 arr = new_params[f"stage.{li}.{pname}"]
-                for s in range(len(stages)):
+                for s in range(len(mids)):
                     per_stage[s][li][pname]._data = arr[s]
+        for key, p in outer_params.items():
+            p._data = new_params[key]
 
     # -- public --------------------------------------------------------------
     @property

@@ -388,3 +388,93 @@ class TestOptimizerFidelity:
         np.testing.assert_allclose(np.asarray(net_c.weight._data),
                                    np.asarray(net_e.weight._data),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestTiedWeightsPipeline:
+    """VERDICT r3 item 3: a SharedLayerDesc tied-embedding model (the
+    reference's pp_layers.py:208-280 case) must compile through the TRUE
+    SPMD pipeline — edge layers peel off, the tied weight appears once,
+    gradient contributions sum."""
+
+    @staticmethod
+    def _head(layer, x):
+        return paddle.matmul(x, layer.weight, transpose_y=True)
+
+    def _tied_pipe(self, seed, V=32, H=16):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            SharedLayerDesc)
+
+        def ce(out, label):
+            return paddle.nn.functional.cross_entropy(
+                out.reshape([-1, V]), label.reshape([-1]))
+
+        paddle.seed(seed)
+        return PipelineLayer(
+            layers=[SharedLayerDesc("embed", paddle.nn.Embedding,
+                                    forward_func=None,
+                                    num_embeddings=V, embedding_dim=H),
+                    LayerDesc(paddle.nn.Linear, H, H),
+                    LayerDesc(paddle.nn.Linear, H, H),
+                    SharedLayerDesc("embed", paddle.nn.Embedding,
+                                    forward_func=self._head,
+                                    num_embeddings=V, embedding_dim=H)],
+            num_stages=2, loss_fn=ce), ce
+
+    def _tokens(self, steps, batch=8, S=4, V=32):
+        rng = np.random.default_rng(5)
+        for _ in range(steps):
+            yield (rng.integers(0, V, (batch, S)).astype("int32"),
+                   rng.integers(0, V, (batch, S)).astype("int64"))
+
+    def test_tied_embedding_uses_true_pipeline(self):
+        import warnings as W
+
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, dp=4, accumulate_steps=4))
+        pipe, ce = self._tied_pipe(41)
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()))
+        with W.catch_warnings(record=True) as caught:
+            W.simplefilter("always")
+            for x, y in self._tokens(2):
+                loss = model.train_batch(
+                    (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+        assert not any("not structurally uniform" in str(w.message)
+                       for w in caught), "tied model fell back to scan path"
+        assert np.isfinite(float(loss._data))
+        specs = model._engine.train_step.param_specs
+        # blocks stage-stacked over "pipe"; tied embedding appears ONCE as
+        # an edge param (grads from embed + head sum through autodiff)
+        assert any(s and "pipe" in str(s) for s in specs.values())
+        edge_keys = [k for k in specs if k.startswith("edge.")]
+        assert len(edge_keys) == 1, edge_keys
+
+    def test_tied_embedding_matches_eager_debug_mode(self):
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, dp=4, accumulate_steps=4))
+        pipe_c, _ = self._tied_pipe(43)
+        model_c = fleet.distributed_model(pipe_c)
+        opt_c = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model_c.parameters()))
+        pipe_e, _ = self._tied_pipe(43)
+        model_e = fleet.distributed_model(pipe_e)
+        opt_e = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model_e.parameters()))
+        for x, y in self._tokens(3):
+            lc = model_c.train_batch(
+                (paddle.to_tensor(x), paddle.to_tensor(y)), opt_c)
+            le = model_e.train_batch(
+                (paddle.to_tensor(x), paddle.to_tensor(y)), opt_e,
+                use_eager=True)
+            np.testing.assert_allclose(float(lc._data), float(le._data),
+                                       rtol=1e-4, atol=1e-5)
+        for (n1, p1), (n2, p2) in zip(pipe_c.named_parameters(),
+                                      pipe_e.named_parameters()):
+            assert n1 == n2
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data),
+                                       rtol=1e-4, atol=1e-5, err_msg=n1)
