@@ -242,7 +242,7 @@ class FleetEngine:
     """
 
     def __init__(self, model: Layer, optimizer, strategy, hcg=None,
-                 loss_fn: Optional[Callable] = None, mesh=None):
+                 loss_fn: Optional[Callable] = None, mesh=None, scaler=None):
         from .meta_parallel.pp_layers import PipelineLayer
 
         self.mesh = mesh or get_mesh()
@@ -297,11 +297,33 @@ class FleetEngine:
             built = self._build_flat(inner_model, loss_arrays)
         params, specs, step_loss, buffers = built
 
+        self._scaler = scaler if (scaler is not None
+                                  and getattr(scaler, "_enable", False)) \
+            else None
+        dynamic_scale = None
+        if self._scaler is not None:
+            s = self._scaler
+            dynamic_scale = {
+                "init_scale": float(s._scale),
+                "incr_ratio": float(s._incr_ratio),
+                "decr_ratio": float(s._decr_ratio),
+                "incr_every_n_steps": int(s._incr_every_n_steps),
+                "decr_every_n": int(s._decr_every_n),
+            }
+
         self._write_back_names = list(params)
         self._step = DistributedTrainStep(
             step_loss, params, specs, optimizer=cfg["opt"], lr=cfg["lr"],
             clip_norm=cfg["clip_norm"], zero=shard_deg > 1, mesh=self.mesh,
-            opt_kwargs=cfg["opt_kwargs"], aux=buffers)
+            opt_kwargs=cfg["opt_kwargs"], aux=buffers,
+            dynamic_scale=dynamic_scale)
+        if self._scaler is not None:
+            # start from the eager scaler's live counters
+            self._step.scaler_state = {
+                "scale": jnp.float32(self._scaler._scale),
+                "good": jnp.int32(self._scaler._good_steps),
+                "bad": jnp.int32(self._scaler._bad_steps),
+            }
 
     # -- builders ------------------------------------------------------------
     def _micro_loss(self, one_loss: Callable):
@@ -499,6 +521,13 @@ class FleetEngine:
         loss = self._step((x, y))
         self._write_back(self._step.params)
         self._write_back_buffers(self._step.aux)
+        if self._scaler is not None:
+            # keep the eager GradScaler object observable (get_loss_scaling,
+            # state_dict) in sync with the compiled counters
+            st = self._step.scaler_state
+            self._scaler._scale = float(st["scale"])
+            self._scaler._good_steps = int(st["good"])
+            self._scaler._bad_steps = int(st["bad"])
         return loss
 
 

@@ -38,6 +38,7 @@ class PipelineParallel(Layer):
         self.total_loss = None
         self._engine = None
         self._engine_opt_id = None
+        self._engine_scaler = None
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -79,14 +80,22 @@ class PipelineParallel(Layer):
             return None
         if self._engine is None or self._engine_opt_id != id(optimizer):
             self._engine = FleetEngine(self._layers, optimizer,
-                                       self._strategy, hcg=self._hcg)
+                                       self._strategy, hcg=self._hcg,
+                                       scaler=self._engine_scaler)
             self._engine_opt_id = id(optimizer)
         return self._engine
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
                     use_eager=False):
         self._layers.train()
-        eager = use_eager or (scaler is not None and scaler._enable)
+        # dynamic loss scaling is COMPILED into the engine step (pure
+        # unscale + finite-gate + where-updated scale — the reference's
+        # check_finite_and_unscale/update_loss_scaling op pair); only an
+        # explicit use_eager drops to the sequential debug path
+        if getattr(self, "_engine_scaler", None) is not scaler:
+            self._engine_scaler = scaler
+            self._engine = None
+        eager = use_eager
         engine = None if eager else self._get_engine(optimizer)
         if engine is not None:
             loss = Tensor(engine.step(data))

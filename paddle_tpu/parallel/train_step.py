@@ -162,6 +162,17 @@ class DistributedTrainStep:
         step keeps ``self.aux`` updated — the functional analog of the
         reference's in-place persistable-variable mutation. Default
         replicated; pass aux_specs to shard.
+      dynamic_scale: optional dict enabling COMPILED dynamic loss scaling
+        (fp16 training) — the in-jit analog of the reference's
+        check_finite_and_unscale + update_loss_scaling op pair
+        (operators/amp/check_finite_and_unscale_op.cc,
+        update_loss_scaling_op.cc): the loss is scaled before the
+        backward, grads unscaled, a single all-reduced finite flag gates
+        the whole parameter/optimizer update with ``where`` (a skipped
+        step costs nothing), and the scale/good/bad counters update in the
+        same program. Keys (GradScaler names): init_scale, incr_ratio,
+        decr_ratio, incr_every_n_steps, decr_every_n. State lives in
+        ``self.scaler_state`` {"scale","good","bad"} (host-readable).
     """
 
     def __init__(self, loss_fn: Callable, params, param_specs,
@@ -169,7 +180,8 @@ class DistributedTrainStep:
                  batch_spec: P = P(("data", "sharding")),
                  clip_norm: Optional[float] = None, zero: bool = True,
                  mesh=None, opt_kwargs: Optional[dict] = None,
-                 aux=None, aux_specs=None):
+                 aux=None, aux_specs=None,
+                 dynamic_scale: Optional[dict] = None):
         self.mesh = mesh or get_mesh()
         if self.mesh is None:
             raise RuntimeError("DistributedTrainStep needs a mesh "
@@ -232,13 +244,29 @@ class DistributedTrainStep:
 
         batch_sh = NamedSharding(self.mesh, batch_spec)
 
-        def step(params, opt_state, aux, batch, lr):
-            if self._has_aux:
-                (loss, new_aux), grads = jax.value_and_grad(
-                    self._loss_fn, has_aux=True)(params, aux, batch)
-            else:
-                loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
-                new_aux = aux
+        self._dyn = dict(dynamic_scale) if dynamic_scale else None
+        if self._dyn is not None:
+            self.scaler_state = {
+                "scale": jnp.float32(self._dyn.get("init_scale", 2.0 ** 15)),
+                "good": jnp.int32(0),
+                "bad": jnp.int32(0),
+            }
+        else:
+            self.scaler_state = None
+
+        def step(params, opt_state, aux, batch, lr, scaler_state):
+            scale = (scaler_state["scale"] if scaler_state is not None
+                     else jnp.float32(1.0))
+
+            def run_loss(p):
+                if self._has_aux:
+                    loss, new_aux = self._loss_fn(p, aux, batch)
+                else:
+                    loss, new_aux = self._loss_fn(p, batch), aux
+                return loss * scale.astype(loss.dtype), (loss, new_aux)
+
+            (_, (loss, new_aux)), grads = jax.value_and_grad(
+                run_loss, has_aux=True)(params)
             # pin grads to the PARAM layout: the ZeRO reshard (m/v carry
             # the "sharding" axis) then happens at this boundary as a
             # reduce-scatter, instead of GSPMD propagating the opt-state
@@ -247,18 +275,53 @@ class DistributedTrainStep:
             grads = jax.tree_util.tree_map(
                 lambda g, s: jax.lax.with_sharding_constraint(g, s),
                 grads, self._param_sh)
+            if scaler_state is not None:
+                inv = (1.0 / scale)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                    grads)
+                finite = jnp.array(True)
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite &= jnp.all(jnp.isfinite(g.astype(jnp.float32)))
             if self._clip is not None:
                 grads, _ = global_norm_clip(grads, self._clip)
             new_params, new_opt = self._update_fn(
                 params, grads, opt_state, lr, **self._opt_kwargs)
-            return new_params, new_opt, new_aux, loss
+            if scaler_state is not None:
+                # gate the whole update on the finite flag (reference
+                # check_finite_and_unscale semantics: a skipped step leaves
+                # params and optimizer state untouched)
+                pick = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), new, old)
+                new_params = pick(new_params, params)
+                new_opt = pick(new_opt, opt_state)
+                # update_loss_scaling_op counters
+                d = self._dyn
+                good = jnp.where(finite, scaler_state["good"] + 1, 0)
+                bad = jnp.where(finite, 0, scaler_state["bad"] + 1)
+                incr = good >= int(d.get("incr_every_n_steps", 1000))
+                decr = bad >= int(d.get("decr_every_n", 2))
+                new_scale = jnp.where(
+                    incr, scale * float(d.get("incr_ratio", 2.0)), scale)
+                new_scale = jnp.where(
+                    decr,
+                    jnp.maximum(scale * float(d.get("decr_ratio", 0.5)), 1.0),
+                    new_scale)
+                scaler_state = {"scale": new_scale,
+                                "good": jnp.where(incr, 0, good),
+                                "bad": jnp.where(decr, 0, bad)}
+            return new_params, new_opt, new_aux, loss, scaler_state
 
         repl = NamedSharding(self.mesh, P())
         aux_sh = self._aux_sh if self._has_aux else None
+        scaler_sh = ({"scale": repl, "good": repl, "bad": repl}
+                     if self._dyn is not None else None)
         self._step = jax.jit(
             step,
-            in_shardings=(self._param_sh, self._opt_sh, aux_sh, batch_sh, repl),
-            out_shardings=(self._param_sh, self._opt_sh, aux_sh, repl),
+            in_shardings=(self._param_sh, self._opt_sh, aux_sh, batch_sh,
+                          repl, scaler_sh),
+            out_shardings=(self._param_sh, self._opt_sh, aux_sh, repl,
+                           scaler_sh),
             donate_argnums=(0, 1, 2) if self._has_aux else (0, 1),
         )
         self._step_count = 0
@@ -271,14 +334,23 @@ class DistributedTrainStep:
     def __call__(self, batch):
         lr = jnp.float32(self.current_lr())
         with self.mesh:
-            self.params, self.opt_state, self.aux, loss = self._step(
-                self.params, self.opt_state, self.aux, batch, lr)
+            (self.params, self.opt_state, self.aux, loss,
+             self.scaler_state) = self._step(
+                self.params, self.opt_state, self.aux, batch, lr,
+                self.scaler_state)
         self._step_count += 1
         return loss
+
+    def loss_scale(self) -> Optional[float]:
+        """Current dynamic loss scale (None when scaling is off)."""
+        if self.scaler_state is None:
+            return None
+        return float(self.scaler_state["scale"])
 
     def lower(self, batch):
         """Expose the lowered/compiled artifact (assert-on-HLO testing —
         the TPU analog of the reference's assert-on-op-list meta-optimizer
         tests, SURVEY.md §4.6)."""
         return self._step.lower(self.params, self.opt_state, self.aux, batch,
-                                jnp.float32(self.current_lr()))
+                                jnp.float32(self.current_lr()),
+                                self.scaler_state)
