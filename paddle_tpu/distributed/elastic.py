@@ -1,0 +1,224 @@
+"""Elastic training manager — membership, scale-in/out, rank remap, relaunch.
+
+Parity: reference fleet elastic (python/paddle/distributed/fleet/elastic/
+manager.py:103 ElasticManager — etcd node registry with watches, :176-225
+host registration + np consistency, :247-270 _match on live host count,
+:268-292 _update_hosts rank preservation, :317 watch/relaunch loop).
+
+TPU-native translation:
+- the etcd cluster becomes a :class:`FileKVStore` — a shared directory
+  (NFS/GCS-fuse on real pods, tmpdir in tests) with atomic-rename writes
+  and mtime heartbeats. Same contract: registry of alive nodes, a np
+  target, completion flag. (On Cloud TPU the scheduler usually owns
+  membership; the kv store is what makes the manager self-contained and
+  testable.)
+- a "node" is a host driving a TPU slice-chunk (one process per host, jax
+  process model), not one process per GPU.
+- scale-in/out within [min_np, max_np]: the supervising agent relaunches
+  the pod whenever the alive-node set stops matching the running pod, with
+  ranks regenerated but PRESERVED for surviving nodes (reference
+  _update_hosts swap logic).
+- fault recovery composes with CheckpointManager auto-resume
+  (framework/checkpoint.py): workers restore_latest() on start, so a
+  relaunch continues from the newest snapshot.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FileKVStore", "ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    RESTART = "restart"
+    ERROR = "error"
+    EXIT = "exit"
+
+
+class FileKVStore:
+    """etcd-analog over a shared directory. Keys are '/'-separated paths;
+    values bytes. Writes are atomic (tmp + rename); watches are polls."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        key = key.strip("/")
+        if not key or ".." in key.split("/"):
+            raise ValueError(f"bad key {key!r}")
+        return os.path.join(self.root, key)
+
+    def put(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}.{time.monotonic_ns()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def get_prefix(self, prefix: str) -> Dict[str, bytes]:
+        """{key: value} for every key under prefix (one directory level)."""
+        base = self._path(prefix)
+        out = {}
+        try:
+            names = sorted(os.listdir(base))
+        except FileNotFoundError:
+            return out
+        for n in names:
+            if n.endswith(("~",)) or ".tmp." in n:
+                continue
+            p = os.path.join(base, n)
+            if os.path.isfile(p):
+                with open(p, "rb") as f:
+                    out[f"{prefix.strip('/')}/{n}"] = f.read()
+        return out
+
+    def mtime(self, key: str) -> Optional[float]:
+        try:
+            return os.path.getmtime(self._path(key))
+        except FileNotFoundError:
+            return None
+
+
+class ElasticManager:
+    """Membership + rank-map + relaunch decisions for one job.
+
+    One instance runs inside each host's launcher agent (and, in the
+    single-host test rig, inside the one agent supervising all workers).
+    """
+
+    def __init__(self, kv: FileKVStore, job_id: str, min_np: int,
+                 max_np: Optional[int] = None, heartbeat_ttl: float = 10.0):
+        if min_np < 1:
+            raise ValueError("min_np must be >= 1")
+        self.kv = kv
+        self.job_id = job_id
+        self.min_np = int(min_np)
+        self.max_np = int(max_np or min_np)
+        if self.max_np < self.min_np:
+            raise ValueError("max_np must be >= min_np")
+        self.ttl = float(heartbeat_ttl)
+        self.prefix = f"jobs/{job_id}"
+        self.node_prefix = f"{self.prefix}/nodes"
+
+    # -- node registry (reference manager.py:176-225) ------------------------
+    def register(self, host: str, status: str = "alive") -> None:
+        self.kv.put(f"{self.node_prefix}/{host}",
+                    json.dumps({"host": host, "status": status,
+                                "ts": time.time()}))
+
+    def heartbeat(self, host: str) -> None:
+        self.register(host)
+
+    def mark_dead(self, host: str) -> None:
+        """Permanent scale-in signal. A TOMBSTONE key, not a node-record
+        status: the supervising agent heartbeats nodes whose process is
+        still alive, and a worker calls mark_dead shortly BEFORE exiting —
+        a status field would race with that heartbeat and get resurrected.
+        Tombstones win over any registration until readmit()."""
+        self.kv.put(f"{self.prefix}/dead/{host}", b"1")
+
+    def readmit(self, host: str) -> None:
+        """Clear a tombstone so the host may rejoin (scale-out)."""
+        self.kv.delete(f"{self.prefix}/dead/{host}")
+
+    def dead_hosts(self) -> List[str]:
+        return sorted(k.rsplit("/", 1)[1]
+                      for k in self.kv.get_prefix(f"{self.prefix}/dead"))
+
+    def deregister(self, host: str) -> None:
+        self.kv.delete(f"{self.node_prefix}/{host}")
+
+    def alive_hosts(self) -> List[str]:
+        """Hosts with a fresh, non-tombstoned registration (etcd lease
+        analog)."""
+        now = time.time()
+        dead = set(self.dead_hosts())
+        alive = []
+        for key, raw in self.kv.get_prefix(self.node_prefix).items():
+            try:
+                rec = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if rec.get("host") in dead or rec.get("status") == "dead":
+                continue
+            if now - float(rec.get("ts", 0)) > self.ttl:
+                continue
+            alive.append(rec["host"])
+        return sorted(alive)
+
+    # -- quorum / scale (reference _match :247, np watch :205) ---------------
+    def match(self) -> Tuple[bool, List[str]]:
+        hosts = self.alive_hosts()
+        return (self.min_np <= len(hosts) <= self.max_np, hosts)
+
+    def wait_for_quorum(self, timeout: float = 60.0,
+                        poll: float = 0.2) -> List[str]:
+        """Block until the alive set sits inside [min_np, max_np] and is
+        stable for one extra poll (reference wait() loop)."""
+        deadline = time.time() + timeout
+        prev: Optional[List[str]] = None
+        while time.time() < deadline:
+            ok, hosts = self.match()
+            if ok and hosts == prev:
+                return hosts
+            prev = hosts if ok else None
+            time.sleep(poll)
+        raise TimeoutError(
+            f"elastic quorum not reached: need [{self.min_np}, "
+            f"{self.max_np}] alive nodes, have {self.alive_hosts()}")
+
+    # -- rank map (reference _update_hosts :268-292) -------------------------
+    def rank_map(self, hosts: List[str],
+                 previous: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+        """host -> rank. Surviving hosts keep their previous rank when it
+        is still inside the new world size; vacated ranks are filled by the
+        new/displaced hosts in sorted order — the reference's host-swap
+        logic generalized to arbitrary membership changes."""
+        n = len(hosts)
+        taken: Dict[int, str] = {}
+        if previous:
+            for h in sorted(hosts):
+                r = previous.get(h)
+                if r is not None and 0 <= r < n and r not in taken:
+                    taken[r] = h
+        placed = set(taken.values())
+        free_ranks = [r for r in range(n) if r not in taken]
+        for h in sorted(hosts):
+            if h in placed:
+                continue
+            taken[free_ranks.pop(0)] = h
+        result = {h: r for r, h in taken.items()}
+        self.kv.put(f"{self.prefix}/rank_map", json.dumps(result))
+        return result
+
+    def last_rank_map(self) -> Optional[Dict[str, int]]:
+        raw = self.kv.get(f"{self.prefix}/rank_map")
+        return json.loads(raw.decode()) if raw else None
+
+    # -- completion flag (reference exit() :229) -----------------------------
+    def set_completed(self) -> None:
+        self.kv.put(f"{self.prefix}/completed", b"1")
+
+    def completed(self) -> bool:
+        return self.kv.get(f"{self.prefix}/completed") == b"1"

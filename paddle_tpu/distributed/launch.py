@@ -25,7 +25,7 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["launch", "main", "get_cluster_env", "wait_pod"]
+__all__ = ["launch", "elastic_launch", "main", "get_cluster_env", "wait_pod"]
 
 
 def _free_port() -> int:
@@ -85,7 +85,8 @@ class Pod:
                 p.kill()
 
 
-def start_pod(script: List[str], nproc: int, log_dir: Optional[str] = None) -> Pod:
+def start_pod(script: List[str], nproc: int, log_dir: Optional[str] = None,
+              extra_env_of_rank=None) -> Pod:
     """Spawn nproc workers with cluster env (reference
     start_local_trainers)."""
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -93,6 +94,8 @@ def start_pod(script: List[str], nproc: int, log_dir: Optional[str] = None) -> P
     procs, logs = [], []
     for rank in range(nproc):
         env = get_cluster_env(rank, nproc, coordinator, endpoints)
+        if extra_env_of_rank is not None:
+            env.update(extra_env_of_rank(rank))
         stdout = None
         log_path = ""
         if log_dir:
@@ -139,6 +142,88 @@ def launch(script: List[str], nproc: int = 1, log_dir: Optional[str] = None,
             f"{restarts}/{max_restarts}\n")
 
 
+def elastic_launch(script: List[str], kv_dir: str, job_id: str,
+                   min_np: int, max_np: Optional[int] = None,
+                   initial_np: Optional[int] = None,
+                   log_dir: Optional[str] = None, max_restarts: int = 10,
+                   quorum_timeout: float = 60.0,
+                   poll_interval: float = 0.2) -> int:
+    """Elastic supervision (reference fleet/elastic/manager.py:317 watch
+    loop): maintain a pod matching the job's live membership.
+
+    - Membership lives in a FileKVStore; logical node ``n{i}``'s liveness
+      is heartbeated by this agent while worker i runs. A worker that
+      fails transiently keeps its node (same-np restart); a worker whose
+      script marks its node dead (``ElasticManager.mark_dead``) is scaled
+      IN — the pod relaunches with np-1 (down to min_np) and ranks
+      remapped, surviving workers keeping theirs. Externally registered
+      nodes scale the pod OUT (up to max_np) at the next membership check.
+    - Every relaunch starts workers that auto-resume from the newest
+      checkpoint (CheckpointManager.restore_latest) — the reference pairs
+      its relaunch with --auto_checkpoint the same way.
+
+    Returns the final exit code (0 = pod completed).
+    """
+    from .elastic import ElasticManager, FileKVStore
+
+    kv = FileKVStore(kv_dir)
+    mgr = ElasticManager(kv, job_id, min_np, max_np)
+    n0 = initial_np or mgr.max_np
+    for i in range(n0):
+        mgr.register(f"n{i}")
+
+    prev_map = None
+    restarts = 0
+    while True:
+        hosts = mgr.wait_for_quorum(quorum_timeout, poll=poll_interval)
+        rank_of = mgr.rank_map(hosts, prev_map)
+        prev_map = rank_of
+        node_of_rank = {r: h for h, r in rank_of.items()}
+
+        def extra_env(rank):
+            return {
+                "PADDLE_ELASTIC_NODE": node_of_rank[rank],
+                "PADDLE_ELASTIC_KV_DIR": kv_dir,
+                "PADDLE_ELASTIC_JOB_ID": job_id,
+            }
+
+        pod = start_pod(script, nproc=len(hosts), log_dir=log_dir,
+                        extra_env_of_rank=extra_env)
+        sys.stderr.write(
+            f"[paddle_tpu.elastic] pod up np={len(hosts)} "
+            f"ranks={rank_of}\n")
+        code = None
+        while code is None:
+            code = pod.poll()
+            # heartbeat nodes whose worker is alive
+            for rank, proc in enumerate(pod.procs):
+                if proc.poll() is None:
+                    mgr.heartbeat(node_of_rank[rank])
+            if code is None:
+                # scale-out/in watch: membership vs running pod
+                ok, now = mgr.match()
+                if ok and set(now) - set(hosts):
+                    sys.stderr.write(
+                        f"[paddle_tpu.elastic] membership grew to {now}; "
+                        "relaunching\n")
+                    pod.terminate()
+                    code = -1  # treat as restart trigger
+                    break
+                time.sleep(poll_interval)
+        if code == 0:
+            mgr.set_completed()
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            sys.stderr.write(
+                f"[paddle_tpu.elastic] giving up after {max_restarts} "
+                "restarts\n")
+            return code if code else 1
+        sys.stderr.write(
+            f"[paddle_tpu.elastic] pod exited {code}; restart "
+            f"{restarts}/{max_restarts}\n")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.distributed.launch",
@@ -150,9 +235,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--elastic", action="store_true",
                     help="supervised restarts on worker failure")
     ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--np", default=None,
+                    help="elastic size or range 'min:max' (enables the "
+                         "membership manager; reference --elastic_server "
+                         "np syntax)")
+    ap.add_argument("--elastic_kv_dir", default=None,
+                    help="shared directory backing the membership store")
+    ap.add_argument("--job_id", default="default")
     ap.add_argument("script", help="training script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+    if args.np:
+        lo, _, hi = args.np.partition(":")
+        min_np, max_np = int(lo), int(hi or lo)
+        kv_dir = args.elastic_kv_dir or os.path.join(
+            args.log_dir or ".", f"elastic_{args.job_id}")
+        return elastic_launch([args.script] + args.script_args,
+                              kv_dir=kv_dir, job_id=args.job_id,
+                              min_np=min_np, max_np=max_np,
+                              log_dir=args.log_dir,
+                              max_restarts=args.max_restarts)
     return launch([args.script] + args.script_args,
                   nproc=args.nproc_per_node, log_dir=args.log_dir,
                   elastic=args.elastic, max_restarts=args.max_restarts)
