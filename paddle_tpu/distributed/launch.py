@@ -168,6 +168,12 @@ def elastic_launch(script: List[str], kv_dir: str, job_id: str,
 
     kv = FileKVStore(kv_dir)
     mgr = ElasticManager(kv, job_id, min_np, max_np)
+    # a fresh launch is a new incarnation of the job: clear the previous
+    # run's completion flag and tombstones, else a reused job_id/kv_dir
+    # silently starts scaled-in
+    kv.delete(f"{mgr.prefix}/completed")
+    for h in mgr.dead_hosts():
+        mgr.readmit(h)
     n0 = initial_np or mgr.max_np
     for i in range(n0):
         mgr.register(f"n{i}")
@@ -193,6 +199,7 @@ def elastic_launch(script: List[str], kv_dir: str, job_id: str,
             f"[paddle_tpu.elastic] pod up np={len(hosts)} "
             f"ranks={rank_of}\n")
         code = None
+        scale_event = False
         while code is None:
             code = pod.poll()
             # heartbeat nodes whose worker is alive
@@ -206,13 +213,19 @@ def elastic_launch(script: List[str], kv_dir: str, job_id: str,
                     sys.stderr.write(
                         f"[paddle_tpu.elastic] membership grew to {now}; "
                         "relaunching\n")
-                    pod.terminate()
-                    code = -1  # treat as restart trigger
+                    scale_event = True
                     break
                 time.sleep(poll_interval)
+        # stop every surviving worker before relaunching: a half-dead pod
+        # left running would race the new one on checkpoints and linger on
+        # a dead coordinator
+        pod.terminate()
         if code == 0:
             mgr.set_completed()
             return 0
+        if scale_event:
+            # voluntary resize, not a failure — doesn't consume the budget
+            continue
         restarts += 1
         if restarts > max_restarts:
             sys.stderr.write(

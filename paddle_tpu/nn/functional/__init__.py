@@ -12,5 +12,5 @@ from .loss import *  # noqa: F401,F403
 from . import activation, common, conv, pooling, norm, loss  # noqa: F401
 from .sequence import (  # noqa: F401
     sequence_mask, sequence_pad, sequence_unpad, sequence_reverse,
-    sequence_softmax, sequence_expand,
+    sequence_softmax, sequence_expand, edit_distance,
 )

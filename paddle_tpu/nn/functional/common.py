@@ -18,6 +18,7 @@ __all__ = [
     "embedding", "interpolate", "upsample", "one_hot", "pad", "unfold",
     "fold", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
     "normalize", "label_smooth", "class_center_sample", "bilinear",
+    "grid_sample", "affine_grid",
 ]
 
 
@@ -304,3 +305,131 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is None:
         return apply_op(_bilinear, x1, x2, weight)
     return apply_op(_bilinear, x1, x2, weight, bias)
+
+
+# -- grid_sample / affine_grid ---------------------------------------------
+
+def _reflect(coord, lo, hi):
+    """Reflection padding coordinate fold into [lo, hi] (reference
+    grid_sampler_op.h reflectIndexes)."""
+    span = hi - lo
+    safe = jnp.where(span > 0, span, 1.0)
+    c = jnp.abs(coord - lo)
+    c = c % (2 * safe)
+    c = jnp.where(c > safe, 2 * safe - c, c)
+    return jnp.where(span > 0, c + lo, jnp.zeros_like(coord))
+
+
+def _bilinear_batch(feat, ys, xs, bounds="zero_corner"):
+    """Shared bilinear gather: feat [C,H,W], ys/xs float coord arrays of a
+    common shape -> [C, *coord shape]. The ONE implementation behind
+    grid_sample (zeros mode), deform_conv2d and roi_align — they differ
+    only in boundary semantics:
+
+    - bounds="zero_corner": an out-of-range CORNER contributes zero
+      (reference grid_sampler zeros mode, deformable_conv_op.h
+      DmcnIm2colBilinear).
+    - bounds="clamp_sample": corner indices clamp to the edge; only whole
+      samples outside [-1, H]x[-1, W] are zeroed (reference roi_align_op.h
+      bilinear_interpolate, which clamps y/x into [0, size-1] first).
+    """
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+
+    def at(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = feat[:, yi, xi]
+        if bounds == "clamp_sample":
+            return v
+        ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        return jnp.where(ok, v, 0.0)
+
+    out = (at(y0, x0) * (1 - wy1) * (1 - wx1)
+           + at(y0, x0 + 1) * (1 - wy1) * wx1
+           + at(y0 + 1, x0) * wy1 * (1 - wx1)
+           + at(y0 + 1, x0 + 1) * wy1 * wx1)
+    if bounds == "clamp_sample":
+        ok = (ys >= -1) & (ys <= H) & (xs >= -1) & (xs <= W)
+        out = jnp.where(ok, out, 0.0)
+    return out
+
+
+def _grid_sample(x, grid, mode, padding_mode, align_corners):
+    N, C, H, W = x.shape
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) / 2.0 * (size - 1)
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    fx = unnorm(grid[..., 0].astype(jnp.float32), W)   # [N, Ho, Wo]
+    fy = unnorm(grid[..., 1].astype(jnp.float32), H)
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, W - 1)
+        fy = jnp.clip(fy, 0, H - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            fx = _reflect(fx, 0.0, W - 1.0)
+            fy = _reflect(fy, 0.0, H - 1.0)
+        else:
+            fx = jnp.clip(_reflect(fx, -0.5, W - 0.5), 0, W - 1)
+            fy = jnp.clip(_reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+    def one(feat, ys, xs):
+        if mode == "nearest":
+            yy, xx = jnp.round(ys), jnp.round(xs)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = feat[:, yi, xi]                        # [C, Ho, Wo]
+            ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            return jnp.where(ok, v, 0.0)
+        return _bilinear_batch(feat, ys, xs, bounds="zero_corner")
+
+    return jax.vmap(one)(x, fy, fx)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at normalized grid [N,Ho,Wo,2] locations
+    (reference operators/grid_sampler_op.h; paddle default
+    align_corners=True). Differentiable in both x and grid."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode}")
+    return apply_op(_grid_sample, x, grid, mode=mode,
+                    padding_mode=padding_mode,
+                    align_corners=bool(align_corners))
+
+
+def _affine_grid(theta, n, h, w, align_corners):
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+    else:
+        xs = (jnp.arange(w) * 2.0 + 1.0) / w - 1.0
+        ys = (jnp.arange(h) * 2.0 + 1.0) / h - 1.0
+    gx, gy = jnp.meshgrid(xs, ys, indexing="xy")       # [h, w]
+    # explicit mul-add, not einsum: a k=3 "matmul" would run at the
+    # backend's matmul default precision (bf16 on TPU), skewing sampling
+    # coordinates by ~1e-3 — these feed interpolation weights directly
+    gx = gx.astype(theta.dtype)
+    gy = gy.astype(theta.dtype)
+    t = theta[:, None, None, :, :]                     # [n,1,1,2,3]
+    return (gx[None, :, :, None] * t[..., 0]
+            + gy[None, :, :, None] * t[..., 1] + t[..., 2])
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid from theta [N,2,3] (reference
+    operators/affine_grid_op.h); out_shape = [N, C, H, W]. Feeds
+    grid_sample (together: the reference's Spatial Transformer pair)."""
+    if hasattr(out_shape, "_data"):
+        out_shape = [int(v) for v in np.asarray(out_shape._data)]
+    n, _, h, w = [int(v) for v in out_shape]
+    return apply_op(_affine_grid, theta, n=n, h=h, w=w,
+                    align_corners=bool(align_corners))

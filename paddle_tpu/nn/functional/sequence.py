@@ -26,7 +26,8 @@ import numpy as np
 from ...framework.core import Tensor, apply_op
 
 __all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
-           "sequence_reverse", "sequence_softmax", "sequence_expand"]
+           "sequence_reverse", "sequence_softmax", "sequence_expand",
+           "edit_distance"]
 
 
 def _mask(lengths, maxlen, dtype):
@@ -117,3 +118,87 @@ def sequence_expand(x, ref_lengths, name=None):
                       else ref_lengths).astype(np.int64)
     idx = jnp.asarray(np.repeat(np.arange(arr.shape[0]), reps))
     return apply_op(lambda a, i: jnp.take(a, i, axis=0), x, idx)
+
+
+def _edit_distance(hyp, hyp_len, ref, ref_len):
+    """Levenshtein DP, batched: hyp [B,T], ref [B,L] padded int tokens with
+    per-row lengths. Row-by-row DP as a lax.scan over hypothesis tokens —
+    the O(T·L) wavefront is vectorized over L (reference
+    operators/edit_distance_op.h computes the same table serially)."""
+    B, T = hyp.shape
+    L = ref.shape[1]
+    cols = jnp.arange(L + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(cols, (B, L + 1))          # dist(0, j) = j
+
+    def step(carry, it):
+        prev, i = carry, it
+        tok = hyp[:, i]                                # [B]
+        # dp[i, j] for j=0..L
+        sub_cost = (ref != tok[:, None]).astype(jnp.float32)   # [B, L]
+        del_ = prev + 1.0                              # delete hyp token
+        # scan over j is inherent; use the standard trick: compute with
+        # lax.associative-free sequential min via cummin formulation.
+        # dp[j] = min(prev[j] + 1, prev[j-1] + sub, dp[j-1] + 1)
+        # The dp[j-1]+1 chain equals min over k<=j of (cand[k] + (j-k)):
+        cand = jnp.minimum(del_[:, 1:], prev[:, :-1] + sub_cost)  # [B, L]
+        first = prev[:, 0:1] + 1.0                     # dp[i, 0] = i+1
+        seed = jnp.concatenate([first, cand], axis=1)  # [B, L+1]
+        shifted = seed - cols[None, :]
+        chain = jax.lax.cummin(shifted, axis=1) + cols[None, :]
+        # mask: rows shorter than i keep their previous values frozen
+        live = (i < hyp_len)[:, None]
+        new = jnp.where(live, chain, prev)
+        return new, None
+
+    dp, _ = jax.lax.scan(step, row0, jnp.arange(T))
+    out = dp[jnp.arange(B), ref_len]
+    return out[:, None]
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between padded token sequences (reference
+    operators/edit_distance_op.h + fluid.layers.edit_distance; the LoD
+    inputs become padded-dense + lengths per the LoD decision in README).
+
+    input [B, T] int hypothesis tokens, label [B, L] int references.
+    Returns (distances [B, 1] float32, sequence_num [1] int64). With
+    ``normalized`` each distance is divided by the reference length.
+    """
+    from ...framework.core import Tensor, apply_op
+
+    hyp = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    ref = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    B, T = hyp.shape
+    L = ref.shape[1]
+    hl = (input_length._data if isinstance(input_length, Tensor)
+          else jnp.asarray(input_length)) if input_length is not None \
+        else jnp.full((B,), T, jnp.int32)
+    rl = (label_length._data if isinstance(label_length, Tensor)
+          else jnp.asarray(label_length)) if label_length is not None \
+        else jnp.full((B,), L, jnp.int32)
+    if ignored_tokens:
+        # drop ignored tokens by compacting each row (host-side; matches
+        # the reference's preprocessing pass)
+        import numpy as _np
+
+        def compact(arr, lens):
+            a = _np.asarray(arr)
+            ls = _np.asarray(lens)
+            out = _np.zeros_like(a)
+            nl = _np.zeros_like(ls)
+            for b in range(a.shape[0]):
+                row = [t for t in a[b, :ls[b]] if t not in ignored_tokens]
+                out[b, :len(row)] = row
+                nl[b] = len(row)
+            return jnp.asarray(out), jnp.asarray(nl)
+
+        hyp, hl = compact(hyp, hl)
+        ref, rl = compact(ref, rl)
+    dist = apply_op(_edit_distance, Tensor(hyp), Tensor(hl.astype(jnp.int32)),
+                    Tensor(ref), Tensor(rl.astype(jnp.int32)))
+    if normalized:
+        denom = jnp.maximum(rl.astype(jnp.float32), 1.0)[:, None]
+        dist = Tensor(dist._data / denom)
+    seq_num = Tensor(jnp.asarray([B], jnp.int64))
+    return dist, seq_num

@@ -7,22 +7,27 @@ its data-dependent loop on host via a fixed-iteration lax.while formulation
 when traced sizes allow, else eager numpy — dynamic output shapes are
 inherently host-side, as in the reference's CPU kernel.
 
-deform_conv2d / read_file / decode_jpeg are intentionally absent: modulated
-deformable sampling is a gather-heavy op with no TPU-efficient layout (the
-reference only ships CUDA kernels), and file IO ops belong to the input
-pipeline (paddle_tpu.io + PIL/numpy), not the graph.
+read_file / decode_jpeg are intentionally absent: file IO ops belong to
+the input pipeline (paddle_tpu.io + PIL/numpy), not the graph.
+deform_conv2d is implemented as vectorized bilinear gathers + grouped
+einsum — gather-heavy (VPU-bound, not MXU-peak) but numerically exact vs
+the reference's modulated im2col.
 """
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, apply_op
+from ..nn.functional.common import _bilinear_batch
 from ..nn.layer.layers import Layer
 
-__all__ = ["yolo_box", "roi_align", "roi_pool", "nms", "box_iou",
-           "RoIAlign", "RoIPool"]
+__all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
+           "box_iou", "prior_box", "box_coder", "bipartite_match",
+           "multiclass_nms", "deform_conv2d", "RoIAlign", "RoIPool"]
 
 
 def _arr(x):
@@ -87,25 +92,8 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
 
 # -- roi align / pool -------------------------------------------------------
-
-def _bilinear(feat, y, x):
-    """feat [C,H,W]; y/x scalar float coords → [C]."""
-    H, W = feat.shape[1], feat.shape[2]
-    y0 = jnp.floor(y)
-    x0 = jnp.floor(x)
-    y1, x1 = y0 + 1, x0 + 1
-    wy1, wx1 = y - y0, x - x0
-    wy0, wx0 = 1 - wy1, 1 - wx1
-
-    def at(yy, xx):
-        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
-        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
-        v = feat[:, yi, xi]
-        ok = (yy >= -1) & (yy <= H) & (xx >= -1) & (xx <= W)
-        return jnp.where(ok, v, 0.0)
-
-    return (at(y0, x0) * wy0 * wx0 + at(y0, x1) * wy0 * wx1 +
-            at(y1, x0) * wy1 * wx0 + at(y1, x1) * wy1 * wx1)
+# bilinear gathers share one implementation: nn/functional/common.py
+# _bilinear_batch (bounds="clamp_sample" here — roi_align edge semantics)
 
 
 def _roi_align(x, boxes, box_image, output_size, spatial_scale,
@@ -134,14 +122,15 @@ def _roi_align(x, boxes, box_image, output_size, spatial_scale,
         ix, mx = (j + 0.5) / srx, j < srx
         gy = y1 + (jnp.arange(oh)[:, None] + iy[None, :]) * bin_h  # [oh,sr]
         gx = x1 + (jnp.arange(ow)[:, None] + ix[None, :]) * bin_w  # [ow,sr]
-        sample = jax.vmap(lambda yy: jax.vmap(
-            lambda xx: _bilinear(feat, yy, xx))(gx.reshape(-1)))(
-                gy.reshape(-1))                      # [oh*sr, ow*sr, C]
-        sample = sample.reshape(oh, sr, ow, sr, -1)
-        w = (my.astype(sample.dtype)[None, :, None, None, None]
-             * mx.astype(sample.dtype)[None, None, None, :, None])
-        return (jnp.sum(sample * w, axis=(1, 3)) / (sry * srx)
-                ).transpose(2, 0, 1)                 # [C,oh,ow]
+        ys = jnp.broadcast_to(gy.reshape(-1)[:, None],
+                              (oh * sr, ow * sr))
+        xs = jnp.broadcast_to(gx.reshape(-1)[None, :],
+                              (oh * sr, ow * sr))
+        sample = _bilinear_batch(feat, ys, xs, bounds="clamp_sample")
+        sample = sample.reshape(-1, oh, sr, ow, sr)   # [C,oh,sr,ow,sr]
+        w = (my.astype(sample.dtype)[None, None, :, None, None]
+             * mx.astype(sample.dtype)[None, None, None, None, :])
+        return jnp.sum(sample * w, axis=(2, 4)) / (sry * srx)  # [C,oh,ow]
 
     return jax.vmap(one_roi)(box_image, boxes)
 
@@ -287,3 +276,393 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     if top_k is not None:
         keep = keep[:top_k]
     return Tensor(jnp.asarray(np.asarray(keep, np.int64)))
+
+
+# -- SSD detection family (reference paddle/fluid/operators/detection/) -----
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference detection/prior_box_op.h:52 — same box
+    emission order, incl. the min_max_aspect_ratios_order switch).
+
+    input [N,C,H,W] feature map, image [N,C,Him,Wim]. Returns
+    (boxes [H,W,num_priors,4], variances [H,W,num_priors,4]) — pure
+    host-side geometry (static given shapes), no device compute.
+    """
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] if max_sizes else []
+    if max_sizes:
+        assert len(max_sizes) == len(min_sizes), \
+            "max_sizes must pair with min_sizes"
+    # ExpandAspectRatios (prior_box_op.h:27): dedup, keep 1.0 first, flip
+    ars = [1.0]
+    for ar in aspect_ratios:
+        ar = float(ar)
+        if any(abs(ar - e) < 1e-6 for e in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+
+    boxes_per_pos = []
+
+    def emit(cx, cy, bw, bh):
+        boxes_per_pos.append(((cx - bw) / iw, (cy - bh) / ih,
+                              (cx + bw) / iw, (cy + bh) / ih))
+
+    rows = []
+    for h in range(fh):
+        row = []
+        for w in range(fw):
+            boxes_per_pos = []
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for s, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    emit(cx, cy, ms / 2.0, ms / 2.0)
+                    if max_sizes:
+                        r = math.sqrt(ms * max_sizes[s]) / 2.0
+                        emit(cx, cy, r, r)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(cx, cy, ms * math.sqrt(ar) / 2.0,
+                             ms / math.sqrt(ar) / 2.0)
+                else:
+                    for ar in ars:
+                        emit(cx, cy, ms * math.sqrt(ar) / 2.0,
+                             ms / math.sqrt(ar) / 2.0)
+                    if max_sizes:
+                        r = math.sqrt(ms * max_sizes[s]) / 2.0
+                        emit(cx, cy, r, r)
+            row.append(boxes_per_pos)
+        rows.append(row)
+    out = np.asarray(rows, np.float32)                 # [H,W,P,4]
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference
+    detection/box_coder_op.h:41 EncodeCenterSize / :118 DecodeCenterSize,
+    same variance and +1-for-unnormalized conventions)."""
+    pb = _arr(prior_box).astype(jnp.float32)
+    tb = _arr(target_box).astype(jnp.float32)
+    norm = bool(box_normalized)
+    off = 0.0 if norm else 1.0
+
+    var_arr = None
+    var_list = None
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            var_list = jnp.asarray(prior_box_var, jnp.float32)
+        else:
+            var_arr = _arr(prior_box_var).astype(jnp.float32)
+
+    pw = pb[:, 2] - pb[:, 0] + off
+    ph = pb[:, 3] - pb[:, 1] + off
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+
+    if code_type == "encode_center_size":
+        # tb [N,4] targets x pb [M,4] priors -> [N,M,4]
+        tw = tb[:, 2] - tb[:, 0] + off
+        th = tb[:, 3] - tb[:, 1] + off
+        tcx = (tb[:, 0] + tb[:, 2]) / 2
+        tcy = (tb[:, 1] + tb[:, 3]) / 2
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(jnp.abs(tw[:, None] / pw[None, :])),
+            jnp.log(jnp.abs(th[:, None] / ph[None, :])),
+        ], axis=-1)
+        if var_arr is not None:
+            out = out / var_arr[None, :, :]
+        elif var_list is not None:
+            out = out / var_list[None, None, :]
+        return Tensor(out)
+
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+    # tb [N,M,4] deltas; priors broadcast along axis (0: per column j,
+    # 1: per row i)
+    exp = (lambda a: a[None, :]) if axis == 0 else (lambda a: a[:, None])
+    if var_arr is not None:
+        v = var_arr[None, :, :] if axis == 0 else var_arr[:, None, :]
+    elif var_list is not None:
+        v = var_list[None, None, :]
+    else:
+        v = jnp.ones((1, 1, 4), jnp.float32)
+    cx = v[..., 0] * tb[..., 0] * exp(pw) + exp(pcx)
+    cy = v[..., 1] * tb[..., 1] * exp(ph) + exp(pcy)
+    w = jnp.exp(v[..., 2] * tb[..., 2]) * exp(pw)
+    h = jnp.exp(v[..., 3] * tb[..., 3]) * exp(ph)
+    out = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - off, cy + h / 2 - off], axis=-1)
+    return Tensor(out)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference detection/bipartite_match_op.cc
+    BipartiteMatch): rows = entities (gt boxes), cols = candidates
+    (priors). Returns (match_indices [1,M] int32 row-per-column with -1
+    unmatched, match_dist [1,M]). match_type='per_prediction' additionally
+    assigns any unmatched column whose best row distance > dist_threshold
+    (argmax match, reference :118)."""
+    d = np.asarray(_arr(dist_matrix), np.float32)
+    assert d.ndim == 2, "bipartite_match expects a 2-D distance matrix"
+    rows, cols = d.shape
+    match_idx = np.full((cols,), -1, np.int32)
+    match_dist = np.zeros((cols,), np.float32)
+    work = d.copy()
+    for _ in range(min(rows, cols)):
+        i, j = np.unravel_index(np.argmax(work), work.shape)
+        if work[i, j] <= 0:
+            break
+        match_idx[j] = i
+        match_dist[j] = d[i, j]
+        work[i, :] = -1.0
+        work[:, j] = -1.0
+    if match_type == "per_prediction":
+        thr = float(dist_threshold if dist_threshold is not None else 0.5)
+        best_row = d.argmax(axis=0)
+        best = d.max(axis=0)
+        extra = (match_idx == -1) & (best > thr)
+        match_idx[extra] = best_row[extra]
+        match_dist[extra] = best[extra]
+    return (Tensor(jnp.asarray(match_idx[None, :])),
+            Tensor(jnp.asarray(match_dist[None, :])))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                   keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """Multi-class NMS (reference detection/multiclass_nms_op.cc, host
+    semantics — dynamic output): bboxes [N,M,4], scores [N,C,M] — or the
+    LoD-style form bboxes [M_total,4], scores [C,M_total] with ``rois_num``
+    [N] giving per-image box counts (reference multiclass_nms3). Returns
+    (out [K,6] rows (label, score, x1,y1,x2,y2), rois_num [N]) and the
+    kept flat indices when return_index."""
+    b = np.asarray(_arr(bboxes), np.float32)
+    s = np.asarray(_arr(scores), np.float32)
+    if s.ndim == 2:
+        if rois_num is None:
+            raise ValueError(
+                "multiclass_nms with 2-D scores needs rois_num (per-image "
+                "box counts, reference multiclass_nms3 RoisNum input)")
+        counts = [int(v) for v in np.asarray(_arr(rois_num))]
+        bounds = np.cumsum([0] + counts)
+        outs = []
+        for n in range(len(counts)):
+            lo, hi = bounds[n], bounds[n + 1]
+            outs.append(multiclass_nms(
+                b[None, lo:hi], s[None, :, lo:hi], score_threshold,
+                nms_top_k, keep_top_k, nms_threshold, normalized, nms_eta,
+                background_label, return_index=True))
+        out = np.concatenate([np.asarray(o[0]._data) for o in outs]) \
+            if outs else np.zeros((0, 6), np.float32)
+        nums = np.concatenate([np.asarray(o[1]._data) for o in outs]) \
+            if outs else np.zeros((0,), np.int32)
+        idx = np.concatenate(
+            [np.asarray(o[2]._data) + bounds[n] for n, o in enumerate(outs)]
+        ) if outs else np.zeros((0,), np.int64)
+        res = (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(nums)))
+        if return_index:
+            return res + (Tensor(jnp.asarray(idx)),)
+        return res
+    if rois_num is not None:
+        raise ValueError("rois_num only applies to the 2-D LoD-style "
+                         "inputs; batched [N,C,M] scores already carry the "
+                         "image grouping")
+    N, C, M = s.shape
+
+    def area_iou(bb):
+        off = 0.0 if normalized else 1.0
+        x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
+        area = (x2 - x1 + off) * (y2 - y1 + off)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        iw = np.clip(ix2 - ix1 + off, 0, None)
+        ih = np.clip(iy2 - iy1 + off, 0, None)
+        inter = iw * ih
+        return inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+    all_rows, all_idx, per_img = [], [], []
+    for n in range(N):
+        iou = area_iou(b[n])
+        kept = []  # (label, score, box_idx)
+        for c in range(C):
+            if c == background_label:
+                continue
+            cand = np.where(s[n, c] > score_threshold)[0]
+            cand = cand[np.argsort(-s[n, c][cand], kind="stable")]
+            if nms_top_k > 0:
+                cand = cand[:nms_top_k]
+            alive = list(cand)
+            thr = nms_threshold
+            while alive:
+                i = alive.pop(0)
+                kept.append((c, s[n, c, i], i))
+                alive = [j for j in alive if iou[i, j] <= thr]
+                if nms_eta < 1.0 and thr > 0.5:
+                    thr *= nms_eta
+        kept.sort(key=lambda t: -t[1])
+        if keep_top_k > 0:
+            kept = kept[:keep_top_k]
+        for c, sc, i in kept:
+            all_rows.append([float(c), float(sc)] + list(b[n, i]))
+            all_idx.append(n * M + i)
+        per_img.append(len(kept))
+    out = (np.asarray(all_rows, np.float32) if all_rows
+           else np.zeros((0, 6), np.float32))
+    res = (Tensor(jnp.asarray(out)),
+           Tensor(jnp.asarray(np.asarray(per_img, np.int32))))
+    if return_index:
+        return res + (Tensor(jnp.asarray(np.asarray(all_idx, np.int64))),)
+    return res
+
+
+# -- psroi_pool -------------------------------------------------------------
+
+def _psroi_pool(x, boxes, box_image, output_size, spatial_scale, out_channels):
+    oh, ow = output_size
+
+    def one_roi(img_idx, box):
+        feat = x[img_idx]                            # [C, H, W]
+        C, H, W = feat.shape
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / ow, rh / oh
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        # bin index of each pixel (floor) with inside-bin masks
+        ybin = jnp.floor((ys - y1) / bin_h).astype(jnp.int32)
+        xbin = jnp.floor((xs - x1) / bin_w).astype(jnp.int32)
+        in_y = (ys >= y1) & (ys < y2)
+        in_x = (xs >= x1) & (xs < x2)
+        # mask [oh, H] / [ow, W]
+        my = ((ybin[None, :] == jnp.arange(oh)[:, None]) & in_y[None, :])
+        mx = ((xbin[None, :] == jnp.arange(ow)[:, None]) & in_x[None, :])
+        myf = my.astype(feat.dtype)
+        mxf = mx.astype(feat.dtype)
+        # position-sensitive: output channel c, bin (i,j) pools input
+        # channel c*oh*ow + i*ow + j — contract each channel against ITS
+        # bin's masks only (an unrestricted chw,ih,jw->cij einsum would
+        # compute the full cross product and keep 1/(oh*ow) of it)
+        featp = feat.reshape(out_channels, oh, ow, H, W)
+        sums = jnp.einsum("cijhw,ih,jw->cij", featp, myf, mxf)
+        counts = jnp.einsum("ih,jw->ij", myf, mxf)
+        return sums / jnp.maximum(counts, 1.0)[None, :, :]
+
+    return jax.vmap(one_roi)(box_image, boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference detection R-FCN op
+    operators/psroi_pool_op.h): x [N, out_c*oh*ow, H, W]; returns
+    [R, out_c, oh, ow] where bin (i,j) averages input channel
+    c*oh*ow + i*ow + j over the bin region."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    C = int(x.shape[1])
+    if C % (oh * ow) != 0:
+        raise ValueError(
+            f"psroi_pool needs channels ({C}) divisible by "
+            f"output_size^2 ({oh * ow})")
+    bn = np.asarray(_arr(boxes_num))
+    box_image = jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+    return apply_op(_psroi_pool, x, boxes, box_image,
+                    output_size=(oh, ow), spatial_scale=float(spatial_scale),
+                    out_channels=C // (oh * ow))
+
+
+# -- deformable conv --------------------------------------------------------
+
+def _deform_conv2d(x, offset, mask, weight, bias, stride, padding, dilation,
+                   deformable_groups, groups):
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    K = kh * kw
+    dg = deformable_groups
+    cpg = Cin // dg  # channels per deformable group
+
+    # base sampling grid [K, Ho, Wo]
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing="ij")
+    base_y = ky.reshape(K, 1, 1) + oy[None, :, None]
+    base_x = kx.reshape(K, 1, 1) + ox[None, None, :]
+
+    def one_image(img, off, msk):
+        # off [2*dg*K, Ho, Wo] layout (dg, K, 2:(y,x)) per reference
+        off = off.reshape(dg, K, 2, Ho, Wo)
+        cols = []
+        for g in range(dg):
+            ys = base_y + off[g, :, 0]
+            xs = base_x + off[g, :, 1]
+            sampled = _bilinear_batch(img[g * cpg:(g + 1) * cpg], ys, xs,
+                                      bounds="zero_corner")
+            if msk is not None:
+                sampled = sampled * msk.reshape(dg, K, Ho, Wo)[g][None]
+            cols.append(sampled)                     # [cpg, K, Ho, Wo]
+        return jnp.concatenate(cols, axis=0)         # [Cin, K, Ho, Wo]
+
+    cols = jax.vmap(one_image)(x, offset,
+                               mask if mask is not None else
+                               jnp.ones((N, dg * K, Ho, Wo), x.dtype))
+    # grouped conv as einsum over (Cin_g, K)
+    cols = cols.reshape(N, groups, Cin_g, K, Ho, Wo)
+    wg = weight.reshape(groups, Cout // groups, Cin_g, kh * kw)
+    out = jnp.einsum("ngckyz,gock->ngoyz", cols, wg)
+    out = out.reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, Cout, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference operators/deformable_conv_op.h
+    modulated im2col + GEMM): per-kernel-point learned (dy, dx) offsets,
+    optional modulation mask (v2). offset [N, 2*dg*kh*kw, Ho, Wo] with
+    (y, x) interleaved per point; mask [N, dg*kh*kw, Ho, Wo]."""
+    def norm2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    args = [x, offset, weight] + ([bias] if bias is not None else []) + \
+        ([mask] if mask is not None else [])
+
+    def impl(x_, off_, w_, *rest):
+        b_ = rest[0] if bias is not None else None
+        m_ = rest[-1] if mask is not None else None
+        return _deform_conv2d(x_, off_, m_, w_, b_, norm2(stride),
+                              norm2(padding), norm2(dilation),
+                              int(deformable_groups), int(groups))
+
+    return apply_op(impl, *args)

@@ -228,3 +228,28 @@ class TestElasticScaleOut:
         with open(os.path.join(workdir, "trace_n0.jsonl")) as f:
             sizes = [json.loads(l)["nproc"] for l in f]
         assert sizes[0] == 2 and sizes[-1] == 3, sizes
+
+
+class TestElasticRelaunchReuse:
+    def test_reused_kv_dir_clears_tombstones(self, tmp_path):
+        """A second launch with the same job_id must not inherit the first
+        run's dead-marks or completion flag."""
+        from paddle_tpu.distributed.launch import elastic_launch
+
+        kv = FileKVStore(str(tmp_path / "kv"))
+        mgr = ElasticManager(kv, "t3", min_np=2, max_np=4)
+        mgr.mark_dead("n3")
+        mgr.set_completed()
+
+        workdir = str(tmp_path / "work")
+        os.makedirs(workdir)
+        script = str(tmp_path / "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER.format(repo=REPO))
+        code = elastic_launch([script, workdir], kv_dir=str(tmp_path / "kv"),
+                              job_id="t3", min_np=2, max_np=4,
+                              initial_np=4, max_restarts=1,
+                              quorum_timeout=30.0)
+        assert code == 0
+        final_map = ElasticManager(kv, "t3", 2, 4).last_rank_map()
+        assert sorted(final_map) == ["n0", "n1", "n2", "n3"]
