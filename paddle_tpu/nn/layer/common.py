@@ -51,7 +51,13 @@ class Linear(Layer):
 
 
 class Embedding(Layer):
-    """Parity: reference python/paddle/nn/layer/common.py Embedding."""
+    """Parity: reference python/paddle/nn/layer/common.py Embedding.
+
+    ``sparse=True`` is accepted and IGNORED by design: it selects a
+    SelectedRows gradient storage format in the reference; here the
+    backward is a dense scatter-add compiled into the step (see README
+    "LoDTensor / SelectedRows decision"). Values and gradients are
+    identical either way (tests/test_sequence_semantics.py)."""
 
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None):

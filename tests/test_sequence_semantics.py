@@ -1,0 +1,103 @@
+"""Pin the padded-dense LoD translation semantics (README "LoDTensor /
+SelectedRows decision"): every sequence op over [batch, max_len, ...] +
+lengths must match a scalar-loop golden over the ragged rows the reference
+expressed as LoD (framework/lod_tensor.h:109), and sparse=True embeddings
+must be gradient-identical to dense (selected_rows.h:41 is a storage
+format, not different math)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _ragged(rng, lens, dim=None):
+    return [rng.rand(l, dim).astype(np.float32) if dim
+            else rng.rand(l).astype(np.float32) for l in lens]
+
+
+class TestPaddedDenseSemantics:
+    """Each test: build the ragged rows, run the padded-dense op, compare
+    per-row against plain numpy on the unpadded row."""
+
+    def test_pad_unpad_roundtrip_is_lossless(self):
+        rng = np.random.RandomState(0)
+        rows = _ragged(rng, [3, 1, 4], dim=2)
+        padded, lens = F.sequence_pad(rows, pad_value=0.0)
+        assert padded.shape == [3, 4, 2]
+        np.testing.assert_array_equal(np.asarray(lens._data), [3, 1, 4])
+        back = F.sequence_unpad(padded, lens)
+        for orig, got in zip(rows, back):
+            np.testing.assert_array_equal(got.numpy(), orig)
+
+    def test_softmax_matches_per_row_numpy_and_zeros_padding(self):
+        rng = np.random.RandomState(1)
+        lens = [4, 2, 5]
+        rows = _ragged(rng, lens)
+        padded, lt = F.sequence_pad(rows, pad_value=7.7)  # poison padding
+        out = F.sequence_softmax(padded, lt).numpy()
+        for i, row in enumerate(rows):
+            e = np.exp(row - row.max())
+            np.testing.assert_allclose(out[i, :lens[i]], e / e.sum(),
+                                       rtol=1e-5, atol=1e-6)
+            # padded tail is exactly zero — poison never leaks
+            np.testing.assert_array_equal(out[i, lens[i]:], 0.0)
+
+    def test_reverse_matches_per_row_numpy_padding_in_place(self):
+        rng = np.random.RandomState(2)
+        lens = [3, 5, 1]
+        rows = _ragged(rng, lens)
+        padded, lt = F.sequence_pad(rows, pad_value=9.0)
+        out = F.sequence_reverse(padded, lt).numpy()
+        for i, row in enumerate(rows):
+            np.testing.assert_array_equal(out[i, :lens[i]], row[::-1])
+            np.testing.assert_array_equal(out[i, lens[i]:], 9.0)
+
+    def test_expand_repeats_rows(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out = F.sequence_expand(_t(x), _t(np.array([2, 3]))).numpy()
+        np.testing.assert_array_equal(
+            out, [x[0], x[0], x[1], x[1], x[1]])
+
+    def test_mask_lengths(self):
+        m = F.sequence_mask(_t(np.array([2, 0, 3])), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            m, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+
+class TestSparseEmbeddingDecision:
+    """sparse=True is a gradient-storage flag in the reference
+    (SelectedRows); here it must be accepted and produce identical values
+    AND identical dense gradients."""
+
+    def test_forward_and_grad_identical(self):
+        rng = np.random.RandomState(3)
+        w = rng.rand(10, 4).astype(np.float32)
+        ids = np.array([[1, 3, 3], [0, 9, 1]], np.int64)
+
+        outs, grads = [], []
+        for sparse in (False, True):
+            paddle.seed(7)
+            emb = paddle.nn.Embedding(10, 4, sparse=sparse)
+            emb.weight.set_value(_t(w))
+            out = emb(_t(ids))
+            loss = paddle.sum(out * out)
+            loss.backward()
+            outs.append(out.numpy())
+            grads.append(emb.weight.grad.numpy())
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_array_equal(grads[0], grads[1])
+        # the dense grad is the scatter-add of the one-hot backward:
+        # repeated id 3 accumulates both contributions
+        g = grads[0]
+        assert np.abs(g[3]).sum() > 0 and np.abs(g[2]).sum() == 0
+
+    def test_functional_embedding_sparse_flag(self):
+        w = _t(np.arange(12, dtype=np.float32).reshape(6, 2))
+        ids = _t(np.array([0, 5], np.int64))
+        a = F.embedding(ids, w, sparse=False).numpy()
+        b = F.embedding(ids, w, sparse=True).numpy()
+        np.testing.assert_array_equal(a, b)
