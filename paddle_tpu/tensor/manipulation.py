@@ -497,10 +497,13 @@ def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):  # noq
         pw = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
     else:
         # partial spec applies to trailing spatial dims per data_format
-        df = data_format or ("NCHW" if nd == 4 else ("NCL" if nd == 3 else "NCDHW"))
+        df = data_format or {3: "NCL", 4: "NCHW", 5: "NCDHW"}.get(nd)
         n_spatial = len(pad) // 2
         pw = [(0, 0)] * nd
-        if df.startswith("NC"):
+        if df is None:
+            # no channel layout (1-D/2-D tensors): pad the trailing dims
+            spatial_dims = list(range(nd - n_spatial, nd))
+        elif df.startswith("NC"):
             spatial_dims = list(range(2, 2 + n_spatial))
         else:
             spatial_dims = list(range(1, 1 + n_spatial))

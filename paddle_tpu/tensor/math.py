@@ -290,7 +290,10 @@ def _logcumsumexp(x, axis=None):
     if axis is None:
         x = x.reshape(-1)
         axis = 0
-    m = jax.lax.cummax(x, axis=axis)
+    # subtract the GLOBAL max along the axis: a running (cummax) shift is
+    # inconsistent across the cumsum — exp(x_i - m_j) terms with different
+    # m_j cannot be summed directly (caught by the op-output sweep)
+    m = jnp.max(x, axis=axis, keepdims=True)
     return jnp.log(jnp.cumsum(jnp.exp(x - m), axis=axis)) + m
 
 
