@@ -447,3 +447,91 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
         return p - (lr * trust * r).astype(p.dtype), nm1, nm2, b1p * b1, b2p * b2
+
+
+class Ftrl(Optimizer):
+    """FTRL — Follow The Regularized Leader (reference
+    python/paddle/fluid/optimizer.py FtrlOptimizer over
+    operators/optimizers/ftrl_op.h: squared/linear accumulators, l1
+    shrinkage, lr_power schedule)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, regularization, grad_clip,
+                         name)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._lr_power = float(lr_power)
+
+    def _slot_names(self):
+        return ["squared_accum", "linear_accum"]
+
+    def _hyper(self, p):
+        return {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power}
+
+    @staticmethod
+    def _pure_update(p, g, lr, s_acc, l_acc, l1, l2, lr_power):
+        lr = lr.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        new_acc = s_acc + g32 * g32
+        if lr_power == -0.5:
+            l_acc = l_acc + g32 - (jnp.sqrt(new_acc) - jnp.sqrt(s_acc)) / lr * p32
+            y = jnp.sqrt(new_acc) / lr + 2.0 * l2
+        else:
+            l_acc = l_acc + g32 - (new_acc ** -lr_power
+                                   - s_acc ** -lr_power) / lr * p32
+            y = new_acc ** -lr_power / lr + 2.0 * l2
+        x = l1 * jnp.sign(l_acc) - l_acc
+        pre_shrink = x / y
+        new_p = jnp.where(jnp.abs(l_acc) > l1, pre_shrink, 0.0)
+        return new_p.astype(p.dtype), new_acc, l_acc
+
+
+FtrlOptimizer = Ftrl
+
+
+class Dpsgd(Optimizer):
+    """DP-SGD — differentially private SGD (reference
+    python/paddle/fluid/optimizer.py DpsgdOptimizer over
+    operators/optimizers/dpsgd_op.h): per-tensor L2 clip to ``clip``, one
+    gaussian noise sample scaled by 1/batch_size per update. The noise
+    comes from the framework RNG (seeded, reproducible) instead of the
+    reference's time(NULL)-seeded minstd engine."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._clip = float(clip)
+        self._batch_size = float(batch_size)
+        self._sigma = float(sigma)
+        self._dp_update = None
+
+    def step(self):
+        from ..framework import random as grandom
+
+        if self._dp_update is None:
+            clip, bs = self._clip, self._batch_size
+
+            @jax.jit
+            def upd(p, g, lr, noise):
+                g32 = g.astype(jnp.float32)
+                l2 = jnp.sqrt(jnp.sum(jnp.square(g32)))
+                scale = jnp.where(l2 > clip, l2 / clip, 1.0)
+                step_ = lr * (g32 / scale + noise / bs)
+                return (p.astype(jnp.float32) - step_).astype(p.dtype)
+
+            self._dp_update = upd
+        params_grads = [(p, p.grad) for p in self._parameter_list or []
+                        if p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        for p, g in params_grads:
+            garr = g._data if isinstance(g, Tensor) else g
+            noise = self._sigma * jax.random.normal(grandom.next_key(), ())
+            p._data = self._dp_update(p._data, garr, lr, noise)
+
+
+DpsgdOptimizer = Dpsgd
