@@ -1,0 +1,126 @@
+"""Enforce/error machinery — structured input validation for the public API.
+
+Parity: reference PADDLE_ENFORCE (paddle/fluid/platform/enforce.h) and its
+Python surface (`check_variable_and_dtype`/`check_type`/`check_dtype` in
+python/paddle/fluid/data_feeder.py): every public op validates its inputs
+and raises a rich, categorized error with the op name and a hint — instead
+of letting a raw jax/XLA traceback surface three layers down.
+
+Error categories mirror paddle/fluid/platform/errors.h; each class also
+subclasses the natural Python builtin (TypeError/ValueError) so generic
+`except ValueError` handling and existing tests keep working.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PreconditionNotMetError",
+    "UnimplementedError", "UnavailableError", "enforce", "check_type",
+    "check_dtype", "check_axis", "check_shape_broadcast",
+]
+
+
+class EnforceNotMet(Exception):
+    """Base of all enforce failures (reference platform::EnforceNotMet)."""
+
+    category = "Error"
+
+    def __init__(self, message: str, hint: Optional[str] = None):
+        text = f"{self.category}: {message}"
+        if hint:
+            text += f"\n  [Hint: {hint}]"
+        super().__init__(text)
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    category = "InvalidArgumentError"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    category = "NotFoundError"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    category = "OutOfRangeError"
+
+
+class AlreadyExistsError(EnforceNotMet, ValueError):
+    category = "AlreadyExistsError"
+
+
+class PreconditionNotMetError(EnforceNotMet, RuntimeError):
+    category = "PreconditionNotMetError"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    category = "UnimplementedError"
+
+
+class UnavailableError(EnforceNotMet, RuntimeError):
+    category = "UnavailableError"
+
+
+class TypeEnforceError(EnforceNotMet, TypeError):
+    category = "InvalidArgumentError"
+
+
+def enforce(condition: Any, message: str, hint: Optional[str] = None,
+            exc=InvalidArgumentError) -> None:
+    """PADDLE_ENFORCE analog: raise a categorized error when falsy."""
+    if not condition:
+        raise exc(message, hint)
+
+
+def check_type(x, name: str, expected_types, op_name: str) -> None:
+    """reference data_feeder.check_type: typed argument validation."""
+    if not isinstance(expected_types, tuple):
+        expected_types = (expected_types,)
+    if not isinstance(x, expected_types):
+        want = "/".join(t.__name__ for t in expected_types)
+        raise TypeEnforceError(
+            f"The type of '{name}' in {op_name} must be {want}, but "
+            f"received {type(x).__name__}.")
+
+
+def check_dtype(dtype, name: str, expected: Iterable[str],
+                op_name: str) -> None:
+    """reference data_feeder.check_dtype: dtype whitelist validation."""
+    d = str(dtype)
+    for pref in ("paddle.", "jax.numpy.", "numpy."):
+        if d.startswith(pref):
+            d = d[len(pref):]
+    expected = list(expected)
+    if d not in expected:
+        raise InvalidArgumentError(
+            f"The data type of '{name}' in {op_name} must be one of "
+            f"{expected}, but received {d}.")
+
+
+def check_axis(axis: int, ndim: int, op_name: str) -> int:
+    """Validate and normalize a dim index (reference enforce pattern in
+    every axis-taking op): axis in [-ndim, ndim)."""
+    if not isinstance(axis, int):
+        raise TypeEnforceError(
+            f"The type of 'axis' in {op_name} must be int, but received "
+            f"{type(axis).__name__}.")
+    if not (-ndim <= axis < max(ndim, 1)):
+        raise OutOfRangeError(
+            f"The axis of {op_name} is expected in range [{-ndim}, "
+            f"{ndim}), but received {axis}.",
+            hint=f"the input has {ndim} dimensions")
+    return axis + ndim if axis < 0 else axis
+
+
+def check_shape_broadcast(s1: Sequence[int], s2: Sequence[int],
+                          op_name: str) -> None:
+    """Validate numpy-style broadcastability with an actionable message."""
+    a, b = list(s1)[::-1], list(s2)[::-1]
+    for i in range(min(len(a), len(b))):
+        if a[i] != b[i] and a[i] != 1 and b[i] != 1:
+            raise InvalidArgumentError(
+                f"Broadcast dimension mismatch in {op_name}: operand "
+                f"shapes {list(s1)} and {list(s2)} are incompatible at "
+                f"dim {len(a) - 1 - i if len(a) >= len(b) else len(b) - 1 - i}.",
+                hint="each trailing dimension must match or be 1")

@@ -44,9 +44,133 @@ __all__ = [
     "name_scope", "device_guard", "py_func", "save_inference_model",
     "load_inference_model", "gradients", "append_backward", "nn",
     "cond", "while_loop", "BuildStrategy", "ExecutionStrategy", "ParallelEnv",
+    "Block", "Operator", "Variable",
 ]
 
 _static_mode = [False]
+
+
+class Operator:
+    """Introspection view over one recorded op (reference framework.py
+    Operator: .type, .input_arg_names, .output_arg_names, .attr)."""
+
+    def __init__(self, block: "Block", rec: OpRecord, idx: int):
+        self._block = block
+        self._rec = rec
+        self.idx = idx
+
+    @property
+    def type(self):  # noqa: A003
+        return self._rec.name
+
+    @property
+    def input_arg_names(self) -> List[str]:
+        names = []
+        for a in self._rec.args:
+            if isinstance(a, SymExpr):
+                names.append(self._block._name_of_expr(a))
+            elif isinstance(a, Tensor):
+                names.append(a.name or f"tensor_{id(a)}")
+        return names
+
+    @property
+    def output_arg_names(self) -> List[str]:
+        return [self._block._op_output_name(self._rec, k)
+                for k in range(self._rec.n_outputs)]
+
+    def attr(self, name: str):
+        return self._rec.attrs.get(name)
+
+    def all_attrs(self) -> Dict[str, object]:
+        return dict(self._rec.attrs)
+
+    @property
+    def attr_names(self) -> List[str]:
+        return list(self._rec.attrs)
+
+    def __repr__(self):
+        ins = ", ".join(self.input_arg_names)
+        outs = ", ".join(self.output_arg_names)
+        return f"{{{outs}}} = {self.type}(inputs=[{ins}], **{self.all_attrs()})"
+
+
+class Variable:
+    """Introspection view over a program value (reference framework.py
+    Variable: .name/.shape/.dtype/.persistable)."""
+
+    def __init__(self, name, shape, dtype, persistable=False, tensor=None):
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.persistable = persistable
+        self._tensor = tensor
+
+    def __repr__(self):
+        kind = "persist " if self.persistable else ""
+        return f"var {self.name} : {kind}{self.shape} {self.dtype}"
+
+
+class Block:
+    """Introspection view over a Program's op list (reference framework.py
+    Block). The TPU program is a flat DAG — control flow lives inside
+    traced lax.cond/while bodies, not nested blocks — so there is exactly
+    one block, matching the reference's global block for the same code."""
+
+    def __init__(self, program: "Program", idx: int = 0):
+        self.program = program
+        self.idx = idx
+
+    # -- naming --------------------------------------------------------------
+    def _op_output_name(self, rec: OpRecord, index: int) -> str:
+        i = self.program.ops.index(rec)
+        suffix = f".{index}" if rec.n_outputs > 1 else ""
+        return f"{rec.name}_{i}.tmp_0{suffix}"
+
+    def _name_of_expr(self, e: SymExpr) -> str:
+        if e.kind == "feed":
+            return e.name
+        if e.kind == "tensor":
+            return e.tensor.name or f"tensor_{id(e.tensor)}"
+        return self._op_output_name(e.op, e.index)
+
+    # -- reference surface ---------------------------------------------------
+    @property
+    def ops(self) -> List[Operator]:
+        return [Operator(self, rec, i)
+                for i, rec in enumerate(self.program.ops)]
+
+    @property
+    def vars(self) -> Dict[str, Variable]:
+        out = {}
+        for name, t in self.program.feed_vars.items():
+            out[name] = Variable(name, t._data.shape, str(t._data.dtype))
+        for p in self.program.all_parameters():
+            n = p.name or f"tensor_{id(p)}"
+            out[n] = Variable(n, p._data.shape, str(p._data.dtype),
+                              persistable=True, tensor=p)
+        for rec in self.program.ops:
+            for k in range(rec.n_outputs):
+                n = self._op_output_name(rec, k)
+                out[n] = Variable(n, (), "unknown")
+        return out
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            from ..framework.enforce import NotFoundError
+
+            raise NotFoundError(f"Variable {name!r} is not found in block "
+                                f"{self.idx}.")
+        return v
+
+    def __repr__(self):
+        lines = [f"block {self.idx} {{"]
+        for v in self.vars.values():
+            lines.append(f"  {v!r}")
+        for op in self.ops:
+            lines.append(f"  {op!r}")
+        lines.append("}")
+        return "\n".join(lines)
 
 
 class Program:
@@ -59,12 +183,31 @@ class Program:
         self.train_specs: List[tuple] = []   # (optimizer, loss SymbolicTensor)
         self.random_seed = None
 
-    def global_block(self):
-        return self
+    def global_block(self) -> Block:
+        return Block(self, 0)
+
+    def block(self, index: int) -> Block:
+        if index != 0:
+            from ..framework.enforce import OutOfRangeError
+
+            raise OutOfRangeError(
+                f"Program has 1 block (the flat DAG; control flow is traced "
+                f"into op bodies), block({index}) does not exist.")
+        return Block(self, 0)
+
+    def current_block(self) -> Block:
+        return Block(self, 0)
 
     @property
-    def blocks(self):
-        return [self]
+    def num_blocks(self) -> int:
+        return 1
+
+    @property
+    def blocks(self) -> List[Block]:
+        return [Block(self, 0)]
+
+    def list_vars(self) -> List["Variable"]:
+        return list(self.global_block().vars.values())
 
     def all_parameters(self):
         exprs = [t._expr for t in self.feed_vars.values()]
@@ -80,6 +223,12 @@ class Program:
         p.train_specs = [] if for_test else list(self.train_specs)
         p.random_seed = self.random_seed
         return p
+
+    def to_string(self, throw_on_error=False, with_details=False) -> str:
+        return repr(self.global_block())
+
+    def __str__(self):
+        return self.to_string()
 
     def __repr__(self):
         return (f"Program(feeds={list(self.feed_vars)}, ops={len(self.ops)}, "

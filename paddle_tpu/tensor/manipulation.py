@@ -34,9 +34,27 @@ def _reshape(x, shape):
 
 
 def reshape(x, shape, name=None):
+    from ..framework.enforce import InvalidArgumentError, check_type
+
+    check_type(x, "x", Tensor, "reshape")
     if isinstance(shape, Tensor):
         shape = shape.numpy().tolist()
     shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    n_unknown = sum(1 for s in shape if s == -1)
+    if n_unknown > 1:
+        raise InvalidArgumentError(
+            f"Only one dimension value of 'shape' in reshape can be -1, "
+            f"but received shape = {list(shape)}.")
+    import numpy as _np
+
+    known = int(_np.prod([s for s in shape if s != -1])) if shape else 1
+    total = int(_np.prod(x.shape)) if x.shape else 1
+    if (n_unknown == 0 and known != total) or             (n_unknown == 1 and (known == 0 or total % known != 0)):
+        raise InvalidArgumentError(
+            f"The 'shape' in reshape is invalid: input has {total} "
+            f"elements, shape = {list(shape)}.",
+            hint="the product of the target shape must equal the element "
+                 "count")
     return apply_op(_reshape, x, shape=shape)
 
 
@@ -45,7 +63,15 @@ def _transpose(x, perm):
 
 
 def transpose(x, perm, name=None):
-    return apply_op(_transpose, x, perm=tuple(int(p) for p in perm))
+    from ..framework.enforce import InvalidArgumentError
+
+    perm = tuple(int(p) for p in perm)
+    nd = x.ndim if hasattr(x, "ndim") else len(x.shape)
+    if sorted(perm) != list(range(nd)):
+        raise InvalidArgumentError(
+            f"The 'perm' in transpose must be a permutation of "
+            f"[0, ..., {nd - 1}], but received {list(perm)}.")
+    return apply_op(_transpose, x, perm=perm)
 
 
 def _concat_op(*xs, axis=0):
@@ -53,7 +79,23 @@ def _concat_op(*xs, axis=0):
 
 
 def concat(x, axis=0, name=None):
-    return apply_op(_concat_op, *x, axis=_ax(axis))
+    from ..framework.enforce import (InvalidArgumentError, check_axis,
+                                     check_type)
+
+    check_type(x, "x", (list, tuple), "concat")
+    if not x:
+        raise InvalidArgumentError("The input list of concat is empty.")
+    ax = check_axis(_ax(axis), x[0].ndim, "concat")
+    ref = list(x[0].shape)
+    for i, t in enumerate(x[1:], 1):
+        s = list(t.shape)
+        if len(s) != len(ref) or any(
+                a != b for d, (a, b) in enumerate(zip(s, ref)) if d != ax):
+            raise InvalidArgumentError(
+                f"The shapes of concat inputs must match except on the "
+                f"concat axis {ax}, but input 0 has shape {ref} and input "
+                f"{i} has shape {s}.")
+    return apply_op(_concat_op, *x, axis=ax)
 
 
 def _stack_op(*xs, axis=0):

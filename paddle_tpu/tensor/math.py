@@ -154,7 +154,17 @@ def _matmul(x, y, transpose_x=False, transpose_y=False):
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     from ..amp import maybe_autocast
+    from ..framework.enforce import InvalidArgumentError
 
+    if getattr(x, "ndim", 0) >= 2 and getattr(y, "ndim", 0) >= 2:
+        k1 = x.shape[-1] if not transpose_x else x.shape[-2]
+        k2 = y.shape[-2] if not transpose_y else y.shape[-1]
+        if int(k1) != int(k2):
+            raise InvalidArgumentError(
+                f"Input shapes of matmul are incompatible: "
+                f"x {list(x.shape)} (transpose_x={bool(transpose_x)}) and "
+                f"y {list(y.shape)} (transpose_y={bool(transpose_y)}) — "
+                f"contracted dims {int(k1)} vs {int(k2)}.")
     x, y = maybe_autocast(x, y)
     return apply_op(_matmul, x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y))
 
