@@ -1,10 +1,14 @@
 """hapi.Model — high-level train/eval/predict.
 
 Parity: reference python/paddle/hapi/model.py:876 (Model.fit:1521,
-evaluate:1752, predict:1855). The reference keeps dual adapters
-(StaticGraphAdapter/DynamicGraphAdapter); here there is one adapter with two
-speeds: eager per-batch (debuggable) and a jit'd TrainStep (default) that
-compiles forward+backward+update into one XLA program.
+evaluate:1752, predict:1855) with BOTH backends like the reference's
+StaticGraphAdapter (:247) / DynamicGraphAdapter split:
+- dynamic (default): eager per-batch, or a jit'd TrainStep that compiles
+  forward+backward+update into one XLA program;
+- static (`paddle.enable_static()` before prepare, Model(net, inputs,
+  labels) with InputSpecs): prepare() builds main/eval Programs through
+  the symbolic recorder, minimize() registers the update, and
+  train/eval/predict_batch run through the static Executor.
 """
 from __future__ import annotations
 
@@ -50,6 +54,57 @@ class Model:
                 raise TypeError(f"metrics must be Metric instances, got {m}")
         self._use_jit = jit_compile
         self._train_step = None
+        self._static = None
+        from .. import in_dynamic_mode
+
+        if not in_dynamic_mode():
+            self._prepare_static()
+
+    # -- static-graph adapter (reference hapi/model.py:247) ------------------
+    def _prepare_static(self):
+        from .. import static
+        from ..framework.enforce import PreconditionNotMetError
+
+        if not self._inputs:
+            raise PreconditionNotMetError(
+                "hapi.Model in static mode needs input InputSpecs: "
+                "Model(net, inputs=[InputSpec(...)], labels=[...]).",
+                hint="the static program is built from the declared shapes")
+
+        def as_data(spec, i, prefix):
+            name = getattr(spec, "name", None) or f"{prefix}{i}"
+            return static.data(name, list(spec.shape),
+                               dtype=getattr(spec, "dtype", "float32"))
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            ins = [as_data(s, i, "x") for i, s in
+                   enumerate(_to_list(self._inputs))]
+            labels = [as_data(s, i, "label") for i, s in
+                      enumerate(_to_list(self._labels))]
+            outs = self.network(*ins)
+            loss_var = None
+            if self._loss is not None and labels:
+                loss_var = self._loss(outs, *labels)
+                if self._optimizer is not None:
+                    self._optimizer.minimize(loss_var)
+        self._static = {
+            "main": main,
+            "eval": main.clone(for_test=True),
+            "exe": static.Executor(),
+            "in_names": [t.name for t in ins],
+            "label_names": [t.name for t in labels],
+            "outs": outs,
+            "loss": loss_var,
+        }
+
+    def _static_feed(self, inputs, labels):
+        st = self._static
+        feed = {n: (x._data if isinstance(x, Tensor) else np.asarray(x))
+                for n, x in zip(st["in_names"], inputs)}
+        for n, x in zip(st["label_names"], labels):
+            feed[n] = x._data if isinstance(x, Tensor) else np.asarray(x)
+        return feed
 
     # -- core steps ----------------------------------------------------------
     def _build_train_step(self):
@@ -69,6 +124,13 @@ class Model:
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if self._static is not None:
+            st = self._static
+            prog = st["main"] if update else st["eval"]
+            (loss,) = st["exe"].run(
+                prog, feed=self._static_feed(inputs, labels),
+                fetch_list=[st["loss"]])
+            return [float(np.asarray(loss))]
         if self._use_jit and update and len(labels) == 1:
             if self._train_step is None:
                 self._train_step = self._build_train_step()
@@ -90,6 +152,26 @@ class Model:
         self.network.eval()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if self._static is not None:
+            st = self._static
+            outs = st["outs"] if isinstance(st["outs"], (list, tuple)) \
+                else [st["outs"]]
+            fetch = ([st["loss"]] if (st["loss"] is not None and labels)
+                     else []) + list(outs)
+            vals = st["exe"].run(st["eval"],
+                                 feed=self._static_feed(inputs, labels),
+                                 fetch_list=fetch)
+            metrics = []
+            k = 0
+            if st["loss"] is not None and labels:
+                metrics.append(float(np.asarray(vals[0])))
+                k = 1
+            out_t = [Tensor(v) for v in vals[k:]]
+            out_t = out_t[0] if len(out_t) == 1 else out_t
+            for metric in self._metrics:
+                corr = metric.compute(out_t, *labels)
+                metric.update(corr)
+            return metrics
         outputs = self.network(*inputs)
         metrics = []
         if self._loss is not None and labels:
@@ -103,6 +185,14 @@ class Model:
     def predict_batch(self, inputs):
         self.network.eval()
         inputs = _to_list(inputs)
+        if self._static is not None:
+            st = self._static
+            outs = st["outs"] if isinstance(st["outs"], (list, tuple)) \
+                else [st["outs"]]
+            vals = st["exe"].run(st["eval"],
+                                 feed=self._static_feed(inputs, []),
+                                 fetch_list=list(outs))
+            return [np.asarray(v) for v in vals]
         out = self.network(*inputs)
         if isinstance(out, (list, tuple)):
             return [o.numpy() for o in out]

@@ -1,0 +1,30 @@
+"""Build hook: compile the native runtime core during install.
+
+Metadata lives in pyproject.toml; this file only adds the build_ext step
+that produces paddle_tpu/core/lib/libptpu_core.so (the same artifact
+`make -C paddle_tpu/core` builds, and that core/native.py lazy-builds on
+first import when missing — installation is an optimization, not a
+requirement).
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNativeCore(build_py):
+    def run(self):
+        core = Path(__file__).parent / "paddle_tpu" / "core"
+        try:
+            subprocess.run(["make", "-C", str(core)], check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            sys.stderr.write(
+                f"[setup] native core build skipped ({e}); the ctypes "
+                "loader will lazy-build it (or fall back to pure Python) "
+                "at import time\n")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNativeCore})
