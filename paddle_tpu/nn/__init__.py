@@ -52,3 +52,4 @@ class utils:  # namespace parity: paddle.nn.utils
     @staticmethod
     def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
         return layer
+from .decode import BeamSearchDecoder, dynamic_decode, beam_search  # noqa: F401

@@ -1,0 +1,94 @@
+"""Beam search decode (nn/decode.py — reference fluid/layers/rnn.py
+BeamSearchDecoder + dynamic_decode over math/beam_search.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import BeamSearchDecoder, beam_search, dynamic_decode
+
+
+class TestFunctionalBeamSearch:
+    def _markov_step(self, trans):
+        """step_fn from a fixed transition log-prob table [V, V]."""
+        import jax.numpy as jnp
+
+        table = jnp.asarray(trans)
+
+        def step(tokens, states):
+            return table[tokens], states
+        return step
+
+    def test_beam_finds_delayed_reward_greedy_misses(self):
+        # from BOS(0): token 1 has logp -0.3, token 2 has -0.7. But after
+        # 1 everything is bad (-3.0 each), after 2 token 3 is free (-0.01).
+        # Greedy (beam 1) takes 1 then pays; beam 2 finds 2->3.
+        V = 5
+        t = np.full((V, V), -5.0, np.float32)
+        t[0, 1] = -0.3
+        t[0, 2] = -0.7
+        t[1, :] = -3.0
+        t[2, 3] = -0.01
+        t[3, 4] = -0.02   # then EOS(4)
+        t[1, 4] = -3.0
+        t[4, 4] = 0.0
+        step = self._markov_step(t)
+        init = {"dummy": np.zeros((1, 1), np.float32)}
+
+        seq_g, score_g, _ = beam_search(step, init, bos_id=0, eos_id=4,
+                                        beam_size=1, max_len=3,
+                                        batch_size=1)
+        seq_b, score_b, len_b = beam_search(step, init, bos_id=0, eos_id=4,
+                                            beam_size=2, max_len=3,
+                                            batch_size=1)
+        assert seq_g.numpy()[0, 0, 0] == 1          # greedy takes the trap
+        np.testing.assert_array_equal(seq_b.numpy()[0, 0], [2, 3, 4])
+        assert float(score_b.numpy()[0, 0]) > float(score_g.numpy()[0, 0])
+        np.testing.assert_allclose(float(score_b.numpy()[0, 0]),
+                                   -0.7 - 0.01 - 0.02, atol=1e-5)
+        assert int(len_b.numpy()[0, 0]) == 3        # incl. the EOS
+
+    def test_finished_beams_freeze_scores(self):
+        # EOS immediately reachable at -0.1; continuing costs more. The
+        # finished beam must keep emitting EOS at zero added cost.
+        V = 4
+        t = np.full((V, V), -2.0, np.float32)
+        t[0, 3] = -0.1    # BOS -> EOS
+        t[3, 3] = -2.0    # would be charged if finish weren't respected
+        step = self._markov_step(t)
+        init = {"d": np.zeros((2, 1), np.float32)}
+        seqs, scores, lengths = beam_search(step, init, bos_id=0, eos_id=3,
+                                            beam_size=2, max_len=4,
+                                            batch_size=2)
+        np.testing.assert_allclose(scores.numpy()[:, 0], [-0.1, -0.1],
+                                   atol=1e-6)
+        np.testing.assert_array_equal(seqs.numpy()[0, 0], [3, 3, 3, 3])
+        np.testing.assert_array_equal(lengths.numpy()[:, 0], [1, 1])
+
+
+class TestDecoderSurface:
+    def test_gru_cell_decoder_runs_and_is_sorted(self):
+        paddle.seed(7)
+        V, H, B = 12, 8, 3
+        cell = paddle.nn.GRUCell(H, H)
+        emb = paddle.nn.Embedding(V, H)
+        proj = paddle.nn.Linear(H, V)
+        dec = BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                beam_size=4, embedding_fn=emb,
+                                output_fn=proj)
+        import numpy as np
+
+        inits = paddle.to_tensor(
+            np.random.RandomState(0).rand(B, H).astype("float32"))
+        seqs, scores, lengths = dynamic_decode(dec, inits=inits,
+                                               max_step_num=6)
+        assert list(seqs.shape) == [B, 4, 6]
+        s = scores.numpy()
+        assert np.all(np.diff(s, axis=1) <= 1e-6)   # best-first
+        assert np.all(np.isfinite(s[:, 0]))
+        assert lengths.numpy().max() <= 6
+
+    def test_requires_static_trip_count(self):
+        cell = paddle.nn.GRUCell(4, 4)
+        dec = BeamSearchDecoder(cell, 0, 1, 2)
+        with pytest.raises(RuntimeError, match="max_step_num"):
+            dynamic_decode(dec, inits=paddle.zeros([2, 4]))
