@@ -27,7 +27,8 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "box_iou", "prior_box", "box_coder", "bipartite_match",
-           "multiclass_nms", "deform_conv2d", "RoIAlign", "RoIPool"]
+           "multiclass_nms", "matrix_nms", "deform_conv2d", "iou_similarity",
+           "box_clip", "anchor_generator", "RoIAlign", "RoIPool"]
 
 
 def _arr(x):
@@ -666,3 +667,155 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                               int(deformable_groups), int(groups))
 
     return apply_op(impl, *args)
+
+
+# -- detection batch 2 ------------------------------------------------------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU [N, M] (reference detection/iou_similarity_op.h;
+    box_normalized=False adds the +1 pixel convention)."""
+    a = _arr(x).astype(jnp.float32)
+    b = _arr(y).astype(jnp.float32)
+    off = 0.0 if box_normalized else 1.0
+    area1 = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area2 = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / (area1[:, None] + area2[None, :] - inter + 1e-10))
+
+
+def _box_clip(boxes, im_info, is_scale, pixel_offset):
+    off = 1.0 if pixel_offset else 0.0
+    h, w, scale = im_info[0], im_info[1], im_info[2]
+    im_w = jnp.round(w / scale) if is_scale else w
+    im_h = jnp.round(h / scale) if is_scale else h
+    x_hi, y_hi = im_w - off, im_h - off
+    x1 = jnp.clip(boxes[..., 0], 0, x_hi)
+    y1 = jnp.clip(boxes[..., 1], 0, y_hi)
+    x2 = jnp.clip(boxes[..., 2], 0, x_hi)
+    y2 = jnp.clip(boxes[..., 3], 0, y_hi)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def box_clip(input, im_info, name=None):  # noqa: A002
+    """Clip boxes to the image (reference detection/box_clip_op over
+    bbox_util.h ClipTiledBoxes): input [N, 4] (or [B, N, 4] with im_info
+    [B, 3]); im_info rows are (height, width, scale) — bounds are
+    round(size/scale) - 1."""
+    b = _arr(input)
+    info = _arr(im_info).astype(jnp.float32)
+    if b.ndim == 3:
+        return apply_op(
+            lambda bb, ii: jax.vmap(
+                lambda r, i: _box_clip(r, i, True, True))(bb, ii),
+            input, im_info)
+    return apply_op(lambda bb, ii: _box_clip(bb, ii, True, True),
+                    input, im_info if info.ndim == 1 else Tensor(info[0]))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios=(1.0,),
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """Faster R-CNN anchors (reference detection/anchor_generator_op.h:40 —
+    same rounding and emission order: aspect_ratios outer, anchor_sizes
+    inner, pixel-center convention). Returns (anchors [H,W,A,4],
+    variances [H,W,A,4]) in absolute pixels."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    rows = []
+    for h in range(fh):
+        row = []
+        for w in range(fw):
+            x_ctr = w * sw + offset * (sw - 1)
+            y_ctr = h * sh + offset * (sh - 1)
+            cell = []
+            for ar in aspect_ratios:
+                for size in anchor_sizes:
+                    area = sw * sh
+                    base_w = round(math.sqrt(area / ar))
+                    base_h = round(base_w * ar)
+                    aw = (size / sw) * base_w
+                    ah = (size / sh) * base_h
+                    cell.append((x_ctr - 0.5 * (aw - 1),
+                                 y_ctr - 0.5 * (ah - 1),
+                                 x_ctr + 0.5 * (aw - 1),
+                                 y_ctr + 0.5 * (ah - 1)))
+            row.append(cell)
+        rows.append(row)
+    anchors = np.asarray(rows, np.float32)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          anchors.shape).copy()
+    return Tensor(jnp.asarray(anchors)), Tensor(jnp.asarray(var))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference detection/matrix_nms_op.cc NMSMatrix — SOLOv2
+    decay: each candidate's score is multiplied by the min over
+    higher-scored overlaps of decay(iou, max_iou)). bboxes [N,M,4], scores
+    [N,C,M]; returns (out [K,6], rois_num [N][, index])."""
+    b = np.asarray(_arr(bboxes), np.float32)
+    s = np.asarray(_arr(scores), np.float32)
+    N, C, M = s.shape
+    off = 0.0 if normalized else 1.0
+
+    def iou(b1, b2):
+        a1 = (b1[2] - b1[0] + off) * (b1[3] - b1[1] + off)
+        a2 = (b2[2] - b2[0] + off) * (b2[3] - b2[1] + off)
+        iw = min(b1[2], b2[2]) - max(b1[0], b2[0]) + off
+        ih = min(b1[3], b2[3]) - max(b1[1], b2[1]) + off
+        if iw <= 0 or ih <= 0:
+            return 0.0
+        return iw * ih / (a1 + a2 - iw * ih)
+
+    all_rows, all_idx, per_img = [], [], []
+    for n in range(N):
+        kept = []  # (decayed_score, label, box_idx)
+        for c in range(C):
+            if c == background_label:
+                continue
+            cand = np.where(s[n, c] > score_threshold)[0]
+            cand = cand[np.argsort(-s[n, c][cand], kind="stable")]
+            if nms_top_k > -1:
+                cand = cand[:nms_top_k]
+            if not len(cand):
+                continue
+            iou_mat = np.zeros((len(cand), len(cand)), np.float32)
+            iou_max = np.zeros(len(cand), np.float32)
+            for i in range(1, len(cand)):
+                for j in range(i):
+                    iou_mat[i, j] = iou(b[n, cand[i]], b[n, cand[j]])
+                iou_max[i] = iou_mat[i, :i].max()
+            if s[n, c, cand[0]] > post_threshold:
+                kept.append((s[n, c, cand[0]], c, cand[0]))
+            for i in range(1, len(cand)):
+                decays = []
+                for j in range(i):
+                    if use_gaussian:
+                        d = math.exp((iou_max[j] ** 2 - iou_mat[i, j] ** 2)
+                                     * gaussian_sigma)
+                    else:
+                        d = (1.0 - iou_mat[i, j]) / (1.0 - iou_max[j])
+                    decays.append(d)
+                ds = min(decays) * s[n, c, cand[i]]
+                if ds > post_threshold:
+                    kept.append((ds, c, cand[i]))
+        kept.sort(key=lambda t: -t[0])
+        if keep_top_k > -1:
+            kept = kept[:keep_top_k]
+        for sc, c, i in kept:
+            all_rows.append([float(c), float(sc)] + list(b[n, i]))
+            all_idx.append(n * M + i)
+        per_img.append(len(kept))
+    out = (np.asarray(all_rows, np.float32) if all_rows
+           else np.zeros((0, 6), np.float32))
+    res = [Tensor(jnp.asarray(out))]
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(per_img, np.int32))))
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(all_idx, np.int64))))
+    return tuple(res) if len(res) > 1 else res[0]

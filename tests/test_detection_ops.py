@@ -410,3 +410,88 @@ class TestEditDistance:
             return_index=True)
         assert list(num.numpy()) == [1, 1]
         np.testing.assert_array_equal(idx.numpy(), [0, 2])
+
+
+class TestIoUSimilarity:
+    def test_normalized_and_pixel_convention(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        got = ops.iou_similarity(_t(a), _t(b)).numpy()
+        np.testing.assert_allclose(got[0], [1.0, 25 / 175], atol=1e-6)
+        # unnormalized: +1 pixel convention changes the areas
+        got2 = ops.iou_similarity(_t(a), _t(b), box_normalized=False).numpy()
+        inter = 6 * 6
+        union = 11 * 11 * 2 - inter
+        np.testing.assert_allclose(got2[0, 1], inter / union, atol=1e-6)
+
+
+class TestBoxClip:
+    def test_clips_to_scaled_image(self):
+        boxes = np.array([[-5, -5, 50, 50], [2, 3, 4, 5]], np.float32)
+        im_info = np.array([20.0, 30.0, 1.0], np.float32)  # h, w, scale
+        out = ops.box_clip(_t(boxes), _t(im_info)).numpy()
+        np.testing.assert_allclose(out[0], [0, 0, 29, 19], atol=1e-5)
+        np.testing.assert_allclose(out[1], [2, 3, 4, 5], atol=1e-5)
+        # scale 2: bounds round(size/scale) - 1
+        out2 = ops.box_clip(_t(boxes),
+                            _t(np.array([20.0, 30.0, 2.0], np.float32))).numpy()
+        np.testing.assert_allclose(out2[0], [0, 0, 14, 9], atol=1e-5)
+
+    def test_batched(self):
+        boxes = np.tile(np.array([[[-1, -1, 100, 100]]], np.float32),
+                        (2, 1, 1))
+        infos = np.array([[10, 10, 1], [50, 40, 1]], np.float32)
+        out = ops.box_clip(_t(boxes), _t(infos)).numpy()
+        np.testing.assert_allclose(out[0, 0], [0, 0, 9, 9], atol=1e-5)
+        np.testing.assert_allclose(out[1, 0], [0, 0, 39, 49], atol=1e-5)
+
+
+class TestAnchorGenerator:
+    def test_reference_rounding_and_order(self):
+        feat = _t(np.zeros((1, 8, 2, 2), np.float32))
+        anchors, var = ops.anchor_generator(
+            feat, anchor_sizes=[32.0], aspect_ratios=[1.0, 2.0],
+            stride=[16.0, 16.0], offset=0.5)
+        assert anchors.shape == [2, 2, 2, 4]
+        a = anchors.numpy()
+        # ar=1: base_w = round(sqrt(256)) = 16 -> anchor 32x32 at center
+        # (0*16 + 0.5*15) = 7.5
+        np.testing.assert_allclose(
+            a[0, 0, 0], [7.5 - 15.5, 7.5 - 15.5, 7.5 + 15.5, 7.5 + 15.5],
+            atol=1e-5)
+        # ar=2: base_w = round(sqrt(128)) = 11, base_h = 22 -> 22x44
+        np.testing.assert_allclose(
+            a[0, 0, 1], [7.5 - 10.5, 7.5 - 21.5, 7.5 + 10.5, 7.5 + 21.5],
+            atol=1e-5)
+        np.testing.assert_allclose(var.numpy()[1, 1, 0],
+                                   [0.1, 0.1, 0.2, 0.2], atol=1e-7)
+
+
+class TestMatrixNMS:
+    def test_decay_matches_hand_computation(self):
+        # three boxes, one class; scores 0.9, 0.8, 0.7
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 5],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        out, num = ops.matrix_nms(_t(boxes), _t(scores),
+                                  score_threshold=0.1, post_threshold=0.0,
+                                  background_label=0)
+        o = out.numpy()
+        assert int(num.numpy()[0]) == 3
+        # box1 iou with box0 = 50/100 = 0.5; linear decay (1-0.5)/(1-0) -> 0.4
+        # box2 overlaps nothing -> decay 1 -> 0.7
+        np.testing.assert_allclose(sorted([r[1] for r in o], reverse=True),
+                                   [0.9, 0.7, 0.4], atol=1e-5)
+
+    def test_gaussian_and_post_threshold(self):
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 5]]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.8]
+        out, num = ops.matrix_nms(_t(boxes), _t(scores),
+                                  score_threshold=0.1, post_threshold=0.5,
+                                  use_gaussian=True, gaussian_sigma=2.0,
+                                  background_label=0)
+        # gaussian decay: exp((0 - 0.25)*2) = 0.6065 -> 0.485 < 0.5 dropped
+        assert int(num.numpy()[0]) == 1
+        np.testing.assert_allclose(out.numpy()[0, 1], 0.9, atol=1e-6)
