@@ -460,10 +460,19 @@ class FleetEngine:
         acc = max(self.accumulate_steps, n_stages)
 
         def step_loss(params, buffers, batch):
+            from ...parallel.sharding import constraint
+
             x, y = batch
             h = apply_edge(prologue, params, x)
             xm = h.reshape(acc, h.shape[0] // acc, *h.shape[1:])
             ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
+            # pin the microbatched labels to the batch layout: the
+            # batch->microbatch reshape leaves the data/sharding tiling on
+            # the time axis, and every per-microbatch slice would hit the
+            # partitioner's replicate-and-repartition fallback (same fix
+            # as pipeline.py's carry pinning)
+            ym = constraint(ym, P(None, ("data", "sharding"),
+                                  *(None,) * (ym.ndim - 2)))
             mid_params = {k: v for k, v in params.items()
                           if k.startswith("stage.")}
             ys = pipeline_forward(stage_fn, mid_params, xm, n_stages)
