@@ -3,4 +3,5 @@ from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
     RMSProp, Lamb, LarsMomentum, Ftrl, FtrlOptimizer, Dpsgd, DpsgdOptimizer,
+    DecayedAdagrad, DecayedAdagradOptimizer, ExponentialMovingAverage,
 )

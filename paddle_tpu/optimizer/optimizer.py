@@ -535,3 +535,103 @@ class Dpsgd(Optimizer):
 
 
 DpsgdOptimizer = Dpsgd
+
+
+class DecayedAdagrad(Optimizer):
+    """Decayed Adagrad (reference fluid/optimizer.py DecayedAdagradOptimizer
+    over operators/optimizers/decayed_adagrad_op.h): moment decays instead
+    of accumulating forever."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, regularization, grad_clip,
+                         name)
+        self._decay = float(decay)
+        self._epsilon = float(epsilon)
+
+    def _slot_names(self):
+        return ["moment"]
+
+    def _hyper(self, p):
+        return {"decay": self._decay, "eps": self._epsilon}
+
+    @staticmethod
+    def _pure_update(p, g, lr, moment, decay, eps):
+        lr = lr.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        m = decay * moment + (1.0 - decay) * g32 * g32
+        new_p = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(m) + eps)
+        return new_p.astype(p.dtype), m
+
+
+DecayedAdagradOptimizer = DecayedAdagrad
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with bias correction (reference fluid/optimizer.py
+    ExponentialMovingAverage: EMA_t = decay*EMA_{t-1} + (1-decay)*theta_t,
+    applied as EMA_t / (1 - decay^t); apply()/restore() swap the shadow
+    values in and out, and apply_guard() is the context form)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._ema: dict = {}
+        self._backup: dict = {}
+        self._params: list = []
+        self._step = 0
+
+    def update(self, parameters=None):
+        from ..framework.core import Parameter
+
+        if parameters is not None:
+            self._params = list(parameters)
+        elif not self._params:
+            raise ValueError("ExponentialMovingAverage.update needs "
+                             "parameters on the first call")
+        self._step += 1
+        d = self._decay
+        if self._thres_steps is not None:
+            # reference: decay = min(decay, (1+steps)/(10+steps))
+            t = float(self._thres_steps() if callable(self._thres_steps)
+                      else self._step)
+            d = min(d, (1.0 + t) / (10.0 + t))
+        for p in self._params:
+            if not isinstance(p, Parameter) and not hasattr(p, "_data"):
+                continue
+            prev = self._ema.get(id(p))
+            cur = p._data.astype(jnp.float32)
+            self._ema[id(p)] = (d * prev + (1.0 - d) * cur
+                                if prev is not None else (1.0 - d) * cur)
+
+    def apply(self, need_restore=True):
+        corr = 1.0 - self._decay ** max(self._step, 1)
+        self._backup = {}
+        for p in self._params:
+            ema = self._ema.get(id(p))
+            if ema is None:
+                continue
+            if need_restore:
+                self._backup[id(p)] = p._data
+            p._data = (ema / corr).astype(p._data.dtype)
+
+    def restore(self):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+    def apply_guard(self, need_restore=True):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def guard():
+            self.apply(need_restore)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()

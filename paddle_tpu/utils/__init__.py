@@ -1,9 +1,11 @@
 """paddle_tpu.utils (reference python/paddle/utils)."""
 from __future__ import annotations
 
-import os
+from . import download  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
 
-__all__ = ["deprecated", "try_import", "download", "unique_name", "install_check"]
+__all__ = ["deprecated", "try_import", "download",
+           "get_weights_path_from_url", "unique_name", "install_check"]
 
 
 def deprecated(update_to="", since="", reason=""):
@@ -20,14 +22,6 @@ def try_import(module_name, err_msg=None):
         return importlib.import_module(module_name)
     except ImportError:
         raise ImportError(err_msg or f"{module_name} is required")
-
-
-class download:
-    @staticmethod
-    def get_weights_path_from_url(url, md5sum=None):
-        raise RuntimeError(
-            "zero-egress environment: pretrained weight download unavailable; "
-            "pass pretrained=False or provide a local path")
 
 
 class unique_name:

@@ -1,0 +1,57 @@
+"""Weights cache lookup (reference python/paddle/utils/download.py).
+
+The reference downloads pretrained weights over HTTP into
+~/.cache/paddle/hapi/weights. This runtime is ZERO-EGRESS by policy: the
+same cache-path contract is honored (plus PADDLE_TPU_WEIGHTS_DIR), files
+already present are returned with md5 verification, and a missing file
+raises UnavailableError telling the user where to place it — instead of
+silently attempting network IO that the environment forbids.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
+                      check_exist: bool = True):
+    fname = os.path.basename(url)
+    search = [os.path.join(root_dir, fname)]
+    env_dir = os.environ.get("PADDLE_TPU_WEIGHTS_DIR")
+    if env_dir:
+        search.insert(0, os.path.join(env_dir, fname))
+    for path in search:
+        if os.path.isfile(path):
+            if md5sum and _md5(path) != md5sum:
+                from ..framework.enforce import PreconditionNotMetError
+
+                raise PreconditionNotMetError(
+                    f"Cached weights {path} fail md5 verification "
+                    f"(want {md5sum}).",
+                    hint="delete the file and re-place a good copy")
+            return path
+    from ..framework.enforce import UnavailableError
+
+    raise UnavailableError(
+        f"Pretrained weights {fname!r} are not in the local cache and this "
+        f"runtime performs no network IO.",
+        hint=f"place the file at {search[-1]} (or set "
+             f"PADDLE_TPU_WEIGHTS_DIR); source URL: {url}")
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None):
+    """reference download.py get_weights_path_from_url: resolve a weights
+    URL to a local cache path."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

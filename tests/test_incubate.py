@@ -214,3 +214,48 @@ class TestGradientMerge:
 
         np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestDecayedAdagradEMA:
+    def test_decayed_adagrad_matches_numpy(self):
+        from paddle_tpu.framework.core import Parameter
+
+        w = np.array([1.0, 2.0, 3.0], np.float32)
+        g = np.array([0.5, -0.5, 1.0], np.float32)
+        p = Parameter(w.copy())
+        opt = paddle.optimizer.DecayedAdagrad(learning_rate=0.1, decay=0.9,
+                                              epsilon=1e-6, parameters=[p])
+        (p * paddle.to_tensor(g)).sum().backward()
+        opt.step()
+        m = 0.1 * g * g
+        want = w - 0.1 * g / (np.sqrt(m) + 1e-6)
+        np.testing.assert_allclose(p.numpy(), want, rtol=1e-5, atol=1e-6)
+
+    def test_ema_bias_corrected_apply_restore(self):
+        from paddle_tpu.framework.core import Parameter
+        from paddle_tpu.optimizer import ExponentialMovingAverage
+
+        p = Parameter(np.array([2.0], np.float32))
+        ema = ExponentialMovingAverage(decay=0.5)
+        ema.update([p])                     # EMA_1 = 0.5*2 = 1; corr 0.5
+        p._data = p._data * 0 + 4.0
+        ema.update()                        # EMA_2 = 0.5*1 + 0.5*4 = 2.5
+        with ema.apply_guard():
+            # corrected: 2.5 / (1 - 0.5^2) = 10/3
+            np.testing.assert_allclose(p.numpy(), [2.5 / 0.75], rtol=1e-6)
+        np.testing.assert_allclose(p.numpy(), [4.0], rtol=1e-6)
+
+
+class TestUtilsDownload:
+    def test_cache_hit_and_zero_egress_error(self, tmp_path, monkeypatch):
+        from paddle_tpu.framework.enforce import UnavailableError
+        from paddle_tpu.utils import get_weights_path_from_url
+        from paddle_tpu.utils import download as D
+
+        wf = tmp_path / "model.pdparams"
+        wf.write_bytes(b"weights")
+        monkeypatch.setenv("PADDLE_TPU_WEIGHTS_DIR", str(tmp_path))
+        got = get_weights_path_from_url("https://x/model.pdparams")
+        assert got == str(wf)
+        with pytest.raises(UnavailableError, match="no network IO"):
+            get_weights_path_from_url("https://x/missing.pdparams")
