@@ -47,6 +47,22 @@ def _compiled_temp_bytes(n_micro, remat):
     return stats.temp_size_in_bytes
 
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh():
+    """These tests pin the eager/no-mesh semantics of pipeline_forward; a
+    mesh leaked by another module's tests would silently shard the compute
+    and shift float reduction order past the tolerance."""
+    from paddle_tpu.parallel.mesh import get_mesh, set_mesh
+
+    prev = get_mesh()
+    set_mesh(None)
+    yield
+    set_mesh(prev)
+
+
 class TestPipelineMemory:
     def test_remat_bounds_per_microbatch_memory_growth(self):
         """Temp memory slope per extra microbatch: without remat every tick
