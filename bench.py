@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import time
 
 import numpy as np
@@ -123,12 +124,16 @@ def bench_bert(on_accel):
 
     batch = 16
     ab = {}
-    for name, use_flash, seq, b, k in (
-            ("xla_512", False, 512, batch, 10),
-            ("flash_512", True, 512, batch, 10),
-            ("xla_2048", False, 2048, 4, 6),
-            ("flash_2048", True, 2048, 4, 6)):
-        cfg = bert_base_config(remat=True, use_flash=use_flash, seq_len=seq)
+    # seq-512 configs compile with the FULL layer unroll (+3-8% measured);
+    # the 2048 A/B keeps the rolled scan — its unrolled compile alone costs
+    # minutes and the flash-vs-XLA comparison is unaffected by unroll
+    for name, use_flash, seq, b, k, unroll in (
+            ("xla_512", False, 512, batch, 10, None),
+            ("flash_512", True, 512, batch, 10, None),
+            ("xla_2048", False, 2048, 4, 6, 1),
+            ("flash_2048", True, 2048, 4, 6, 1)):
+        cfg = bert_base_config(remat=True, use_flash=use_flash, seq_len=seq,
+                               scan_unroll=unroll)
         dt, n = _device_step_seconds(cfg, b, K=k)
         ab[name] = {"sps": round(b / dt, 2),
                     "mfu": round(_mfu(n, seq, b / dt), 4)}
@@ -149,7 +154,7 @@ def bench_ernie_large(on_accel):
     cfg = GPTConfig(vocab_size=30592, hidden=1024, n_layers=24, n_heads=16,
                     seq_len=512, remat=True, use_flash=False)
     batch = 8
-    dt, n = _device_step_seconds(cfg, batch, K=8)
+    dt, n = _device_step_seconds(cfg, batch, K=8)  # full unroll: +19% on v5e
     sps = batch / dt
     return {"sps": round(sps, 2), "mfu": round(_mfu(n, 512, sps), 4),
             "note": "bf16 compute + fp32 master, single chip; sharding+AMP "
@@ -165,7 +170,10 @@ def bench_gpt_1p3b(on_accel):
 
     if not on_accel:
         return None
-    cfg = gpt_1p3b(remat=True, use_flash=True, param_dtype=jnp.bfloat16)
+    # rolled scan (scan_unroll=1): the 24-layer seq-2048 unrolled compile
+    # costs minutes and would blow the bench budget for ~8%
+    cfg = gpt_1p3b(remat=True, use_flash=True, param_dtype=jnp.bfloat16,
+                   scan_unroll=1)
     batch = 2
     dt, n = _device_step_seconds(cfg, batch, K=4, loss_chunk=256,
                                  optimizer="sgd")
@@ -192,7 +200,7 @@ def bench_gpt_760m_adamw(on_accel):
         return None
     cfg = GPTConfig(vocab_size=50304, hidden=1536, n_layers=24, n_heads=16,
                     seq_len=2048, remat=True, use_flash=True,
-                    param_dtype=jnp.bfloat16)
+                    param_dtype=jnp.bfloat16, scan_unroll=1)
     batch = 4
     dt, n = _device_step_seconds(cfg, batch, K=4, loss_chunk=256,
                                  optimizer="adamw")
@@ -278,6 +286,18 @@ def bench_resnet50(on_accel):
 
 def main():
     import jax
+
+    # persistent XLA compile cache: the full-unroll configs take ~7min of
+    # compile cold; with the on-disk cache (kept in-repo and pre-warmed)
+    # a bench run is dominated by device time (~3min)
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(os.path.abspath(
+                              __file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # older jax without the knobs: cold compiles still complete
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
