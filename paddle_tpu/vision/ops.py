@@ -28,7 +28,9 @@ from ..nn.layer.layers import Layer
 __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "box_iou", "prior_box", "box_coder", "bipartite_match",
            "multiclass_nms", "matrix_nms", "deform_conv2d", "iou_similarity",
-           "box_clip", "anchor_generator", "RoIAlign", "RoIPool"]
+           "box_clip", "anchor_generator", "generate_proposals",
+           "distribute_fpn_proposals", "collect_fpn_proposals",
+           "RoIAlign", "RoIPool"]
 
 
 def _arr(x):
@@ -819,3 +821,161 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     if return_index:
         res.append(Tensor(jnp.asarray(np.asarray(all_idx, np.int64))))
     return tuple(res) if len(res) > 1 else res[0]
+
+
+# -- RPN / FPN proposal pipeline --------------------------------------------
+
+_BBOX_CLIP = math.log(1000.0 / 16.0)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances=None,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=True, name=None):
+    """RPN proposal generation (reference
+    detection/generate_proposals_op.cc ProposalForOneImage + bbox_util.h
+    BoxCoder/FilterBoxes): per image, take pre_nms_top_n scores, decode
+    deltas against anchors (variance-scaled, w/h delta clipped at
+    log(1000/16)), clip to the image (pixel convention), drop boxes
+    smaller than min_size (scale-corrected) or with centers outside, NMS,
+    keep post_nms_top_n. Host semantics (dynamic output), like the
+    reference CPU kernel.
+
+    scores [N,A,H,W]; bbox_deltas [N,4A,H,W]; img_size/im_info [N,3]
+    (h, w, scale); anchors [H,W,A,4] or [M,4]; variances same shape as
+    anchors or None. Returns (rois [K,4], roi_probs [K,1], rois_num [N]).
+    """
+    s = np.asarray(_arr(scores), np.float32)
+    d = np.asarray(_arr(bbox_deltas), np.float32)
+    info = np.asarray(_arr(img_size), np.float32)
+    anc = np.asarray(_arr(anchors), np.float32).reshape(-1, 4)
+    var = (np.asarray(_arr(variances), np.float32).reshape(-1, 4)
+           if variances is not None else None)
+    N, A, H, W = s.shape
+
+    def decode(anchor, vr, delta):
+        off = 1.0
+        aw = anchor[:, 2] - anchor[:, 0] + off
+        ah = anchor[:, 3] - anchor[:, 1] + off
+        acx = anchor[:, 0] + 0.5 * aw
+        acy = anchor[:, 1] + 0.5 * ah
+        dx, dy, dw, dh = delta[:, 0], delta[:, 1], delta[:, 2], delta[:, 3]
+        if vr is not None:
+            dx, dy = vr[:, 0] * dx, vr[:, 1] * dy
+            dw, dh = vr[:, 2] * dw, vr[:, 3] * dh
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        w = np.exp(np.minimum(dw, _BBOX_CLIP)) * aw
+        h = np.exp(np.minimum(dh, _BBOX_CLIP)) * ah
+        return np.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - off, cy + h / 2 - off], axis=1)
+
+    all_rois, all_probs, per_img = [], [], []
+    for n in range(N):
+        # [A,H,W] -> [H,W,A] flat, matching the anchors' [H,W,A,4] order
+        sc = s[n].transpose(1, 2, 0).reshape(-1)
+        dl = d[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc, kind="stable")
+        if 0 < pre_nms_top_n < len(order):
+            order = order[:pre_nms_top_n]
+        props = decode(anc[order], var[order] if var is not None else None,
+                       dl[order])
+        im_h, im_w, im_scale = info[n]
+        props[:, 0] = np.clip(props[:, 0], 0, im_w - 1)
+        props[:, 1] = np.clip(props[:, 1], 0, im_h - 1)
+        props[:, 2] = np.clip(props[:, 2], 0, im_w - 1)
+        props[:, 3] = np.clip(props[:, 3], 0, im_h - 1)
+        # FilterBoxes (bbox_util.h:190): min_size in ORIGINAL image scale,
+        # centers inside the image
+        ms = max(float(min_size), 1.0)
+        ws = props[:, 2] - props[:, 0] + 1
+        hs = props[:, 3] - props[:, 1] + 1
+        ws_orig = (props[:, 2] - props[:, 0]) / im_scale + 1
+        hs_orig = (props[:, 3] - props[:, 1]) / im_scale + 1
+        cx = props[:, 0] + ws / 2
+        cy = props[:, 1] + hs / 2
+        keep = np.where((ws_orig >= ms) & (hs_orig >= ms)
+                        & (cx <= im_w) & (cy <= im_h))[0]
+        props, psc = props[keep], sc[order][keep]
+        # NMS with eta-adaptive threshold (nms_util.h NMSFast)
+        alive = list(range(len(props)))
+        sel = []
+        thr = nms_thresh
+        while alive:
+            i = alive.pop(0)
+            sel.append(i)
+            if 0 < post_nms_top_n <= len(sel):
+                break
+            ref = props[i]
+            rest = []
+            for j in alive:
+                b = props[j]
+                iw = min(ref[2], b[2]) - max(ref[0], b[0]) + 1
+                ih = min(ref[3], b[3]) - max(ref[1], b[1]) + 1
+                inter = max(iw, 0) * max(ih, 0)
+                a1 = (ref[2] - ref[0] + 1) * (ref[3] - ref[1] + 1)
+                a2 = (b[2] - b[0] + 1) * (b[3] - b[1] + 1)
+                if inter / (a1 + a2 - inter) <= thr:
+                    rest.append(j)
+            alive = rest
+            if eta < 1.0 and thr > 0.5:
+                thr *= eta
+        all_rois.append(props[sel])
+        all_probs.append(psc[sel])
+        per_img.append(len(sel))
+    rois = (np.concatenate(all_rois) if all_rois
+            else np.zeros((0, 4), np.float32))
+    probs = (np.concatenate(all_probs)[:, None] if all_probs
+             else np.zeros((0, 1), np.float32))
+    out = (Tensor(jnp.asarray(rois)), Tensor(jnp.asarray(probs)))
+    if return_rois_num:
+        out += (Tensor(jnp.asarray(np.asarray(per_img, np.int32))),)
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels (reference
+    detection/distribute_fpn_proposals_op.h:113: tgt_lvl =
+    floor(log2(sqrt(area)/refer_scale + 1e-6) + refer_level), clipped).
+    Returns (multi_rois list low→high level, restore_index [R,1]
+    mapping concat(multi_rois) rows back to input order[, rois_num list])."""
+    r = np.asarray(_arr(fpn_rois), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = r[:, 2] - r[:, 0] + off
+    h = r[:, 3] - r[:, 1] + off
+    area = np.where((w > 0) & (h > 0), w * h, 0.0)
+    lvl = np.floor(np.log2(np.sqrt(area) / refer_scale + 1e-6) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, order = [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        multi.append(Tensor(jnp.asarray(r[idx])))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    out = ([t for t in multi],
+           Tensor(jnp.asarray(restore[:, None].astype(np.int32))))
+    if rois_num is not None:
+        # per-level per-image counts, summed over images like the reference
+        counts = [Tensor(jnp.asarray(np.asarray(
+            [int((lvl == L).sum())], np.int32)))
+            for L in range(min_level, max_level + 1)]
+        return out + (counts,)
+    return out
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """Merge per-level RoIs and keep the post_nms_top_n best by score
+    (reference detection/collect_fpn_proposals_op.h)."""
+    rois = np.concatenate([np.asarray(_arr(r), np.float32)
+                           for r in multi_rois]) \
+        if multi_rois else np.zeros((0, 4), np.float32)
+    scores = np.concatenate([np.asarray(_arr(s), np.float32).reshape(-1)
+                             for s in multi_scores]) \
+        if multi_scores else np.zeros((0,), np.float32)
+    order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
+    return Tensor(jnp.asarray(rois[order]))

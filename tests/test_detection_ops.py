@@ -495,3 +495,80 @@ class TestMatrixNMS:
         # gaussian decay: exp((0 - 0.25)*2) = 0.6065 -> 0.485 < 0.5 dropped
         assert int(num.numpy()[0]) == 1
         np.testing.assert_allclose(out.numpy()[0, 1], 0.9, atol=1e-6)
+
+
+class TestGenerateProposals:
+    def test_decode_clip_filter_nms(self):
+        # 1 image, 2x(1x1) feature -> anchors at two positions
+        H = W = 1
+        A = 2
+        anchors = np.array([[[[0, 0, 9, 9], [2, 2, 5, 5]]]], np.float32)
+        var = np.full_like(anchors, 1.0)
+        scores = np.array([[[[0.9]], [[0.8]]]], np.float32)     # [1,A,1,1]
+        deltas = np.zeros((1, 4 * A, 1, 1), np.float32)          # identity
+        info = np.array([[20.0, 20.0, 1.0]], np.float32)
+        rois, probs, num = ops.generate_proposals(
+            _t(scores), _t(deltas), _t(info), _t(anchors), _t(var),
+            pre_nms_top_n=10, post_nms_top_n=10, nms_thresh=0.99,
+            min_size=2.0)
+        assert int(num.numpy()[0]) == 2
+        # zero deltas decode back to the anchors themselves
+        np.testing.assert_allclose(rois.numpy()[0], [0, 0, 9, 9], atol=1e-4)
+        np.testing.assert_allclose(rois.numpy()[1], [2, 2, 5, 5], atol=1e-4)
+        np.testing.assert_allclose(probs.numpy().ravel(), [0.9, 0.8],
+                                   atol=1e-6)
+
+    def test_min_size_filter_and_nms_suppress(self):
+        A = 2
+        anchors = np.array([[[[0, 0, 9, 9], [1, 1, 2, 2]]]], np.float32)
+        scores = np.array([[[[0.9]], [[0.95]]]], np.float32)
+        deltas = np.zeros((1, 4 * A, 1, 1), np.float32)
+        info = np.array([[20.0, 20.0, 1.0]], np.float32)
+        rois, probs, num = ops.generate_proposals(
+            _t(scores), _t(deltas), _t(info), _t(anchors), None,
+            min_size=5.0)   # the 2x2 anchor is filtered
+        assert int(num.numpy()[0]) == 1
+        np.testing.assert_allclose(rois.numpy()[0], [0, 0, 9, 9], atol=1e-4)
+
+    def test_delta_decode_matches_formula(self):
+        anchors = np.array([[[[0, 0, 9, 9]]]], np.float32)   # w=h=10,c=(4.5)
+        scores = np.array([[[[0.9]]]], np.float32)
+        deltas = np.zeros((1, 4, 1, 1), np.float32)
+        deltas[0, 0, 0, 0] = 0.1    # dx
+        deltas[0, 2, 0, 0] = np.log(2.0)  # dw -> w doubles
+        info = np.array([[100.0, 100.0, 1.0]], np.float32)
+        rois, _, _ = ops.generate_proposals(
+            _t(scores), _t(deltas), _t(info), _t(anchors), None,
+            min_size=1.0)
+        # pixel convention (bbox_util.h BoxCoder): aw = 10, center = x1 +
+        # aw/2 = 5; cx = 5 + 0.1*10 = 6, w = 20 -> x1 clips at 0,
+        # x2 = 6 + 10 - 1 = 15; y stays h=10 -> y2 = 5 + 5 - 1 = 9
+        np.testing.assert_allclose(rois.numpy()[0], [0, 0, 15, 9], atol=1e-4)
+
+
+class TestFPNRouting:
+    def test_distribute_levels_and_restore(self):
+        rois = np.array([
+            [0, 0, 223, 223],    # sqrt(area)=224 -> level 4
+            [0, 0, 111, 111],    # 112 -> level 3
+            [0, 0, 447, 447],    # 448 -> level 5
+            [0, 0, 15, 15],      # 16 -> clipped to level 2
+        ], np.float32)
+        multi, restore = ops.distribute_fpn_proposals(
+            _t(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        sizes = [len(m.numpy()) for m in multi]
+        assert sizes == [1, 1, 1, 1]
+        np.testing.assert_allclose(multi[2].numpy()[0], rois[0])  # lvl 4
+        # restore index maps concat(multi) back to the original order
+        cat = np.concatenate([m.numpy() for m in multi])
+        np.testing.assert_allclose(cat[restore.numpy().ravel()], rois)
+
+    def test_collect_top_n(self):
+        r1 = np.array([[0, 0, 1, 1], [0, 0, 2, 2]], np.float32)
+        r2 = np.array([[0, 0, 3, 3]], np.float32)
+        s1 = np.array([0.5, 0.9], np.float32)
+        s2 = np.array([0.7], np.float32)
+        out = ops.collect_fpn_proposals([_t(r1), _t(r2)], [_t(s1), _t(s2)],
+                                        2, 3, post_nms_top_n=2).numpy()
+        np.testing.assert_allclose(out, [[0, 0, 2, 2], [0, 0, 3, 3]])
