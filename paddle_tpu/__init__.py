@@ -30,6 +30,8 @@ from .framework.core import (
     is_grad_enabled,
 )
 from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.dtype import dtype  # noqa: F401  (paddle.dtype)
+from .batch import batch  # noqa: F401
 from .framework.core import grad  # noqa: F401  (paddle.grad)
 
 from .tensor import *  # noqa: F401,F403 — op namespace at top level (paddle.add, ...)
@@ -75,6 +77,11 @@ from . import distributed  # noqa: F401
 from . import device  # noqa: F401
 from . import utils  # noqa: F401
 from . import ops  # noqa: F401
+from . import fft  # noqa: F401
+# NOT `from . import linalg`: the tensor star-import above already bound
+# `linalg` to tensor.linalg, which would stop the submodule import; the
+# absolute import always loads paddle_tpu/linalg.py and rebinds the attr.
+import paddle_tpu.linalg  # noqa: F401,E402
 from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
@@ -119,3 +126,41 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
     return 0
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr print options (reference tensor/to_string.py
+    set_printoptions); Tensor repr renders through numpy, so this delegates
+    to np.set_printoptions."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op for parity: the reference installs C++ signal handlers for
+    crash stacks (paddle/fluid/platform/init.cc); this runtime leaves
+    Python's handlers in place, so there is nothing to disable."""
+
+
+def get_cuda_rng_state():
+    """Device RNG state (name kept for parity; state is the jax PRNG key)."""
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    if isinstance(state_list, (list, tuple)):
+        state_list = state_list[0]
+    set_rng_state(state_list)

@@ -1,0 +1,231 @@
+"""Discrete Fourier transforms (parity surface: reference
+python/paddle/fft.py + python/paddle/tensor/fft.py).
+
+All transforms lower to XLA's FFT HLO via jnp.fft; gradients come from
+jax.vjp through apply_op like every other op. The Hermitian family
+(hfft*/ihfft*) is expressed through the standard identities
+``hfft(x) = irfft(conj(x), norm=swap(norm))`` and
+``ihfft(x) = conj(rfft(x, norm=swap(norm)))`` — the same construction the
+reference's fft_c2r/fft_r2c kernels implement
+(/root/reference/python/paddle/tensor/fft.py:1404,1367).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor, apply_op
+from .framework import dtype as dtypes
+
+__all__ = [
+    "fft", "fft2", "fftn", "ifft", "ifft2", "ifftn",
+    "rfft", "rfft2", "rfftn", "irfft", "irfft2", "irfftn",
+    "hfft", "hfft2", "hfftn", "ihfft", "ihfft2", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            "norm should be 'backward', 'ortho' or 'forward', got %r" % (norm,))
+    return norm
+
+
+def _swap_norm(norm):
+    """forward<->backward (ortho is self-inverse) — numpy's _swap_direction."""
+    return {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+
+
+# impl wrappers are defined ONCE at module level: apply_op's jit cache is
+# keyed on (fn, attrs), so a per-call closure would recompile every call.
+def _fft_impl(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+
+
+def _ifft_impl(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+
+
+def _rfft_impl(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+
+
+def _irfft_impl(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_fft_impl, x, n=n, axis=int(axis), norm=norm,
+                    op_name="fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_ifft_impl, x, n=n, axis=int(axis), norm=norm,
+                    op_name="ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_rfft_impl, x, n=n, axis=int(axis), norm=norm,
+                    op_name="rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_irfft_impl, x, n=n, axis=int(axis), norm=norm,
+                    op_name="irfft")
+
+
+def _hfft_impl(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(jnp.conj(x), n=n, axis=axis, norm=_swap_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_hfft_impl, x, n=n, axis=int(axis), norm=norm,
+                    op_name="hfft")
+
+
+def _ihfft_impl(x, n=None, axis=-1, norm="backward"):
+    return jnp.conj(jnp.fft.rfft(x, n=n, axis=axis, norm=_swap_norm(norm)))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_ihfft_impl, x, n=n, axis=int(axis), norm=norm,
+                    op_name="ihfft")
+
+
+def _tupled(v):
+    if v is None:
+        return None
+    return tuple(int(i) for i in v)
+
+
+def _fftn_impl(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
+
+
+def _ifftn_impl(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def _rfftn_impl(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def _irfftn_impl(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_fftn_impl, x, s=_tupled(s), axes=_tupled(axes),
+                    norm=norm, op_name="fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_ifftn_impl, x, s=_tupled(s), axes=_tupled(axes),
+                    norm=norm, op_name="ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_rfftn_impl, x, s=_tupled(s), axes=_tupled(axes),
+                    norm=norm, op_name="rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_irfftn_impl, x, s=_tupled(s), axes=_tupled(axes),
+                    norm=norm, op_name="irfftn")
+
+
+def _hfftn_impl(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes, norm=_swap_norm(norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_hfftn_impl, x, s=_tupled(s), axes=_tupled(axes),
+                    norm=norm, op_name="hfftn")
+
+
+def _ihfftn_impl(x, s=None, axes=None, norm="backward"):
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes, norm=_swap_norm(norm)))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply_op(_ihfftn_impl, x, s=_tupled(s), axes=_tupled(axes),
+                    norm=norm, op_name="ihfftn")
+
+
+def _check_2d_axes(axes):
+    axes = _tupled(axes)
+    if axes is not None and len(axes) != 2:
+        raise ValueError("axes for a 2-D transform must have length 2")
+    return axes
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s=s, axes=_check_2d_axes(axes), norm=norm, name=name)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s=s, axes=_check_2d_axes(axes), norm=norm, name=name)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s=s, axes=_check_2d_axes(axes), norm=norm, name=name)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s=s, axes=_check_2d_axes(axes), norm=norm, name=name)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=_check_2d_axes(axes), norm=norm, name=name)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=_check_2d_axes(axes), norm=norm, name=name)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else dtypes.default_float_dtype()
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)).astype(dt))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else dtypes.default_float_dtype()
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)).astype(dt))
+
+
+def _fftshift_impl(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(int(a) for a in axes)
+    elif axes is not None:
+        axes = int(axes)
+    return apply_op(_fftshift_impl, x, axes=axes, op_name="fftshift")
+
+
+def _ifftshift_impl(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(int(a) for a in axes)
+    elif axes is not None:
+        axes = int(axes)
+    return apply_op(_ifftshift_impl, x, axes=axes, op_name="ifftshift")

@@ -560,6 +560,29 @@ def _accum_leaf(t: Tensor, ct):
         hook(t)
 
 
+def inplace_apply(x: "Tensor", fn, *args, **kwargs) -> "Tensor":
+    """Inplace-API helper for the reference's trailing-underscore ops
+    (tanh_/reshape_/scatter_ ...). XLA arrays are immutable, so "inplace"
+    means: run the out-of-place op against an alias carrying x's tape node,
+    then rebind x's buffer and node to the result. The alias (not x itself)
+    is what the new GradNode records as input — rebinding x directly would
+    make its node list x as its own input, a cycle that severs the tape.
+    """
+    if (_grad_state.enabled and not x.stop_gradient
+            and x._grad_node is None):
+        raise ValueError(
+            "in-place operation on a leaf Tensor that requires grad is not "
+            "supported (matches reference dygraph inplace semantics)")
+    prev = Tensor(x._data, stop_gradient=x.stop_gradient)
+    prev._grad_node = x._grad_node
+    prev._out_index = x._out_index
+    out = fn(prev, *args, **kwargs)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    return x
+
+
 # grad-accumulation hooks keyed by tensor id (DDP reducer uses these)
 _leaf_hooks: dict = {}
 

@@ -84,3 +84,9 @@ def is_floating(dtype) -> bool:
 
 def is_integer(dtype) -> bool:
     return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+# paddle.dtype parity: the reference aliases VarDesc.VarType as paddle.dtype
+# (reference python/paddle/framework/dtype.py:17); here dtypes ARE numpy
+# dtypes, so the class users construct/compare with is np.dtype itself.
+dtype = np.dtype

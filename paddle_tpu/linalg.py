@@ -1,0 +1,41 @@
+"""paddle.linalg namespace (reference python/paddle/linalg.py).
+
+Thin re-export of the tensor.linalg op set, plus ``cond`` which the
+reference exposes only here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import apply_op
+from .tensor.linalg import (  # noqa: F401
+    cholesky, det, eig, eigh, eigvals, eigvalsh, inv, lstsq, lu,
+    matrix_power, matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve,
+    svd, triangular_solve, cov, corrcoef,
+)
+
+__all__ = [
+    "cholesky", "cond", "det", "eig", "eigh", "eigvals", "inv",
+    "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv", "qr",
+    "slogdet", "solve", "svd",
+]
+
+
+def _cond_impl(x, p=2):
+    if p in ("fro", "nuc") or isinstance(p, (int, float)):
+        if p == 2 or p == -2:
+            s = jnp.linalg.svd(x, compute_uv=False)
+            if p == 2:
+                return s[..., 0] / s[..., -1]
+            return s[..., -1] / s[..., 0]
+        return (jnp.linalg.norm(x, ord=p, axis=(-2, -1))
+                * jnp.linalg.norm(jnp.linalg.inv(x), ord=p, axis=(-2, -1)))
+    raise ValueError("unsupported norm order for cond: %r" % (p,))
+
+
+def cond(x, p=None, name=None):
+    """Condition number w.r.t. matrix norm ``p``
+    (reference python/paddle/tensor/linalg.py:549)."""
+    if p is None:
+        p = 2
+    return apply_op(_cond_impl, x, p=p, op_name="cond")

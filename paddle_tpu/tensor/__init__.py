@@ -18,8 +18,9 @@ from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .signal import *  # noqa: F401,F403
 
-from . import creation, math, manipulation, logic, search, random, linalg  # noqa: F401
+from . import creation, math, manipulation, logic, search, random, linalg, signal  # noqa: F401
 
 
 def _einsum_impl(*ops, equation):
@@ -69,16 +70,19 @@ def _setitem_impl(x, v, idx):
 
 
 def _tensor_setitem(self, idx, value):
+    from ..framework.core import inplace_apply
+
     idx = _norm_index(idx)
     if not isinstance(value, Tensor):
         value = Tensor(jnp.asarray(value, dtype=self._data.dtype))
     elif value.dtype != self.dtype:
         value = cast(value, self.dtype)
-    out = apply_op(_setitem_impl, self, value, idx=idx)
-    self._data = out._data
-    self._grad_node = out._grad_node
-    self._out_index = out._out_index
-    if out._grad_node is not None:
+    # inplace_apply runs the op against an alias carrying the old tape node:
+    # rebinding self directly would make the new node its own input and
+    # sever the gradient history (see inplace_apply docstring).
+    inplace_apply(self, lambda prev: apply_op(_setitem_impl, prev, value,
+                                              idx=idx))
+    if self._grad_node is not None:
         self.stop_gradient = False
 
 

@@ -16,7 +16,8 @@ __all__ = [
     "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
     "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
     "eye", "tril", "triu", "diag", "diagflat", "meshgrid", "assign",
-    "clone", "numel", "one_hot", "complex",
+    "clone", "numel", "one_hot", "complex", "create_parameter",
+    "check_shape",
 ]
 
 
@@ -176,3 +177,42 @@ def _complex(r, i):
 
 def complex(real, imag, name=None):  # noqa: A001
     return apply_op(_complex, real, imag)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create a learnable Parameter directly
+    (reference fluid/layers/tensor.py:97: Xavier default, Constant(0) for
+    bias)."""
+    from ..framework.core import Parameter
+    from ..framework.param_attr import ParamAttr
+    from ..nn import initializer as I
+
+    init = default_initializer
+    trainable = True
+    attr = ParamAttr._to_attr(attr)
+    if isinstance(attr, ParamAttr):
+        if attr.initializer is not None:
+            init = attr.initializer
+        trainable = attr.trainable
+        if name is None:
+            name = attr.name
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    dt = _dt(dtype)
+    data = init(tuple(int(s) for s in shape), dt)
+    return Parameter(data, name=name, trainable=trainable)
+
+
+def check_shape(shape, op_name="create"):
+    """Validate a shape argument (reference fluid/data_feeder.py:142)."""
+    if isinstance(shape, Tensor):
+        return
+    if not isinstance(shape, (list, tuple)):
+        raise TypeError("%s: shape must be a list/tuple/Tensor, got %r"
+                        % (op_name, type(shape)))
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and not isinstance(s, Tensor):
+            raise TypeError("%s: shape entries must be int or Tensor" % op_name)
+        if isinstance(s, (int, np.integer)) and s < -1:
+            raise ValueError("%s: shape entries must be >= -1" % op_name)

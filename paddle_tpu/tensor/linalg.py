@@ -17,6 +17,7 @@ __all__ = [
     "slogdet", "solve", "triangular_solve", "matrix_power", "pinv",
     "multi_dot", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
     "histogram", "bincount", "mv", "lu", "lstsq", "cov", "corrcoef",
+    "inverse",
 ]
 
 
@@ -246,3 +247,6 @@ def corrcoef(x, rowvar=True, name=None):
 
 def _corrcoef(x, rowvar=True):
     return jnp.corrcoef(x, rowvar=rowvar)
+
+
+inverse = inv  # reference paddle.inverse (tensor/math.py) == linalg.inv

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtypes
-from ..framework.core import Tensor, apply_op
+from ..framework.core import Tensor, apply_op, inplace_apply
 
 __all__ = [
     "reshape", "transpose", "concat", "stack", "split", "chunk", "squeeze",
@@ -19,7 +19,9 @@ __all__ = [
     "unbind", "unique", "unique_consecutive", "repeat_interleave",
     "take_along_axis", "put_along_axis", "moveaxis", "cast", "unstack",
     "strided_slice", "tensordot", "as_real", "as_complex", "crop", "pad",
-    "index_sample", "index_add", "tolist", "split_sections",
+    "index_sample", "index_add", "tolist", "split_sections", "shape",
+    "rank", "reverse", "scatter_nd", "shard_index", "reshape_",
+    "squeeze_", "unsqueeze_", "scatter_",
 ]
 
 
@@ -559,3 +561,75 @@ def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):  # noq
 
 def tolist(x):
     return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()
+
+
+def _shape_impl(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def shape(input, name=None):  # noqa: A002
+    """1-D int32 tensor of the runtime shape (reference paddle.shape)."""
+    return apply_op(_shape_impl, input, op_name="shape")
+
+
+def _rank_impl(x):
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+def rank(input, name=None):  # noqa: A002
+    """0-D int32 tensor holding the number of dimensions."""
+    return apply_op(_rank_impl, input, op_name="rank")
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference fluid.layers.reverse)."""
+    return flip(x, axis, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None):  # noqa: A002
+    """Sum-scatter ``updates`` into zeros of ``shape``
+    (reference scatter_nd_op.cc: scatter_nd = scatter_nd_add onto zeros)."""
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s) for s in shape)
+    updates_t = updates if isinstance(updates, Tensor) else Tensor(jnp.asarray(updates))
+    zero = Tensor(jnp.zeros(shape, updates_t.dtype))
+    return scatter_nd_add(zero, index, updates_t, name=name)
+
+
+def _shard_index_impl(x, shard_size, shard_id, ignore_value):
+    return jnp.where(x // shard_size == shard_id, x % shard_size,
+                     jnp.asarray(ignore_value, x.dtype))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):  # noqa: A002
+    """Recompute indices relative to the shard that owns them
+    (reference fluid/layers/nn.py:14904 shard_index)."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            "The shard_id(%d) should be in [0, %d)" % (shard_id, nshards))
+    shard_size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    return apply_op(_shard_index_impl, input, shard_size=shard_size,
+                    shard_id=int(shard_id), ignore_value=int(ignore_value),
+                    op_name="shard_index")
+
+
+# ---------------------------------------------------------------------------
+# inplace variants (reference tensor/manipulation.py reshape_/squeeze_/...)
+# ---------------------------------------------------------------------------
+
+def reshape_(x, shape, name=None):  # noqa: A002
+    return inplace_apply(x, reshape, shape, name=name)
+
+
+def squeeze_(x, axis=None, name=None):
+    return inplace_apply(x, squeeze, axis=axis, name=name)
+
+
+def unsqueeze_(x, axis, name=None):
+    return inplace_apply(x, unsqueeze, axis, name=name)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return inplace_apply(x, scatter, index, updates, overwrite=overwrite,
+                         name=name)

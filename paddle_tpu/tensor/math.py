@@ -25,7 +25,8 @@ __all__ = [
     "lgamma", "digamma", "neg", "increment", "scale", "stanh", "multiplex",
     "all", "any", "deg2rad", "rad2deg", "angle", "conj", "real", "imag",
     "trace", "diff", "heaviside", "frac", "count_nonzero", "nansum",
-    "nanmean", "gcd", "lcm", "lerp", "rot90",
+    "nanmean", "gcd", "lcm", "lerp", "rot90", "add_n", "diagonal",
+    "floor_mod", "tanh_",
 ]
 
 
@@ -453,3 +454,41 @@ def _rot90(x, k=1, axes=(0, 1)):
 
 def rot90(x, k=1, axes=(0, 1), name=None):
     return apply_op(_rot90, x, k=int(k), axes=tuple(axes))
+
+
+def _add_n_impl(*xs):
+    out = xs[0]
+    for a in xs[1:]:
+        out = out + a
+    return out
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference sum_op / paddle.add_n).
+
+    A single Tensor still goes through apply_op so the result is a fresh
+    Tensor, never an alias of the input (inplace ops on the result must not
+    mutate the input)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if not inputs:
+        raise ValueError("add_n expects at least one input tensor")
+    return apply_op(_add_n_impl, *[_w(x) for x in inputs], op_name="add_n")
+
+
+def _diagonal_impl(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(_diagonal_impl, _w(x), offset=int(offset),
+                    axis1=int(axis1), axis2=int(axis2), op_name="diagonal")
+
+
+floor_mod = remainder  # reference alias (paddle.floor_mod == paddle.remainder)
+
+
+def tanh_(x, name=None):
+    from ..framework.core import inplace_apply
+
+    return inplace_apply(x, tanh)

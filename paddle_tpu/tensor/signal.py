@@ -1,0 +1,165 @@
+"""Signal ops: frame / overlap_add / stft / istft
+(reference python/paddle/tensor/signal.py:34,155,238,392).
+
+frame/overlap_add are pure gather/scatter-add reshapes, so XLA fuses them;
+stft composes frame + rfft/fft and istft inverts it with the standard
+window-envelope normalization. All differentiable through apply_op's vjp.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_impl(x, frame_length, hop_length, axis=-1):
+    if axis not in (0, -1):
+        raise ValueError("frame: axis must be 0 or -1, got %d" % axis)
+    if axis == 0:
+        # [seq, ...] -> operate on leading axis; move it last, recurse, undo
+        y = _frame_impl(jnp.moveaxis(x, 0, -1), frame_length, hop_length, -1)
+        # y: [..., frame_length, num_frames] -> [num_frames, frame_length, ...]
+        return jnp.moveaxis(jnp.moveaxis(y, -1, 0), -1, 1)
+    seq_len = x.shape[-1]
+    num_frames = 1 + (seq_len - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length               # [F]
+    offs = jnp.arange(frame_length)                            # [L]
+    idx = starts[None, :] + offs[:, None]                      # [L, F]
+    return x[..., idx]                                         # [..., L, F]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    frame_length, hop_length = int(frame_length), int(hop_length)
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    seq_len = x.shape[0] if int(axis) == 0 else x.shape[-1]
+    if seq_len < frame_length:
+        raise ValueError(
+            "frame: input length (%d) must be >= frame_length (%d)"
+            % (seq_len, frame_length))
+    return apply_op(_frame_impl, x, frame_length=frame_length,
+                    hop_length=hop_length, axis=int(axis), op_name="frame")
+
+
+def _overlap_add_impl(x, hop_length, axis=-1):
+    if axis not in (0, -1):
+        raise ValueError("overlap_add: axis must be 0 or -1, got %d" % axis)
+    if axis == 0:
+        # [num_frames, frame_length, ...] -> [..., frame_length, num_frames]
+        y = _overlap_add_impl(
+            jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2), hop_length, -1)
+        return jnp.moveaxis(y, -1, 0)
+    frame_length, num_frames = x.shape[-2], x.shape[-1]
+    seq_len = (num_frames - 1) * hop_length + frame_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = (starts[None, :] + jnp.arange(frame_length)[:, None]).reshape(-1)
+    flat = x.reshape(x.shape[:-2] + (frame_length * num_frames,))
+    out = jnp.zeros(x.shape[:-2] + (seq_len,), dtype=x.dtype)
+    return out.at[..., idx].add(flat)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return apply_op(_overlap_add_impl, x, hop_length=int(hop_length),
+                    axis=int(axis), op_name="overlap_add")
+
+
+def _prep_window(window, win_length, n_fft, op):
+    """Validate win_length<=n_fft (reference signal.py asserts this) and
+    center-pad the window to n_fft."""
+    if win_length > n_fft:
+        raise ValueError(
+            "%s: win_length (%d) must be <= n_fft (%d)" % (op, win_length, n_fft))
+    if window is not None:
+        w = window.numpy() if isinstance(window, Tensor) else np.asarray(window)
+        if w.ndim != 1 or len(w) != win_length:
+            raise ValueError(
+                "%s: window must be a 1-D tensor of length win_length (%d), "
+                "got shape %r" % (op, win_length, tuple(w.shape)))
+    else:
+        w = np.ones(win_length, np.float32)
+    if len(w) < n_fft:
+        lpad = (n_fft - len(w)) // 2
+        w = np.pad(w, (lpad, n_fft - len(w) - lpad))
+    return Tensor(jnp.asarray(w))
+
+
+def _stft_impl(x, window, n_fft, hop_length, center, pad_mode, normalized,
+               onesided):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = _frame_impl(x, n_fft, hop_length, -1)      # [..., n_fft, F]
+    frames = frames * window[:, None]
+    if onesided:
+        out = jnp.fft.rfft(frames, axis=-2)
+    else:
+        out = jnp.fft.fft(frames, axis=-2)
+    if normalized:
+        out = out / jnp.sqrt(jnp.asarray(n_fft, out.real.dtype))
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference tensor/signal.py:238).
+
+    x: [..., seq_length] real (or complex with onesided=False). Returns
+    complex [..., n_fft//2+1 (or n_fft), num_frames].
+    """
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    w = _prep_window(window, win_length, n_fft, "stft")
+    return apply_op(_stft_impl, x, w, n_fft=n_fft,
+                    hop_length=hop_length, center=bool(center),
+                    pad_mode=pad_mode, normalized=bool(normalized),
+                    onesided=bool(onesided), op_name="stft")
+
+
+def _istft_impl(x, window, n_fft, hop_length, center, normalized, onesided,
+                length, return_complex):
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, x.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2)     # [..., n_fft, F]
+    else:
+        frames = jnp.fft.ifft(x, axis=-2)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * window[:, None]
+    y = _overlap_add_impl(frames, hop_length, -1)
+    # window-envelope normalization: overlap-add of window^2
+    wsq = jnp.broadcast_to((window ** 2)[:, None], (n_fft, x.shape[-1]))
+    env = _overlap_add_impl(wsq, hop_length, -1)
+    y = y / jnp.where(env > 1e-11, env, 1.0)
+    if center:
+        y = y[..., n_fft // 2:]
+        if length is None:
+            # all full frames minus the symmetric head padding
+            y = y[..., : (x.shape[-1] - 1) * hop_length]
+    if length is not None:
+        y = y[..., :length]
+    return y
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT (reference tensor/signal.py:392)."""
+    n_fft = int(n_fft)
+    if onesided and return_complex:
+        raise ValueError(
+            "istft: onesided=True cannot produce a complex output "
+            "(set onesided=False for return_complex=True)")
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    w = _prep_window(window, win_length, n_fft, "istft")
+    return apply_op(_istft_impl, x, w, n_fft=n_fft,
+                    hop_length=hop_length, center=bool(center),
+                    normalized=bool(normalized), onesided=bool(onesided),
+                    length=None if length is None else int(length),
+                    return_complex=bool(return_complex), op_name="istft")

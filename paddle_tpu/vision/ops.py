@@ -897,27 +897,25 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances=None,
         keep = np.where((ws_orig >= ms) & (hs_orig >= ms)
                         & (cx <= im_w) & (cy <= im_h))[0]
         props, psc = props[keep], sc[order][keep]
-        # NMS with eta-adaptive threshold (nms_util.h NMSFast)
-        alive = list(range(len(props)))
+        # NMS with eta-adaptive threshold (nms_util.h NMSFast), rejection
+        # vectorized per round so pre_nms_top_n=6000 stays tractable
+        areas = (props[:, 2] - props[:, 0] + 1) * (props[:, 3] - props[:, 1] + 1)
+        alive_idx = np.arange(len(props))
         sel = []
         thr = nms_thresh
-        while alive:
-            i = alive.pop(0)
+        while alive_idx.size:
+            i = alive_idx[0]
             sel.append(i)
             if 0 < post_nms_top_n <= len(sel):
                 break
-            ref = props[i]
-            rest = []
-            for j in alive:
-                b = props[j]
-                iw = min(ref[2], b[2]) - max(ref[0], b[0]) + 1
-                ih = min(ref[3], b[3]) - max(ref[1], b[1]) + 1
-                inter = max(iw, 0) * max(ih, 0)
-                a1 = (ref[2] - ref[0] + 1) * (ref[3] - ref[1] + 1)
-                a2 = (b[2] - b[0] + 1) * (b[3] - b[1] + 1)
-                if inter / (a1 + a2 - inter) <= thr:
-                    rest.append(j)
-            alive = rest
+            rest = alive_idx[1:]
+            iw = (np.minimum(props[i, 2], props[rest, 2])
+                  - np.maximum(props[i, 0], props[rest, 0]) + 1)
+            ih = (np.minimum(props[i, 3], props[rest, 3])
+                  - np.maximum(props[i, 1], props[rest, 1]) + 1)
+            inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
+            iou = inter / (areas[i] + areas[rest] - inter)
+            alive_idx = rest[iou <= thr]
             if eta < 1.0 and thr > 0.5:
                 thr *= eta
         all_rois.append(props[sel])
@@ -959,9 +957,12 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     out = ([t for t in multi],
            Tensor(jnp.asarray(restore[:, None].astype(np.int32))))
     if rois_num is not None:
-        # per-level per-image counts, summed over images like the reference
-        counts = [Tensor(jnp.asarray(np.asarray(
-            [int((lvl == L).sum())], np.int32)))
+        # per-level PER-IMAGE counts [N], matching the reference's
+        # MultiLevelRoIsNum output (distribute_fpn_proposals_op.h:180)
+        rn = np.asarray(_arr(rois_num), np.int64).reshape(-1)
+        img_id = np.repeat(np.arange(len(rn)), rn)
+        counts = [Tensor(jnp.asarray(np.bincount(
+            img_id[lvl == L], minlength=len(rn)).astype(np.int32)))
             for L in range(min_level, max_level + 1)]
         return out + (counts,)
     return out
@@ -978,4 +979,20 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                              for s in multi_scores]) \
         if multi_scores else np.zeros((0,), np.float32)
     order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
-    return Tensor(jnp.asarray(rois[order]))
+    if rois_num_per_level is None:
+        return Tensor(jnp.asarray(rois[order]))
+    # reference collect_fpn_proposals_op.h: select top-K globally by score,
+    # then regroup by image (stable, so within-image score order is kept)
+    # and also emit per-image counts.
+    per_level = [np.asarray(_arr(c), np.int64).reshape(-1)
+                 for c in rois_num_per_level]
+    n_img = len(per_level[0]) if per_level else 0
+    img_id = np.concatenate([np.repeat(np.arange(len(c)), c)
+                             for c in per_level]) \
+        if per_level else np.zeros((0,), np.int64)
+    sel_img = img_id[order]
+    regroup = np.argsort(sel_img, kind="stable")
+    out_rois = rois[order][regroup]
+    rois_num = np.bincount(sel_img, minlength=n_img).astype(np.int32)
+    return (Tensor(jnp.asarray(out_rois)),
+            Tensor(jnp.asarray(rois_num)))

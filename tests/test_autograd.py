@@ -164,3 +164,23 @@ class TestRecompute:
         y2 = lin(x).sum()
         y2.backward()
         np.testing.assert_allclose(g_rec, lin.weight.grad.numpy(), rtol=1e-5)
+
+
+class TestInplaceTape:
+    def test_setitem_keeps_history(self):
+        """__setitem__ must not sever the tape of the pre-assignment value
+        (regression: the old rebind made the new node its own input)."""
+        x = paddle.ones([3])
+        x.stop_gradient = False
+        y = x * 3.0
+        y[0] = 5.0
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 3.0, 3.0])
+
+    def test_inplace_on_requires_grad_leaf_raises(self):
+        x = paddle.ones([3])
+        x.stop_gradient = False
+        with pytest.raises(ValueError, match="in-place"):
+            paddle.tanh_(x)
+        with paddle.no_grad():
+            paddle.tanh_(x)  # allowed under no_grad, like reference init code

@@ -572,3 +572,36 @@ class TestFPNRouting:
         out = ops.collect_fpn_proposals([_t(r1), _t(r2)], [_t(s1), _t(s2)],
                                         2, 3, post_nms_top_n=2).numpy()
         np.testing.assert_allclose(out, [[0, 0, 2, 2], [0, 0, 3, 3]])
+
+
+class TestFPNRoutingPerImage:
+    def test_distribute_per_image_counts(self):
+        # image 0 owns rois[0:2], image 1 owns rois[2:4]
+        rois = np.array([
+            [0, 0, 223, 223],    # lvl 4  (img 0)
+            [0, 0, 111, 111],    # lvl 3  (img 0)
+            [0, 0, 447, 447],    # lvl 5  (img 1)
+            [0, 0, 15, 15],      # lvl 2  (img 1)
+        ], np.float32)
+        multi, restore, counts = ops.distribute_fpn_proposals(
+            _t(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224, rois_num=_t(np.array([2, 2], np.int32)))
+        got = [c.numpy().tolist() for c in counts]
+        # per-level, PER-IMAGE counts [N=2]
+        assert got == [[0, 1], [1, 0], [1, 0], [0, 1]]
+
+    def test_collect_returns_rois_num_grouped_by_image(self):
+        # level A: img0 has 1 roi, img1 has 1; level B: img0 has 0, img1 has 1
+        r1 = np.array([[0, 0, 1, 1], [0, 0, 2, 2]], np.float32)
+        r2 = np.array([[0, 0, 3, 3]], np.float32)
+        s1 = np.array([0.5, 0.9], np.float32)
+        s2 = np.array([0.7], np.float32)
+        n1 = np.array([1, 1], np.int32)
+        n2 = np.array([0, 1], np.int32)
+        fpn_rois, rois_num = ops.collect_fpn_proposals(
+            [_t(r1), _t(r2)], [_t(s1), _t(s2)], 2, 3, post_nms_top_n=2,
+            rois_num_per_level=[_t(n1), _t(n2)])
+        # top-2 by score: (img1, 0.9) and (img1, 0.7); regrouped by image
+        np.testing.assert_allclose(rois_num.numpy(), [0, 2])
+        np.testing.assert_allclose(fpn_rois.numpy(),
+                                   [[0, 0, 2, 2], [0, 0, 3, 3]])

@@ -1,0 +1,261 @@
+"""paddle.fft / paddle.linalg / tensor.signal / top-level op-surface parity.
+
+Goldens: numpy.fft for the fft family (torch.fft for the Hermitian 2-d/n-d
+variants numpy lacks), manual numpy for frame/overlap_add, torch.stft for
+stft. Reference surface: python/paddle/fft.py, python/paddle/tensor/signal.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft
+
+torch = pytest.importorskip("torch")
+
+RNG = np.random.default_rng(7)
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+class TestFFT:
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_ifft_roundtrip_and_numpy(self, norm):
+        x = RNG.standard_normal((3, 16)).astype(np.float32)
+        got = fft.fft(_t(x), norm=norm).numpy()
+        np.testing.assert_allclose(got, np.fft.fft(x, norm=norm), rtol=1e-4,
+                                   atol=1e-5)
+        back = fft.ifft(_t(got), norm=norm).numpy()
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_irfft_hfft_ihfft_vs_numpy(self):
+        x = RNG.standard_normal((2, 12)).astype(np.float32)
+        np.testing.assert_allclose(fft.rfft(_t(x)).numpy(), np.fft.rfft(x),
+                                   rtol=1e-4, atol=1e-5)
+        c = np.fft.rfft(x)
+        np.testing.assert_allclose(fft.irfft(_t(c)).numpy(), np.fft.irfft(c),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(fft.hfft(_t(c)).numpy(), np.fft.hfft(c),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(fft.ihfft(_t(x)).numpy(), np.fft.ihfft(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fftn_fft2_vs_numpy(self):
+        x = RNG.standard_normal((2, 8, 6)).astype(np.float32)
+        np.testing.assert_allclose(fft.fftn(_t(x)).numpy(), np.fft.fftn(x),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(fft.fft2(_t(x)).numpy(), np.fft.fft2(x),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(fft.rfft2(_t(x)).numpy(), np.fft.rfft2(x),
+                                   rtol=1e-3, atol=1e-4)
+        c = np.fft.rfft2(x)
+        np.testing.assert_allclose(fft.irfft2(_t(c)).numpy(),
+                                   np.fft.irfft2(c), rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_hfft2_ihfft2_vs_torch(self, norm):
+        x = RNG.standard_normal((4, 6)).astype(np.float32) \
+            + 1j * RNG.standard_normal((4, 6)).astype(np.float32)
+        want = torch.fft.hfft2(torch.from_numpy(x), norm=norm).numpy()
+        got = fft.hfft2(_t(x.astype(np.complex64)), norm=norm).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        r = RNG.standard_normal((4, 6)).astype(np.float32)
+        want_i = torch.fft.ihfft2(torch.from_numpy(r), norm=norm).numpy()
+        got_i = fft.ihfft2(_t(r), norm=norm).numpy()
+        np.testing.assert_allclose(got_i, want_i, rtol=1e-3, atol=1e-4)
+
+    def test_fftfreq_shift(self):
+        np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5).astype(np.float32))
+        np.testing.assert_allclose(fft.rfftfreq(8).numpy(),
+                                   np.fft.rfftfreq(8).astype(np.float32))
+        x = RNG.standard_normal((5, 6)).astype(np.float32)
+        np.testing.assert_allclose(fft.fftshift(_t(x)).numpy(),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(fft.ifftshift(_t(x), axes=1).numpy(),
+                                   np.fft.ifftshift(x, axes=1))
+
+    def test_fft_grad(self):
+        x = _t(RNG.standard_normal((8,)).astype(np.float32))
+        x.stop_gradient = False
+        y = paddle.sum(paddle.abs(fft.rfft(x)))
+        y.backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_bad_norm_raises(self):
+        with pytest.raises(ValueError, match="norm"):
+            fft.fft(_t(np.ones(4, np.float32)), norm="bogus")
+
+
+class TestSignal:
+    def test_frame_matches_manual(self):
+        x = np.arange(10, dtype=np.float32)
+        got = paddle.tensor.signal.frame(_t(x), 4, 2).numpy()
+        want = np.stack([x[s:s + 4] for s in range(0, 7, 2)], axis=-1)
+        np.testing.assert_allclose(got, want)
+        # batch + axis=0
+        xb = RNG.standard_normal((2, 10)).astype(np.float32)
+        got_b = paddle.tensor.signal.frame(_t(xb), 4, 2).numpy()
+        assert got_b.shape == (2, 4, 4)
+        got0 = paddle.tensor.signal.frame(_t(x), 4, 2, axis=0).numpy()
+        np.testing.assert_allclose(got0, want.T)
+
+    def test_overlap_add_inverts_nonoverlapping_frame(self):
+        x = RNG.standard_normal((12,)).astype(np.float32)
+        f = paddle.tensor.signal.frame(_t(x), 4, 4)
+        back = paddle.tensor.signal.overlap_add(f, 4).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_overlap_add_matches_torch(self):
+        frames = RNG.standard_normal((3, 6, 5)).astype(np.float32)
+        got = paddle.tensor.signal.overlap_add(_t(frames), 2).numpy()
+        # torch.nn.functional.fold equivalent via manual loop
+        want = np.zeros((3, 2 * 4 + 6), np.float32)
+        for i in range(5):
+            want[:, i * 2:i * 2 + 6] += frames[:, :, i]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_stft_matches_torch(self):
+        x = RNG.standard_normal((2, 128)).astype(np.float32)
+        win = np.hanning(16).astype(np.float32)
+        got = paddle.tensor.signal.stft(_t(x), n_fft=16, hop_length=4,
+                                        window=_t(win)).numpy()
+        want = torch.stft(torch.from_numpy(x), n_fft=16, hop_length=4,
+                          window=torch.from_numpy(win), center=True,
+                          pad_mode="reflect", onesided=True,
+                          return_complex=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_istft_roundtrip(self):
+        x = RNG.standard_normal((2, 128)).astype(np.float32)
+        win = (np.hanning(17)[:16] + 1e-3).astype(np.float32)
+        spec = paddle.tensor.signal.stft(_t(x), n_fft=16, hop_length=4,
+                                         window=_t(win))
+        back = paddle.tensor.signal.istft(spec, n_fft=16, hop_length=4,
+                                          window=_t(win), length=128).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_grad(self):
+        x = _t(RNG.standard_normal((64,)).astype(np.float32))
+        x.stop_gradient = False
+        y = paddle.sum(paddle.abs(paddle.tensor.signal.stft(x, 16)))
+        y.backward()
+        assert x.grad is not None and x.grad.shape == [64]
+
+
+class TestLinalgNamespace:
+    def test_cond(self):
+        a = RNG.standard_normal((4, 4)).astype(np.float32)
+        a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(paddle.linalg.cond(_t(a)).numpy(),
+                                   np.linalg.cond(a), rtol=1e-3)
+        np.testing.assert_allclose(
+            paddle.linalg.cond(_t(a), p="fro").numpy(),
+            np.linalg.cond(a, "fro"), rtol=1e-3)
+        np.testing.assert_allclose(
+            paddle.linalg.cond(_t(a), p=1).numpy(),
+            np.linalg.cond(a, 1), rtol=1e-3)
+
+    def test_namespace_complete(self):
+        for n in ["cholesky", "cond", "det", "eig", "eigh", "eigvals", "inv",
+                  "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv",
+                  "qr", "slogdet", "solve", "svd"]:
+            assert hasattr(paddle.linalg, n), n
+
+
+class TestTopLevelSurface:
+    def test_add_n_diagonal(self):
+        xs = [RNG.standard_normal((3, 4)).astype(np.float32) for _ in range(3)]
+        np.testing.assert_allclose(
+            paddle.add_n([_t(a) for a in xs]).numpy(), sum(xs), rtol=1e-6)
+        m = RNG.standard_normal((5, 5)).astype(np.float32)
+        np.testing.assert_allclose(paddle.diagonal(_t(m), offset=1).numpy(),
+                                   np.diagonal(m, offset=1))
+
+    def test_shape_rank_reverse(self):
+        x = _t(np.zeros((2, 3, 4), np.float32))
+        assert paddle.shape(x).numpy().tolist() == [2, 3, 4]
+        assert int(paddle.rank(x)) == 3
+        m = RNG.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(paddle.reverse(_t(m), [0]).numpy(), m[::-1])
+
+    def test_scatter_nd_sums_duplicates(self):
+        idx = _t(np.array([[1], [2], [1]], np.int64))
+        upd = _t(np.array([1.0, 2.0, 3.0], np.float32))
+        out = paddle.scatter_nd(idx, upd, [5]).numpy()
+        np.testing.assert_allclose(out, [0, 4, 2, 0, 0])
+
+    def test_shard_index(self):
+        label = _t(np.array([[16], [1]], np.int64))
+        out = paddle.shard_index(label, index_num=20, nshards=2,
+                                 shard_id=0).numpy()
+        np.testing.assert_allclose(out, [[-1], [1]])
+        with pytest.raises(ValueError):
+            paddle.shard_index(label, 20, 2, 5)
+
+    def test_inplace_variants_rebind_and_autograd(self):
+        x = _t(np.full((4,), 0.5, np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        paddle.tanh_(y)          # y <- tanh(y), same python object
+        np.testing.assert_allclose(y.numpy(), np.tanh(1.0), rtol=1e-6)
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   2 * (1 - np.tanh(1.0) ** 2) * np.ones(4),
+                                   rtol=1e-5)
+        z = _t(np.ones((2, 3), np.float32))
+        zid = id(z)
+        paddle.reshape_(z, [3, 2])
+        paddle.unsqueeze_(z, 0)
+        paddle.squeeze_(z, 0)
+        assert z.shape == [3, 2] and id(z) == zid
+
+    def test_create_parameter(self):
+        p = paddle.create_parameter([4, 3], "float32")
+        assert not p.stop_gradient and p.shape == [4, 3]
+        b = paddle.create_parameter([3], "float32", is_bias=True)
+        np.testing.assert_allclose(b.numpy(), np.zeros(3))
+
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5], [6]]
+        r2 = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+        assert list(r2()) == [[0, 1, 2], [3, 4, 5]]
+
+    def test_misc_parity_names(self):
+        paddle.disable_signal_handler()
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        paddle.set_printoptions(precision=4)
+        assert paddle.dtype("float32") == np.float32
+        assert paddle.floor_mod(_t(np.array([7])),
+                                _t(np.array([4]))).numpy() == 3
+        paddle.check_shape([2, 3])
+        with pytest.raises((TypeError, ValueError)):
+            paddle.check_shape("nope")
+
+
+class TestSignalValidation:
+    def test_frame_too_short_raises(self):
+        with pytest.raises(ValueError, match="frame_length"):
+            paddle.tensor.signal.frame(_t(np.ones(3, np.float32)), 4, 2)
+
+    def test_stft_win_length_too_long_raises(self):
+        with pytest.raises(ValueError, match="win_length"):
+            paddle.tensor.signal.stft(_t(np.ones(64, np.float32)),
+                                      n_fft=16, win_length=32)
+
+    def test_istft_onesided_complex_raises(self):
+        spec = paddle.tensor.signal.stft(_t(np.ones(64, np.float32)), 16)
+        with pytest.raises(ValueError, match="onesided"):
+            paddle.tensor.signal.istft(spec, 16, return_complex=True)
+
+    def test_create_parameter_str_and_initializer_attr(self):
+        from paddle_tpu.nn import initializer as I
+
+        p = paddle.create_parameter([2, 2], "float32", attr="named_w")
+        assert p.name == "named_w"
+        p2 = paddle.create_parameter([2, 2], "float32",
+                                     attr=I.Constant(3.0))
+        np.testing.assert_allclose(p2.numpy(), np.full((2, 2), 3.0))
