@@ -77,6 +77,8 @@ from . import distributed  # noqa: F401
 from . import device  # noqa: F401
 from . import utils  # noqa: F401
 from . import ops  # noqa: F401
+from . import distribution  # noqa: F401
+from . import onnx  # noqa: F401
 from . import fft  # noqa: F401
 # NOT `from . import linalg`: the tensor star-import above already bound
 # `linalg` to tensor.linalg, which would stop the submodule import; the

@@ -20,6 +20,10 @@ from .auto_parallel import (  # noqa: F401
     set_pipeline_stage)
 from . import auto_parallel  # noqa: F401
 from . import fleet  # noqa: F401
+from . import launch  # noqa: F401
+from .fleet.dataset import (  # noqa: F401
+    InMemoryDataset, QueueDataset, CountFilterEntry, ProbabilityEntry,
+)
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
@@ -33,3 +37,21 @@ def get_device_count():
     import jax
 
     return jax.device_count()
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference parallel.py gloo CPU bootstrap. The mesh runtime needs no
+    TCP rendezvous (jax.distributed owns multi-host init), so this only
+    validates arguments and marks the env initialized."""
+    from .env import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """No gloo store to tear down — kept for API parity."""

@@ -1,0 +1,132 @@
+"""Dataset shims: InMemoryDataset / QueueDataset / sparse-table entries
+(reference python/paddle/distributed/fleet/dataset/dataset.py:24,324 and
+distributed/entry_attr.py).
+
+The reference backs these with the C++ MultiSlotDataFeed + channel stack
+feeding parameter-server trainers (SURVEY §2.1 #24/#25). Per the README
+trainer/DataFeed and parameter-server decisions, the TPU build's
+high-throughput path is io.DataLoader (+ the native prefetcher); these
+classes keep the file-list API working single-process: text files, one
+sample per line, parsed by ``pipe_command`` (run through the shell exactly
+like the reference) or a user ``parse_fn``.
+"""
+from __future__ import annotations
+
+import random as _random
+import subprocess
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
+           "CountFilterEntry", "ProbabilityEntry"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist = []
+        self.pipe_command = None
+        self.parse_fn = None
+        self.use_var = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self.batch_size = int(batch_size)
+        self.thread_num = int(thread_num)
+        self.use_var = list(use_var or [])
+        self.pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def _read_lines(self):
+        for path in self.filelist:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                data = f.read()
+            if self.pipe_command:
+                data = subprocess.run(
+                    self.pipe_command, shell=True, input=data,
+                    capture_output=True, text=True, check=True).stdout
+            for line in data.splitlines():
+                if line:
+                    yield self.parse_fn(line) if self.parse_fn else line
+
+    def _batches(self, lines):
+        buf = []
+        for item in lines:
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference dataset.py:324)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = []
+
+    def load_into_memory(self, is_shuffle=False):
+        self._memory = list(self._read_lines())
+        if is_shuffle:
+            self.local_shuffle()
+
+    def local_shuffle(self):
+        _random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host: global == local (multi-host shuffle belongs to the
+        # PS runtime, see the README parameter-server decision)
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def release_memory(self):
+        self._memory = []
+
+    def __iter__(self):
+        return self._batches(iter(self._memory))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: no in-memory staging (reference dataset.py
+    QueueDataset)."""
+
+    def __iter__(self):
+        return self._batches(self._read_lines())
+
+
+class ProbabilityEntry:
+    """Sparse-table entry admission by probability (reference
+    distributed/entry_attr.py). Config-only here: the sparse table lives in
+    the parameter server the README documents out of the TPU critical
+    path."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = float(probability)
+
+    def _to_attr(self):
+        return "probability_entry:%f" % self.probability
+
+
+class CountFilterEntry:
+    """Sparse-table entry admission by show count (reference
+    distributed/entry_attr.py)."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return "count_filter_entry:%d" % self.count_filter

@@ -1,0 +1,154 @@
+"""Probability distributions (reference python/paddle/distribution.py:42).
+
+Uniform/Normal/Categorical with sample/log_prob/probs/entropy/kl_divergence.
+Sampling draws keys from the global framework PRNG (framework/random.py) so
+``paddle.seed`` governs reproducibility, mirroring the reference's use of
+the global generator.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor
+from .framework.random import next_key
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _arr(v):
+    if isinstance(v, Tensor):
+        return v._data
+    return jnp.asarray(v, jnp.float32)
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:42)."""
+
+    def sample(self, shape):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distribution.py:169)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape, seed=0):
+        key = jax.random.PRNGKey(seed) if seed else next_key()
+        shape = tuple(int(s) for s in shape) + jnp.broadcast_shapes(
+            self.low.shape, self.high.shape)
+        u = jax.random.uniform(key, shape, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        dens = jnp.where(inside, 1.0 / (self.high - self.low), 0.0)
+        return Tensor(jnp.log(dens))
+
+    def probs(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, 1.0 / (self.high - self.low), 0.0))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) (reference distribution.py:391)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape, seed=0):
+        key = jax.random.PRNGKey(seed) if seed else next_key()
+        shape = tuple(int(s) for s in shape) + jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)
+        z = jax.random.normal(key, shape, jnp.float32)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Normal):
+            raise TypeError("kl_divergence expects another Normal")
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference distribution.py:641,
+    which softmax-normalizes: prob = exp(logits - max) / sum)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def _p(self):
+        z = self.logits - jnp.max(self.logits, axis=-1, keepdims=True)
+        e = jnp.exp(z)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def sample(self, shape):
+        key = next_key()
+        p = self._p()
+        shape = tuple(int(s) for s in shape)
+        idx = jax.random.categorical(key, jnp.log(p),
+                                     shape=shape + p.shape[:-1])
+        return Tensor(idx.astype(jnp.int64))
+
+    def probs(self, value):
+        p = self._p()
+        v = _arr(value).astype(jnp.int32)
+        if p.ndim == 1:
+            return Tensor(p[v])
+        if v.ndim == p.ndim - 1:
+            # per-row category index (batched logits): gather one per row
+            return Tensor(jnp.take_along_axis(p, v[..., None],
+                                              axis=-1)[..., 0])
+        return Tensor(jnp.take_along_axis(p, v, axis=-1))
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(self.probs(value)._data))
+
+    def entropy(self):
+        p = self._p()
+        return Tensor(-jnp.sum(p * jnp.log(p), axis=-1))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            raise TypeError("kl_divergence expects another Categorical")
+        p, q = self._p(), other._p()
+        return Tensor(jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1))

@@ -29,6 +29,8 @@ from ..framework.core import Parameter, Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["state", "functional_call", "to_static", "TrainStep", "not_to_static",
+           "ProgramTranslator", "TracedLayer", "TranslatedLayer",
+           "set_code_level", "set_verbosity",
            "InputSpec", "save", "load"]
 
 
@@ -120,6 +122,7 @@ class StaticFunction:
         if isinstance(fn_or_layer, Layer):
             self._layer = fn_or_layer
             self._fn = None
+            self._orig_call = fn_or_layer.forward  # pre-conversion, bound
             try:
                 converted = convert_to_static(fn_or_layer.forward)
                 if converted is not type(fn_or_layer).forward:
@@ -130,6 +133,7 @@ class StaticFunction:
                 pass  # conversion is best-effort; plain trace still works
         else:
             self._layer = None
+            self._orig_call = fn_or_layer
             try:
                 self._fn = convert_to_static(fn_or_layer)
             except Exception:
@@ -157,6 +161,11 @@ class StaticFunction:
             self._compiled = jax.jit(pure_fn)
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator._enabled:
+            # ProgramTranslator().enable(False): run the ORIGINAL python
+            # eagerly (no AST conversion, no jit) so breakpoints/prints in
+            # user code fire — reference program_translator.py semantics.
+            return self._orig_call(*args, **kwargs)
         if self._compiled is None:
             self._make_compiled()
         arr_args = _tree_tensor_to_array(args)
@@ -321,6 +330,88 @@ def save(layer, path, input_spec=None, **configs):
 
 
 def load(path, **configs):
+    """Reference jit.load: returns a TranslatedLayer when jit.save
+    artifacts exist at ``path``; falls back to the raw state dict."""
+    import os
+
+    if os.path.exists(path + ".pdmodel"):
+        return TranslatedLayer(path)
     from ..framework.io import load as _load
 
     return _load(path + ".pdparams")
+
+
+class ProgramTranslator:
+    """Singleton switch for dy2static (reference
+    dygraph_to_static/program_translator.py:768). enable(False) makes
+    to_static functions run eagerly."""
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed code at the given level (reference jit API); the
+    AST translator logs through the standard logging module here."""
+    import logging
+
+    logging.getLogger("paddle_tpu.dy2static").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import logging
+
+    logging.getLogger("paddle_tpu.dy2static").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+class TranslatedLayer(Layer):
+    """Layer reconstructed from jit.save artifacts, served through the
+    compiled-program Predictor (reference dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, path):
+        super().__init__()
+        from ..inference import Predictor
+
+        self._predictor = Predictor(path)
+
+    def forward(self, *inputs):
+        arrs = [x.numpy() if isinstance(x, Tensor) else x for x in inputs]
+        outs = [Tensor(jnp.asarray(o)) for o in self._predictor.run(arrs)]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class TracedLayer:
+    """Trace a dygraph layer into a servable program (reference
+    dygraph/jit.py TracedLayer): TracedLayer.trace -> (out, traced);
+    traced(x) replays; save_inference_model exports."""
+
+    def __init__(self, layer, input_spec):
+        self._layer = layer
+        self._input_spec = input_spec
+
+    @staticmethod
+    def trace(layer, inputs):
+        out = layer(*inputs)
+        spec = [InputSpec(list(x.shape), str(x.dtype)) for x in inputs]
+        return out, TracedLayer(layer, spec)
+
+    def __call__(self, *inputs):
+        return self._layer(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._layer, path, input_spec=self._input_spec)

@@ -8,8 +8,9 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .extension import *  # noqa: F401,F403
 
-from . import activation, common, conv, pooling, norm, loss  # noqa: F401
+from . import activation, common, conv, pooling, norm, loss, extension  # noqa: F401
 from .sequence import (  # noqa: F401
     sequence_mask, sequence_pad, sequence_unpad, sequence_reverse,
     sequence_softmax, sequence_expand, edit_distance,

@@ -12,6 +12,7 @@ __all__ = [
     "hardshrink", "hardsigmoid", "hardswish", "hardtanh", "softplus",
     "softshrink", "softsign", "tanhshrink", "thresholded_relu", "maxout",
     "prelu", "rrelu", "glu", "gumbel_softmax", "log_sigmoid",
+    "relu_", "elu_", "softmax_", "tanh_",
 ]
 
 
@@ -240,3 +241,29 @@ def _gumbel_softmax(x, g, temperature=1.0, hard=False, axis=-1):
         # straight-through: hard value forward, soft gradient backward
         y = jax.lax.stop_gradient(y_hard - y) + y
     return y
+
+
+# --- inplace variants (reference nn/functional/activation.py relu_/...) ---
+
+def relu_(x, name=None):
+    from ...framework.core import inplace_apply
+
+    return inplace_apply(x, relu)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...framework.core import inplace_apply
+
+    return inplace_apply(x, elu, alpha=alpha)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...framework.core import inplace_apply
+
+    return inplace_apply(x, softmax, axis=axis, dtype=dtype)
+
+
+def tanh_(x, name=None):
+    from ...framework.core import inplace_apply
+
+    return inplace_apply(x, tanh)

@@ -1,0 +1,123 @@
+"""Extension ops: diag_embed / gather_tree / temporal_shift /
+sparse_attention (reference python/paddle/nn/functional/extension.py:30,
+fluid/layers/nn.py:13498,15107, nn/functional/sparse_attention.py:23).
+
+gather_tree is a reverse lax.scan (compiler-friendly backtrace);
+sparse_attention materializes the CSR layout as a dense additive mask —
+on TPU the masked dense matmul rides the MXU, which beats gather-based
+sparsity for the block patterns this API is used with.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op
+
+__all__ = ["diag_embed", "gather_tree", "temporal_shift", "sparse_attention"]
+
+
+def _diag_embed_impl(x, offset=0, dim1=-2, dim2=-1):
+    k = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (k, k), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    base = base.at[..., rows, cols].set(x)
+    nd = base.ndim
+    d1 = dim1 if dim1 >= 0 else dim1 + nd
+    d2 = dim2 if dim2 >= 0 else dim2 + nd
+    return jnp.moveaxis(base, (-2, -1), (d1, d2))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):  # noqa: A002
+    """Batched diagonal matrix from the last dim of ``input``."""
+    return apply_op(_diag_embed_impl, input, offset=int(offset),
+                    dim1=int(dim1), dim2=int(dim2), op_name="diag_embed")
+
+
+def _gather_tree_impl(ids, parents):
+    # ids/parents: [max_time, batch, beam]. Walk the search tree backwards,
+    # carrying the beam index each sequence occupies at step t+1.
+    T = ids.shape[0]
+    beams = jnp.arange(ids.shape[-1])
+
+    def step(carry_beam, xs):
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, carry_beam, axis=-1)
+        nxt = jnp.take_along_axis(step_parents, carry_beam, axis=-1)
+        return nxt, out
+
+    init = jnp.broadcast_to(beams, ids.shape[1:])
+    _, outs = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return outs[::-1]
+
+
+def gather_tree(ids, parents):
+    """Backtrace full beam-search sequences (reference
+    fluid/layers/nn.py:15107 gather_tree)."""
+    return apply_op(_gather_tree_impl, ids, parents, op_name="gather_tree")
+
+
+def _temporal_shift_impl(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    r = x.reshape(-1, seg_num, c, h, w)
+    pad = jnp.pad(r, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    s1 = pad[:, :seg_num, :c1]          # shift from t-1 (backward in time)
+    s2 = pad[:, 2:seg_num + 2, c1:c2]   # shift from t+1
+    s3 = pad[:, 1:seg_num + 1, c2:]     # unshifted
+    out = jnp.concatenate([s1, s2, s3], axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM channel shift along the segment (time) axis (reference
+    temporal_shift_op.cc)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError("data_format must be NCHW or NHWC")
+    return apply_op(_temporal_shift_impl, x, seg_num=int(seg_num),
+                    shift_ratio=float(shift_ratio), data_format=data_format,
+                    op_name="temporal_shift")
+
+
+def _masked_attention(q, k, v, mask):
+    d = q.shape[-1]
+    s = jnp.einsum("bhld,bhmd->bhlm", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    s = jnp.where(mask, s, jnp.asarray(-1e9, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask, p, jnp.asarray(0.0, p.dtype))
+    return jnp.einsum("bhlm,bhmd->bhld", p, v)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, name=None):
+    """CSR-masked attention (reference nn/functional/sparse_attention.py:23).
+
+    q/k/v: [B, H, L, D]; offset: [B, H, L+1]; columns: [B, H, nnz].
+    The CSR pattern is converted (host-side, it is data) into a dense
+    boolean mask and computed as one masked MXU matmul.
+    """
+    off = np.asarray(sparse_csr_offset.numpy()
+                     if isinstance(sparse_csr_offset, Tensor)
+                     else sparse_csr_offset, np.int64)
+    col = np.asarray(sparse_csr_columns.numpy()
+                     if isinstance(sparse_csr_columns, Tensor)
+                     else sparse_csr_columns, np.int64)
+    B, H, L, _ = query.shape
+    mask = np.zeros((B, H, L, L), bool)
+    for b in range(B):
+        for h in range(H):
+            counts = np.diff(off[b, h])
+            rows = np.repeat(np.arange(L), counts)
+            mask[b, h, rows, col[b, h, : len(rows)]] = True
+    return apply_op(_masked_attention, query, key, value,
+                    Tensor(jnp.asarray(mask)), op_name="sparse_attention")

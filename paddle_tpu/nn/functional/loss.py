@@ -14,7 +14,8 @@ __all__ = [
     "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
     "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
     "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
-    "npair_loss", "triplet_margin_loss",
+    "npair_loss", "triplet_margin_loss", "hsigmoid_loss",
+    "margin_cross_entropy",
 ]
 
 
@@ -398,3 +399,117 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e
                         swap=False, reduction="mean", name=None):
     return apply_op(_triplet_margin, input, positive, negative, margin=float(margin),
                     p_norm=float(p), eps=float(epsilon), swap=bool(swap), reduction=reduction)
+
+
+def _hsigmoid_default(x, label, w, b, num_classes, depth):
+    # default complete binary tree (reference math/matrix_bit_code.h
+    # SimpleCode:106: encoding of class c is c + num_classes, root id 1)
+    c = label.reshape(-1).astype(jnp.int32) + num_classes
+    length = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+    loss = jnp.zeros(c.shape, x.dtype)
+    for bit in range(depth):
+        idx = (c >> (bit + 1)) - 1                    # [N] node index
+        bitv = ((c >> bit) & 1).astype(x.dtype)       # [N] code bit
+        pre = jnp.sum(x * w[idx], axis=-1)
+        if b is not None:
+            pre = pre + b[idx]
+        # binary logistic loss with target = code bit
+        contrib = jax.nn.softplus(pre) - bitv * pre
+        loss = loss + jnp.where(bit < length, contrib, 0.0)
+    return loss[:, None]
+
+
+def _hsigmoid_custom(x, label, w, b, path_table, path_code):
+    idx = jnp.maximum(path_table, 0)
+    valid = path_table >= 0                            # [N, L]
+    pre = jnp.einsum("nd,nld->nl", x, w[idx])
+    if b is not None:
+        pre = pre + b[idx]
+    bitv = path_code.astype(x.dtype)
+    contrib = jax.nn.softplus(pre) - bitv * pre
+    return jnp.sum(jnp.where(valid, contrib, 0.0), axis=-1)[:, None]
+
+
+def _hsigmoid_default_op(x, lab, w, *rest, has_bias=False, num_classes=0,
+                         depth=0):
+    b = rest[0].reshape(-1) if has_bias else None
+    return _hsigmoid_default(x, lab, w, b, num_classes, depth)
+
+
+def _hsigmoid_custom_op(x, lab, w, *rest, has_bias=False):
+    b = rest[0].reshape(-1) if has_bias else None
+    return _hsigmoid_custom(x, lab, w, b, rest[-2], rest[-1])
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py:312,
+    hierarchical_sigmoid_op.cc). Default tree: complete binary tree over
+    num_classes; custom tree via path_table/path_code. ``is_sparse`` is
+    accepted and ignored — dense grads by design (see README LoD/
+    SelectedRows decision).
+
+    input [N, D]; label [N] or [N, 1]; weight [num_classes-1, D];
+    bias [num_classes-1] (or [num_classes-1, 1]). Returns [N, 1].
+    """
+    del is_sparse
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+
+    if path_table is not None or path_code is not None:
+        if path_table is None or path_code is None:
+            raise ValueError(
+                "hsigmoid_loss: path_table and path_code must be given "
+                "together for a custom tree")
+        return apply_op(_hsigmoid_custom_op, *args, path_table, path_code,
+                        has_bias=bias is not None, op_name="hsigmoid_loss")
+
+    if num_classes < 2:
+        raise ValueError("hsigmoid_loss: num_classes must be >= 2")
+    depth = int(2 * num_classes - 1).bit_length()
+    return apply_op(_hsigmoid_default_op, *args,
+                    has_bias=bias is not None, num_classes=int(num_classes),
+                    depth=depth, op_name="hsigmoid_loss")
+
+
+def _margin_ce(logits, label, m1, m2, m3, scale, reduction, return_softmax):
+    n, c = logits.shape
+    cos = jnp.clip(logits, -1.0, 1.0)
+    one_hot = jax.nn.one_hot(label.reshape(-1), c, dtype=logits.dtype)
+    theta = jnp.arccos(cos)
+    target_cos = jnp.cos(m1 * theta + m2) - m3
+    adjusted = jnp.where(one_hot > 0, target_cos, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(one_hot * logp, axis=-1, keepdims=True)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax CE (reference
+    nn/functional/loss.py:1101, margin_cross_entropy_op.cu).
+
+    ``group`` selects the model-parallel group that shards the class dim in
+    the reference. Here class-dim sharding is GSPMD's job: shard the logits
+    on the mesh "model" axis and the same code lowers with the cross-shard
+    collectives inserted by XLA. An explicit multi-rank eager group is not
+    supported.
+    """
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        raise ValueError(
+            "margin_cross_entropy: explicit eager groups are not supported; "
+            "shard the class dim on the mesh 'model' axis instead (GSPMD "
+            "inserts the collectives)")
+    return apply_op(_margin_ce, logits, label, m1=float(margin1),
+                    m2=float(margin2), m3=float(margin3), scale=float(scale),
+                    reduction=reduction, return_softmax=bool(return_softmax),
+                    op_name="margin_cross_entropy")

@@ -14,7 +14,7 @@ __all__ = [
     "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
     "avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
     "adaptive_max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
-    "adaptive_avg_pool3d",
+    "adaptive_avg_pool3d", "max_unpool2d",
 ]
 
 
@@ -235,3 +235,34 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     o = _ntuple(output_size, 3)
     o = tuple(x.shape[2 + i] if v is None else v for i, v in enumerate(o))
     return apply_op(_adaptive_pool, x, out_sizes=o, op="max")
+
+
+def _max_unpool2d_impl(x, indices, out_h, out_w):
+    n, c, ho, wo = x.shape
+    flat_x = x.reshape(n, c, ho * wo)
+    flat_i = indices.reshape(n, c, ho * wo).astype(jnp.int32)
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    bi = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    out = out.at[bi, ci, flat_i].set(flat_x)
+    return out.reshape(n, c, out_h, out_w)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Invert max_pool2d using the pooling indices (reference
+    nn/functional/pooling.py:667, unpool_op.cc). ``indices`` are the flat
+    H*W positions max_pool2d(return_mask=True) emits."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d only supports NCHW")
+    k = _ntuple(kernel_size, 2)
+    s = _ntuple(stride if stride is not None else kernel_size, 2)
+    p = _ntuple(padding, 2)
+    n, c, ho, wo = x.shape
+    if output_size is None:
+        out_h = (ho - 1) * s[0] - 2 * p[0] + k[0]
+        out_w = (wo - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        out_h, out_w = (int(v) for v in tuple(output_size)[-2:])
+    return apply_op(_max_unpool2d_impl, x, indices, out_h=int(out_h),
+                    out_w=int(out_w), op_name="max_unpool2d")

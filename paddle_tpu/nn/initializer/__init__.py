@@ -18,7 +18,8 @@ from ...framework.core import Tensor
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "Bilinear",
+    "set_global_initializer",
 ]
 
 
@@ -196,3 +197,44 @@ XavierInitializer = XavierUniform
 MSRAInitializer = KaimingNormal
 TruncatedNormalInitializer = TruncatedNormal
 NumpyArrayInitializer = Assign
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (reference
+    fluid/initializer.py BilinearInitializer): weight[..., y, x] =
+    (1-|x/f - c|)(1-|y/f - c|) with f = ceil(k/2), c = (2f-1-f%2)/(2f)."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        w = np.zeros(shape, np.float32)
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        kern = (1 - np.abs(xx / f_w - c_w)) * (1 - np.abs(yy / f_h - c_h))
+        w[:, :] = kern
+        return jnp.asarray(w, dtype=dtype)
+
+
+# --- global default-initializer override (reference
+#     nn/initializer/__init__.py set_global_initializer) -------------------
+
+_global_init = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the DEFAULT initializers used when a layer's ParamAttr does
+    not name one explicitly. Pass None to reset. Explicit ParamAttr
+    initializers always win, like the reference."""
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _global_init["weight"] = weight_init
+    _global_init["bias"] = bias_init
+
+
+def _global_default(is_bias):
+    return _global_init["bias" if is_bias else "weight"]

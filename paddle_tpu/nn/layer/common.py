@@ -12,7 +12,7 @@ __all__ = [
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
     "Flatten", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
     "CosineSimilarity", "Bilinear", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
-    "PixelShuffle", "PixelUnshuffle", "Identity", "Unfold", "Fold",
+    "PixelShuffle", "PixelUnshuffle", "Identity", "Unfold", "Fold", "PairwiseDistance",
 ]
 
 
@@ -251,3 +251,21 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """p-norm of x - y along the last dim (reference
+    nn/layer/distance.py PairwiseDistance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = float(p), float(epsilon), keepdim
+
+    def forward(self, x, y):
+        from ...tensor.linalg import norm
+
+        return norm(x - y + self.epsilon, p=self.p, axis=-1,
+                    keepdim=self.keepdim)
+
+    def extra_repr(self):
+        return f"p={self.p}"

@@ -56,6 +56,10 @@ class Layer:
         init = None
         if attr is not None and attr.initializer is not None:
             init = attr.initializer
+        elif I._global_default(is_bias) is not None:
+            # set_global_initializer overrides layer defaults (reference
+            # nn/initializer set_global_initializer semantics)
+            init = I._global_default(is_bias)
         elif default_initializer is not None:
             init = default_initializer
         else:

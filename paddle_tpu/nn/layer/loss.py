@@ -7,7 +7,7 @@ from .layers import Layer
 __all__ = [
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss", "MarginRankingLoss",
-    "HingeEmbeddingLoss", "CosineEmbeddingLoss", "CTCLoss", "TripletMarginLoss",
+    "HingeEmbeddingLoss", "CosineEmbeddingLoss", "CTCLoss", "TripletMarginLoss", "HSigmoidLoss",
 ]
 
 
@@ -149,3 +149,30 @@ class TripletMarginLoss(Layer):
     def forward(self, input, positive, negative):  # noqa: A002
         m, p, e, s, r = self.args
         return F.triplet_margin_loss(input, positive, negative, m, p, e, s, r)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss): owns the [num_classes-1, feature_size] node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        from .. import initializer as I
+
+        self.weight = self.create_parameter(
+            shape=[num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            shape=[num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        if self._is_custom and (path_table is None or path_code is None):
+            raise ValueError("custom tree requires path_table and path_code")
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)

@@ -1,0 +1,21 @@
+"""paddle.onnx surface (reference python/paddle/onnx/export.py).
+
+DECISION: the reference delegates to the external paddle2onnx package; this
+environment is zero-egress and ships no onnx runtime, so export raises with
+a pointer to the native serving path. The framework's own deployment format
+is the versioned StableHLO artifact (static/export.py) served by
+inference.Predictor — strictly more capable on TPU than an ONNX detour.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "onnx export requires the external paddle2onnx package, which "
+            "is not available in this environment; use paddle.jit.save + "
+            "inference.Predictor (versioned StableHLO) for deployment"
+        ) from None

@@ -198,6 +198,8 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
         if name is None:
             name = attr.name
     if init is None:
+        init = I._global_default(is_bias)
+    if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierUniform()
     dt = _dt(dtype)
     data = init(tuple(int(s) for s in shape), dt)

@@ -21,7 +21,7 @@ __all__ = [
     "strided_slice", "tensordot", "as_real", "as_complex", "crop", "pad",
     "index_sample", "index_add", "tolist", "split_sections", "shape",
     "rank", "reverse", "scatter_nd", "shard_index", "reshape_",
-    "squeeze_", "unsqueeze_", "scatter_",
+    "squeeze_", "unsqueeze_", "scatter_", "broadcast_shape",
 ]
 
 
@@ -633,3 +633,9 @@ def unsqueeze_(x, axis, name=None):
 def scatter_(x, index, updates, overwrite=True, name=None):
     return inplace_apply(x, scatter, index, updates, overwrite=overwrite,
                          name=name)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Broadcast result shape of two shapes (reference paddle.broadcast_shape)."""
+    return list(jnp.broadcast_shapes(tuple(int(s) for s in x_shape),
+                                     tuple(int(s) for s in y_shape)))

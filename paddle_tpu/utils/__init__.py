@@ -4,7 +4,7 @@ from __future__ import annotations
 from . import download  # noqa: F401
 from .download import get_weights_path_from_url  # noqa: F401
 
-__all__ = ["deprecated", "try_import", "download",
+__all__ = ["deprecated", "try_import", "download", "require_version",
            "get_weights_path_from_url", "unique_name", "install_check"]
 
 
@@ -52,3 +52,27 @@ def install_check():
 
 def run_check():
     install_check()
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is within range (reference
+    utils/install_check.py require_version)."""
+    from .. import __version__
+
+    def _key(v):
+        parts = []
+        for p in str(v).split(".")[:3]:
+            digits = "".join(ch for ch in p if ch.isdigit())
+            parts.append(int(digits) if digits else 0)
+        while len(parts) < 3:  # pad so '0.1' == '0.1.0', like the reference
+            parts.append(0)
+        return tuple(parts)
+
+    if _key(__version__) < _key(min_version):
+        raise Exception(
+            "installed version %s is below required %s"
+            % (__version__, min_version))
+    if max_version is not None and _key(__version__) > _key(max_version):
+        raise Exception(
+            "installed version %s is above supported %s"
+            % (__version__, max_version))

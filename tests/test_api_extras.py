@@ -1,0 +1,341 @@
+"""Round-4 API-surface batch: hsigmoid/margin CE, extension ops,
+max_unpool2d, distributions, initializer globals, jit wrappers, dataset
+shims (reference python/paddle/nn/functional/{loss,extension}.py,
+distribution.py, fleet/dataset/dataset.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import initializer as I
+
+RNG = np.random.default_rng(11)
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+def _hsigmoid_numpy(x, label, w, b, num_classes):
+    """Straight transcription of SimpleCode (matrix_bit_code.h:106)."""
+    out = np.zeros((len(x), 1), np.float64)
+    for n in range(len(x)):
+        c = int(label[n]) + num_classes
+        length = c.bit_length() - 1
+        for bit in range(length):
+            idx = (c >> (bit + 1)) - 1
+            bitv = (c >> bit) & 1
+            pre = float(x[n] @ w[idx] + (b[idx] if b is not None else 0.0))
+            out[n, 0] += np.log1p(np.exp(pre)) - bitv * pre
+    return out
+
+
+class TestHSigmoid:
+    def test_matches_bitcode_numpy(self):
+        x = RNG.standard_normal((5, 6)).astype(np.float32)
+        lab = np.array([0, 3, 6, 2, 5])
+        w = RNG.standard_normal((6, 6)).astype(np.float32) * 0.3
+        b = RNG.standard_normal((6,)).astype(np.float32) * 0.1
+        got = F.hsigmoid_loss(_t(x), _t(lab), 7, _t(w), _t(b)).numpy()
+        want = _hsigmoid_numpy(x, lab, w, b, 7)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_custom_tree_and_grad(self):
+        x = _t(RNG.standard_normal((3, 4)).astype(np.float32))
+        x.stop_gradient = False
+        lab = _t(np.array([0, 1, 2]))
+        w = _t(RNG.standard_normal((5, 4)).astype(np.float32) * 0.2)
+        pt = _t(np.array([[0, 1, -1], [2, 3, 4], [0, -1, -1]]))
+        pc = _t(np.array([[1, 0, 0], [0, 1, 1], [0, 0, 0]]))
+        loss = F.hsigmoid_loss(x, lab, 4, w, path_table=pt, path_code=pc)
+        assert loss.shape == [3, 1]
+        paddle.sum(loss).backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_layer(self):
+        layer = paddle.nn.HSigmoidLoss(6, 8)
+        out = layer(_t(RNG.standard_normal((4, 6)).astype(np.float32)),
+                    _t(np.array([1, 0, 7, 3])))
+        assert out.shape == [4, 1]
+
+
+class TestMarginCE:
+    def test_reduces_to_plain_ce_with_no_margin(self):
+        logits = (RNG.random((6, 10)).astype(np.float32) - 0.5) * 2
+        lab = np.array([0, 3, 9, 1, 2, 7])
+        got = float(F.margin_cross_entropy(_t(logits), _t(lab), margin1=1.0,
+                                           margin2=0.0, margin3=0.0,
+                                           scale=1.0))
+        # plain CE on clipped logits
+        z = np.clip(logits, -1, 1)
+        p = z - z.max(-1, keepdims=True)
+        logp = p - np.log(np.exp(p).sum(-1, keepdims=True))
+        want = -logp[np.arange(6), lab].mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_margin_increases_loss_and_softmax_shape(self):
+        logits = (RNG.random((4, 8)).astype(np.float32) - 0.5) * 2
+        lab = np.array([1, 2, 3, 4])
+        base = float(F.margin_cross_entropy(_t(logits), _t(lab), margin2=0.0,
+                                            margin3=0.0))
+        hard = float(F.margin_cross_entropy(_t(logits), _t(lab), margin2=0.5,
+                                            margin3=0.0))
+        assert hard > base
+        loss, sm = F.margin_cross_entropy(_t(logits), _t(lab),
+                                          return_softmax=True,
+                                          reduction="none")
+        assert loss.shape == [4, 1] and sm.shape == [4, 8]
+
+    def test_eager_group_rejected(self):
+        class G:
+            nranks = 2
+
+        with pytest.raises(ValueError, match="GSPMD"):
+            F.margin_cross_entropy(_t(np.ones((2, 4), np.float32)),
+                                   _t(np.array([0, 1])), group=G())
+
+
+class TestExtensionOps:
+    def test_temporal_shift_matches_reference_numpy(self):
+        x = RNG.random((6, 4, 3, 3)).astype(np.float32)
+        got = F.temporal_shift(_t(x), seg_num=3, shift_ratio=0.25).numpy()
+        # reference test_temporal_shift_op.py golden
+        r = x.reshape((-1, 3, 4, 3, 3))
+        pad = np.pad(r, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        c1, c2 = 1, 2
+        want = np.concatenate(
+            [pad[:, :3, :c1], pad[:, 2:5, c1:c2], pad[:, 1:4, c2:]],
+            axis=2).reshape(x.shape)
+        np.testing.assert_allclose(got, want)
+
+    def test_gather_tree_reference_example(self):
+        ids = _t(np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                           [[0, 1], [9, 0]]]))
+        parents = _t(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                               [[0, 0], [0, 1]]]))
+        want = [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]]
+        assert F.gather_tree(ids, parents).numpy().tolist() == want
+
+    def test_diag_embed(self):
+        x = RNG.random((2, 3)).astype(np.float32)
+        got = F.diag_embed(_t(x)).numpy()
+        want = np.stack([np.diag(r) for r in x])
+        np.testing.assert_allclose(got, want)
+        off = F.diag_embed(_t(x), offset=1).numpy()
+        assert off.shape == (2, 4, 4)
+        np.testing.assert_allclose(np.diagonal(off, 1, -2, -1), x)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = RNG.random((2, 3, 6, 6)).astype(np.float32)
+        pooled, idx = F.max_pool2d(_t(x), 2, 2, return_mask=True)
+        up = F.max_unpool2d(pooled, idx, 2).numpy()
+        assert up.shape == x.shape
+        # every pooled max lands back at its argmax position
+        np.testing.assert_allclose(up.max(axis=(2, 3)),
+                                   pooled.numpy().max(axis=(2, 3)))
+        assert (np.count_nonzero(up, axis=(2, 3)) <= 9).all()
+
+    def test_sparse_attention_matches_masked_dense(self):
+        B, H, L, D = 1, 2, 4, 8
+        q = RNG.random((B, H, L, D)).astype(np.float32)
+        k = RNG.random((B, H, L, D)).astype(np.float32)
+        v = RNG.random((B, H, L, D)).astype(np.float32)
+        # banded pattern: each row attends to itself and its left neighbor
+        cols, offs = [], [0]
+        for i in range(L):
+            row = [max(i - 1, 0), i] if i else [0]
+            cols += row
+            offs.append(len(cols))
+        off = np.tile(np.asarray(offs, np.int64), (B, H, 1))
+        col = np.tile(np.asarray(cols, np.int64), (B, H, 1))
+        got = F.sparse_attention(_t(q), _t(k), _t(v), _t(off), _t(col)).numpy()
+        mask = np.zeros((L, L), bool)
+        for i in range(L):
+            mask[i, max(i - 1, 0)] = True
+            mask[i, i] = True
+        s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+        s = np.where(mask, s, -1e9)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, p @ v, rtol=1e-4, atol=1e-5)
+
+
+class TestDistributions:
+    def test_normal_logprob_entropy_kl(self):
+        from paddle_tpu.distribution import Normal
+
+        n = Normal(1.0, 2.0)
+        v = np.array([0.5, 3.0], np.float32)
+        want = -((v - 1) ** 2) / 8 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(n.log_prob(_t(v)).numpy(), want, rtol=1e-5)
+        np.testing.assert_allclose(float(n.entropy()),
+                                   0.5 + 0.5 * np.log(2 * np.pi) + np.log(2),
+                                   rtol=1e-5)
+        assert float(n.kl_divergence(Normal(1.0, 2.0))) == pytest.approx(0.0)
+        assert float(n.kl_divergence(Normal(0.0, 1.0))) > 0
+        paddle.seed(3)
+        s = n.sample([5000]).numpy()
+        assert abs(s.mean() - 1.0) < 0.15 and abs(s.std() - 2.0) < 0.15
+
+    def test_uniform_and_categorical(self):
+        from paddle_tpu.distribution import Categorical, Uniform
+
+        u = Uniform(1.0, 3.0)
+        np.testing.assert_allclose(u.probs(_t(np.array([2.0]))).numpy(), 0.5)
+        assert float(u.entropy()) == pytest.approx(np.log(2), rel=1e-5)
+        c = Categorical(_t(np.array([1.0, 1.0, 2.0], np.float32)))
+        p = np.exp([1, 1, 2]) / np.exp([1, 1, 2]).sum()
+        np.testing.assert_allclose(
+            c.probs(_t(np.array([0, 2]))).numpy(), p[[0, 2]], rtol=1e-5)
+        np.testing.assert_allclose(float(c.entropy()),
+                                   -(p * np.log(p)).sum(), rtol=1e-5)
+        assert c.sample([7]).shape == [7]
+
+
+class TestInitializerExtras:
+    def test_bilinear_kernel(self):
+        w = np.asarray(I.Bilinear()((1, 1, 4, 4), np.float32))
+        np.testing.assert_allclose(w[0, 0, 0],
+                                   [0.0625, 0.1875, 0.1875, 0.0625])
+        np.testing.assert_allclose(w[0, 0].sum(), 4.0, rtol=1e-5)
+
+    def test_set_global_initializer(self):
+        I.set_global_initializer(I.Constant(0.25), I.Constant(-1.0))
+        try:
+            lin = paddle.nn.Linear(4, 2)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.25)
+            np.testing.assert_allclose(lin.bias.numpy(), -1.0)
+            # explicit ParamAttr initializer still wins
+            lin2 = paddle.nn.Linear(
+                4, 2, weight_attr=paddle.ParamAttr(
+                    initializer=I.Constant(9.0)))
+            np.testing.assert_allclose(lin2.weight.numpy(), 9.0)
+        finally:
+            I.set_global_initializer(None)
+        lin3 = paddle.nn.Linear(4, 2)
+        assert not np.allclose(lin3.weight.numpy(), 0.25)
+
+
+class TestJitWrappers:
+    def test_traced_layer_roundtrip(self, tmp_path):
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 3), paddle.nn.ReLU())
+        x = _t(RNG.random((2, 4)).astype(np.float32))
+        out, traced = paddle.jit.TracedLayer.trace(net, [x])
+        np.testing.assert_allclose(traced(x).numpy(), out.numpy())
+        prefix = str(tmp_path / "traced")
+        traced.save_inference_model(prefix)
+        loaded = paddle.jit.load(prefix)
+        assert isinstance(loaded, paddle.jit.TranslatedLayer)
+        np.testing.assert_allclose(loaded(x).numpy(), out.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_program_translator_singleton(self):
+        pt = paddle.jit.ProgramTranslator.get_instance()
+        assert pt is paddle.jit.ProgramTranslator.get_instance()
+        pt.enable(False)
+        assert not pt.enable_to_static
+        pt.enable(True)
+
+
+class TestDatasetShims:
+    def test_in_memory_dataset(self, tmp_path):
+        f = tmp_path / "part-0"
+        f.write_text("\n".join(str(i) for i in range(10)))
+        ds = paddle.distributed.InMemoryDataset()
+        ds.init(batch_size=4)
+        ds.parse_fn = int
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        paddle.seed(0)
+        ds.local_shuffle()
+        batches = list(ds)
+        assert [len(b) for b in batches] == [4, 4, 2]
+        assert sorted(sum(batches, [])) == list(range(10))
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_pipe_command(self, tmp_path):
+        f = tmp_path / "data.txt"
+        f.write_text("a\nb\nc\n")
+        ds = paddle.distributed.QueueDataset()
+        ds.init(batch_size=2, pipe_command="tr a-z A-Z")
+        ds.set_filelist([str(f)])
+        assert list(ds) == [["A", "B"], ["C"]]
+
+    def test_entries(self):
+        assert paddle.distributed.ProbabilityEntry(0.5)._to_attr() \
+            .startswith("probability_entry")
+        assert paddle.distributed.CountFilterEntry(3)._to_attr() \
+            == "count_filter_entry:3"
+        with pytest.raises(ValueError):
+            paddle.distributed.ProbabilityEntry(0)
+
+
+class TestMiscParity:
+    def test_require_version(self):
+        paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception, match="below"):
+            paddle.utils.require_version("99.0.0")
+
+    def test_onnx_gated(self):
+        with pytest.raises(RuntimeError, match="paddle2onnx"):
+            paddle.onnx.export(None, "x")
+
+    def test_functional_inplace(self):
+        x = _t(np.array([-1.0, 2.0], np.float32))
+        y = x * 1.0
+        F.relu_(y)
+        np.testing.assert_allclose(y.numpy(), [0.0, 2.0])
+        z = x * 1.0
+        F.softmax_(z)
+        np.testing.assert_allclose(z.numpy().sum(), 1.0, rtol=1e-6)
+
+    def test_pairwise_distance(self):
+        pd = paddle.nn.PairwiseDistance(p=2.0)
+        a = RNG.random((3, 5)).astype(np.float32)
+        b = RNG.random((3, 5)).astype(np.float32)
+        got = pd(_t(a), _t(b)).numpy()
+        want = np.linalg.norm(a - b + 1e-6, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+class TestReviewRegressions:
+    def test_categorical_batched_probs(self):
+        from paddle_tpu.distribution import Categorical
+
+        c = Categorical(_t(np.array([[1., 2., 3.], [3., 2., 1.]],
+                                    np.float32)))
+        p = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+        got = c.probs(_t(np.array([0, 1]))).numpy()
+        np.testing.assert_allclose(got, [p[0], p[1]], rtol=1e-5)
+        assert c.log_prob(_t(np.array([2, 0]))).shape == [2]
+
+    def test_program_translator_eager_fallback(self):
+        hits = []
+
+        @paddle.jit.to_static
+        def f(x):
+            hits.append(1)
+            return x * 2
+
+        pt = paddle.jit.ProgramTranslator.get_instance()
+        pt.enable(False)
+        try:
+            out = f(_t(np.array([3.0], np.float32)))
+            assert hits, "original python body should run eagerly"
+            np.testing.assert_allclose(out.numpy(), [6.0])
+        finally:
+            pt.enable(True)
+
+    def test_create_parameter_honors_global_init(self):
+        I.set_global_initializer(I.Constant(1.0))
+        try:
+            p = paddle.create_parameter([2, 2], "float32")
+            np.testing.assert_allclose(p.numpy(), 1.0)
+        finally:
+            I.set_global_initializer(None)
+
+    def test_require_version_pads_components(self):
+        paddle.utils.require_version("0.1", "0.1")  # 0.1.0 is inside [0.1,0.1]
