@@ -127,7 +127,9 @@ class Categorical(Distribution):
         shape = tuple(int(s) for s in shape)
         idx = jax.random.categorical(key, jnp.log(p),
                                      shape=shape + p.shape[:-1])
-        return Tensor(idx.astype(jnp.int64))
+        # leave the native integer dtype: an int64 astype under the default
+        # x64-disabled config only emits a truncation warning
+        return Tensor(idx)
 
     def probs(self, value):
         p = self._p()

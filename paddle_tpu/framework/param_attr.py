@@ -38,3 +38,16 @@ class ParamAttr:
             return ParamAttr(name=attr)
         # assume an initializer instance
         return ParamAttr(initializer=attr)
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference WeightNormParamAttr (fluid/param_attr.py:216): requests
+    the weight-norm reparameterization (g * v/||v||). DECISION: the
+    static-graph reparameterization is served by the dygraph hook API
+    (nn.utils.weight_norm); parameter creation with this attr raises and
+    directs users there rather than silently training unnormalized.
+    """
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.weight_norm_dim = dim

@@ -13,5 +13,8 @@ from .extension import *  # noqa: F401,F403
 from . import activation, common, conv, pooling, norm, loss, extension  # noqa: F401
 from .sequence import (  # noqa: F401
     sequence_mask, sequence_pad, sequence_unpad, sequence_reverse,
-    sequence_softmax, sequence_expand, edit_distance,
+    sequence_softmax, sequence_expand, edit_distance, sequence_pool,
+    sequence_first_step, sequence_last_step, sequence_concat,
+    sequence_enumerate, sequence_expand_as, sequence_conv,
+    sequence_reshape, sequence_scatter, sequence_slice,
 )

@@ -405,7 +405,11 @@ def _hsigmoid_default(x, label, w, b, num_classes, depth):
     # default complete binary tree (reference math/matrix_bit_code.h
     # SimpleCode:106: encoding of class c is c + num_classes, root id 1)
     c = label.reshape(-1).astype(jnp.int32) + num_classes
-    length = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+    # exact integer floor(log2(c)): count the shifts that stay non-zero
+    # (float32 log2 rounds up near 2^24, wrapping the top-bit weight index)
+    length = jnp.zeros(c.shape, jnp.int32)
+    for j in range(1, depth + 1):
+        length = length + ((c >> j) > 0).astype(jnp.int32)
     loss = jnp.zeros(c.shape, x.dtype)
     for bit in range(depth):
         idx = (c >> (bit + 1)) - 1                    # [N] node index

@@ -27,7 +27,10 @@ from ...framework.core import Tensor, apply_op
 
 __all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_reverse", "sequence_softmax", "sequence_expand",
-           "edit_distance"]
+           "edit_distance", "sequence_pool", "sequence_first_step",
+           "sequence_last_step", "sequence_concat", "sequence_enumerate",
+           "sequence_expand_as", "sequence_conv", "sequence_reshape",
+           "sequence_scatter", "sequence_slice"]
 
 
 def _mask(lengths, maxlen, dtype):
@@ -202,3 +205,182 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
         dist = Tensor(dist._data / denom)
     seq_num = Tensor(jnp.asarray([B], jnp.int64))
     return dist, seq_num
+
+
+def _seq_time_mask(x, lengths):
+    t = x.shape[1]
+    m = jnp.arange(t)[None, :] < lengths.reshape(-1, 1)
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+def _seq_pool(x, lengths, pool_type="sum"):
+    mask = _seq_time_mask(x, lengths).astype(x.dtype)
+    L = lengths.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype)
+    if pool_type == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if pool_type == "average":
+        return jnp.sum(x * mask, axis=1) / jnp.maximum(L, 1)
+    if pool_type == "sqrt":
+        return jnp.sum(x * mask, axis=1) / jnp.sqrt(jnp.maximum(L, 1))
+    if pool_type == "max":
+        neg = jnp.where(mask > 0, x, jnp.asarray(-1e30, x.dtype))
+        return jnp.max(neg, axis=1)
+    if pool_type == "first":
+        return x[:, 0]
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+        idx = idx.reshape((-1, 1) + (1,) * (x.ndim - 2))
+        return jnp.take_along_axis(x, jnp.broadcast_to(
+            idx, (x.shape[0], 1) + x.shape[2:]), axis=1)[:, 0]
+    raise ValueError("unknown pool_type %r" % (pool_type,))
+
+
+def sequence_pool(x, lengths, pool_type="sum", name=None):
+    """Per-sequence pooling over time (reference sequence_pool_op.cc:
+    sum/average/sqrt/max/first/last on the valid steps)."""
+    return apply_op(_seq_pool, x, lengths, pool_type=str(pool_type).lower(),
+                    op_name="sequence_pool")
+
+
+def sequence_first_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "first", name=name)
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "last", name=name)
+
+
+def sequence_concat(inputs, lengths_list, name=None):
+    """Concatenate sequences per batch row (reference sequence_concat_op):
+    inputs [Bi, Ti, ...] all same B; output padded to sum of max lengths,
+    rows packed valid-head-first. Host-resolved lengths (static shapes)."""
+    arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+            for i in inputs]
+    lens = [np.asarray(l._data if isinstance(l, Tensor) else l, np.int64)
+            for l in lengths_list]
+    B = arrs[0].shape[0]
+    total = np.sum([l for l in lens], axis=0)
+    T = int(total.max())
+    rows, out_lens = [], []
+    for b in range(B):
+        parts = [a[b, : int(l[b])] for a, l in zip(arrs, lens)]
+        row = jnp.concatenate(parts, axis=0)
+        pad = [(0, T - row.shape[0])] + [(0, 0)] * (row.ndim - 1)
+        rows.append(jnp.pad(row, pad))
+        out_lens.append(int(total[b]))
+    return (Tensor(jnp.stack(rows)),
+            Tensor(jnp.asarray(np.asarray(out_lens, np.int64))))
+
+
+def _seq_enumerate(x, win_size, pad_value):
+    b, t = x.shape
+    idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+    valid = idx < t
+    g = x[:, jnp.minimum(idx, t - 1)]
+    return jnp.where(valid[None], g, jnp.asarray(pad_value, x.dtype))
+
+
+def sequence_enumerate(x, win_size, pad_value=0, name=None):
+    """All-window enumeration of an id sequence [B, T] -> [B, T, win]
+    (reference sequence_enumerate_op)."""
+    return apply_op(_seq_enumerate, x, win_size=int(win_size),
+                    pad_value=int(pad_value), op_name="sequence_enumerate")
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """Expand each row i to y_lengths[i] copies (reference
+    sequence_expand_as_op; ref_level fixed at the row level)."""
+    return sequence_expand(x, y_lengths, name=name)
+
+
+def _seq_conv(x, lengths, w, context_start):
+    # x [B,T,D]; w [ctx*D, F]; zero outside the valid window, like the
+    # reference's im2col over LoD rows (sequence_conv_op.h ContextProject)
+    B, T, D = x.shape
+    ctx = w.shape[0] // D
+    mask = _seq_time_mask(x, lengths).astype(x.dtype)
+    xm = x * mask
+    cols = []
+    for k in range(ctx):
+        off = context_start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        t_idx = jnp.arange(T) + off
+        ok = ((t_idx >= 0) & (t_idx < T))[None, :, None]
+        cols.append(jnp.where(ok, shifted, 0.0))
+    stacked = jnp.concatenate(cols, axis=-1)          # [B,T,ctx*D]
+    out = jnp.einsum("btc,cf->btf", stacked, w)
+    return out * mask
+
+
+def sequence_conv(x, lengths, weight, context_start=None, padding=True,
+                  name=None):
+    """Context-window sequence convolution (reference sequence_conv_op):
+    weight [filter_size*D, num_filters]; default context centered."""
+    D = x.shape[-1]
+    ctx = weight.shape[0] // D
+    if context_start is None:
+        context_start = -(ctx // 2)
+    return apply_op(_seq_conv, x, lengths, weight,
+                    context_start=int(context_start), op_name="sequence_conv")
+
+
+def _seq_reshape(x, lengths, new_dim):
+    B, T, D = x.shape
+    if (T * D) % new_dim:
+        raise ValueError("T*D must be divisible by new_dim")
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    new_len = lengths * D // new_dim
+    return out, new_len
+
+
+def sequence_reshape(x, lengths, new_dim, name=None):
+    """Re-chunk each sequence's flattened payload to rows of new_dim
+    (reference sequence_reshape_op; lengths scale by D/new_dim)."""
+    return apply_op(_seq_reshape, x, lengths, new_dim=int(new_dim),
+                    op_name="sequence_reshape")
+
+
+def _seq_scatter(x, index, updates, lengths):
+    # x [N,D] or [N]; per row b of index/updates, set x[index[b,j]] for the
+    # first lengths[b] entries (reference sequence_scatter_op: out[ids] +=
+    # updates - with LoD rows flattened; duplicates take the update sum)
+    B, L = index.shape[:2]
+    mask = jnp.arange(L)[None, :] < lengths.reshape(-1, 1)
+    flat_idx = jnp.where(mask, index, x.shape[0]).reshape(-1)
+    upd = (updates * mask.reshape(mask.shape + (1,) * (updates.ndim - 2))
+           ).reshape((-1,) + updates.shape[2:])
+    grown = jnp.concatenate(
+        [x, jnp.zeros((1,) + x.shape[1:], x.dtype)], axis=0)
+    out = grown.at[flat_idx].add(upd)
+    return out[:-1]
+
+
+def sequence_scatter(x, index, updates, lengths, name=None):
+    """Scatter-add per-sequence updates into x (reference
+    sequence_scatter_op with the padded-dense layout: index/updates
+    [B, L(, D)] + lengths [B])."""
+    return apply_op(_seq_scatter, x, index, updates, lengths,
+                    op_name="sequence_scatter")
+
+
+def _seq_slice(x, offset, length, out_t):
+    B, T = x.shape[0], x.shape[1]
+    t_idx = jnp.arange(out_t)[None, :] + offset.reshape(-1, 1)  # [B,out_t]
+    valid = t_idx < (offset + length).reshape(-1, 1)
+    g_idx = jnp.clip(t_idx, 0, T - 1).astype(jnp.int32)
+    g_idx = g_idx.reshape(g_idx.shape + (1,) * (x.ndim - 2))
+    g = jnp.take_along_axis(
+        x, jnp.broadcast_to(g_idx, (B, out_t) + x.shape[2:]), axis=1)
+    return jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)), g, 0)
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-sequence [offset, offset+length) slice (reference
+    sequence_slice_op). Output time dim = max(length); returns
+    (sliced, new_lengths=length)."""
+    larr = np.asarray(length._data if isinstance(length, Tensor) else length)
+    out_t = int(larr.max()) if larr.size else 0
+    out = apply_op(_seq_slice, x, offset, length, out_t=out_t,
+                   op_name="sequence_slice")
+    return out, (length if isinstance(length, Tensor)
+                 else Tensor(jnp.asarray(larr)))

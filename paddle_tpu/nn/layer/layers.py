@@ -53,6 +53,11 @@ class Layer:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
+        if getattr(attr, "weight_norm_dim", None) is not None:
+            raise NotImplementedError(
+                "WeightNormParamAttr: apply nn.utils.weight_norm(layer) "
+                "instead — the g*v/||v|| reparameterization is a layer "
+                "hook here, not a parameter attribute")
         init = None
         if attr is not None and attr.initializer is not None:
             init = attr.initializer

@@ -11,11 +11,8 @@ __all__ = ["export"]
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import paddle2onnx  # noqa: F401
-    except ImportError:
-        raise RuntimeError(
-            "onnx export requires the external paddle2onnx package, which "
-            "is not available in this environment; use paddle.jit.save + "
-            "inference.Predictor (versioned StableHLO) for deployment"
-        ) from None
+    raise RuntimeError(
+        "onnx export is not supported by this framework (the reference "
+        "delegates to the external paddle2onnx converter, which cannot "
+        "translate this runtime's programs); use paddle.jit.save + "
+        "inference.Predictor (versioned StableHLO) for deployment")

@@ -28,9 +28,11 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Parameter, Tensor
+from ..framework.param_attr import ParamAttr
 from ..jit import InputSpec  # noqa: F401
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 from . import nn  # noqa: F401
@@ -44,7 +46,14 @@ __all__ = [
     "name_scope", "device_guard", "py_func", "save_inference_model",
     "load_inference_model", "gradients", "append_backward", "nn",
     "cond", "while_loop", "BuildStrategy", "ExecutionStrategy", "ParallelEnv",
-    "Block", "Operator", "Variable",
+    "Block", "Operator", "Variable", "ExponentialMovingAverage",
+    "ParallelExecutor", "Print", "WeightNormParamAttr", "accuracy", "auc",
+    "cpu_places", "cuda_places", "xpu_places", "npu_places", "Scope",
+    "create_global_var", "create_parameter", "global_scope", "scope_guard",
+    "load", "save", "load_from_file", "save_to_file", "load_program_state",
+    "set_program_state", "normalize_program", "serialize_program",
+    "serialize_persistables", "deserialize_program",
+    "deserialize_persistables",
 ]
 
 _static_mode = [False]
@@ -313,7 +322,10 @@ def device_guard(device=None):
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError("py_func: wrap python code with jax.pure_callback instead")
+    """Host-python op; see static.nn.py_func (jax.pure_callback)."""
+    from .nn import py_func as _py_func
+
+    return _py_func(func, x, out, backward_func, skip_vars_in_backward_input)
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None):
@@ -738,3 +750,295 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..framework.core import grad as _grad
 
     return _grad(targets, inputs, target_gradients, allow_unused=True)
+
+
+# ---------------------------------------------------------------------------
+# places / scope / program-state / serialization surface
+# (reference python/paddle/static/__init__.py remaining exports)
+# ---------------------------------------------------------------------------
+
+from ..tensor.creation import create_parameter  # noqa: F401,E402
+from ..optimizer.optimizer import ExponentialMovingAverage  # noqa: F401,E402
+from ..metric import accuracy  # noqa: F401,E402
+
+
+def cpu_places(device_count=None):
+    """List of CPUPlaces (reference framework.py cpu_places); count
+    defaults to CPU_NUM=1 like the reference under a TPU runtime."""
+    from ..device import CPUPlace
+
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places. On this runtime the accelerators are TPU chips:
+    returns one place per visible jax device (reference cuda_places
+    semantics transposed to the TPU fleet)."""
+    import jax
+
+    from ..device import TPUPlace
+
+    devs = jax.devices()
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [TPUPlace(int(i)) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class Scope:
+    """name → Tensor registry (reference framework/scope.h:52). The traced
+    program captures tensors directly, so the scope is bookkeeping for
+    save/load parity, not the execution store."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        from ..framework.core import Tensor
+
+        if name not in self._vars:
+            self._vars[name] = Tensor(jnp.zeros((), jnp.float32), name=name)
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set_var(self, name, t):
+        self._vars[name] = t
+
+
+_global_scope = [Scope()]
+
+
+def global_scope():
+    return _global_scope[-1]
+
+
+@contextmanager
+def scope_guard(scope):
+    _global_scope.append(scope)
+    try:
+        yield
+    finally:
+        _global_scope.pop()
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Persistable global variable (reference layers/tensor.py
+    create_global_var); registered in the global scope by name."""
+    from ..framework import dtype as dtypes
+    from ..framework.core import Tensor
+
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        dtypes.convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    if name:
+        global_scope().set_var(name, t)
+    return t
+
+
+def _print_impl(x, message, summarize):
+    jax.debug.print((message + " {}") if message else "{}", x)
+    return x + 0 if jnp.issubdtype(x.dtype, jnp.number) else x
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Debug print op (reference controlflow/print_op.cc): prints the
+    tensor when the op executes (jax.debug.print inside jit) and passes
+    the value through."""
+    from ..framework.core import apply_op
+
+    return apply_op(_print_impl, input, message=message or "",
+                    summarize=int(summarize), op_name="Print")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
+        topk=1, slide_steps=1, ins_tag_weight=None):
+    """Batch AUC by threshold histogram (reference metrics/auc_op.cc —
+    same bucketed trapezoid estimate). Returns (auc, batch_auc, states)
+    with states = (tp, fp, tn, fn) histograms, like the reference's
+    stat outputs."""
+    from ..framework.core import apply_op
+
+    def _auc(scores, lab, num_thresholds, curve):
+        pos_score = scores[:, 1] if scores.ndim == 2 else scores.reshape(-1)
+        lab = lab.reshape(-1)
+        bins = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0,
+                        num_thresholds)
+        pos = jnp.zeros(num_thresholds + 1).at[bins].add(lab == 1)
+        neg = jnp.zeros(num_thresholds + 1).at[bins].add(lab == 0)
+        # cumulative from the highest threshold down
+        tp = jnp.cumsum(pos[::-1])[::-1]
+        fp = jnp.cumsum(neg[::-1])[::-1]
+        tot_pos, tot_neg = tp[0], fp[0]
+        tpr = tp / jnp.maximum(tot_pos, 1)
+        if curve == "PR":
+            precision = tp / jnp.maximum(tp + fp, 1)
+            a = jnp.trapezoid(precision[::-1], tpr[::-1])
+        else:
+            fpr = fp / jnp.maximum(tot_neg, 1)
+            a = jnp.trapezoid(tpr[::-1], fpr[::-1])
+        return a, a, tp, fp, tot_neg - fp, tot_pos - tp
+
+    if curve not in ("ROC", "PR"):
+        raise ValueError("curve must be 'ROC' or 'PR'")
+    out = apply_op(_auc, input, label, num_thresholds=int(num_thresholds),
+                   curve=curve, op_name="auc")
+    return out[0], out[1], tuple(out[2:])
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist a program's parameters + buffers (reference static/io.py
+    save: <path>.pdparams + .pdopt)."""
+    from ..framework.io import save as _save
+
+    state = {(t.name or f"param_{i}"): np.asarray(t._data)
+             for i, t in enumerate(program.all_parameters())}
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore parameters saved by static.save into the program's
+    captured tensors, matched by name."""
+    from ..framework.io import load as _load
+
+    state = _load(model_path + ".pdparams")
+    by_name = {t.name: t for t in program.all_parameters() if t.name}
+    for name, arr in state.items():
+        if var_list is not None and name not in {
+                getattr(v, "name", v) for v in var_list}:
+            continue
+        if name in by_name:
+            by_name[name].set_value(np.asarray(arr))
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+
+    return {k: np.asarray(v)
+            for k, v in _load(model_path + ".pdparams").items()}
+
+
+def set_program_state(program, state_dict):
+    by_name = {t.name: t for t in program.all_parameters() if t.name}
+    for name, arr in state_dict.items():
+        if name in by_name:
+            by_name[name].set_value(np.asarray(arr))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Prune to the inference graph (reference static/io.py
+    normalize_program). The traced Program already contains only reached
+    ops; returns the program annotated with the feed/fetch interface."""
+    program._normalized_feeds = [getattr(v, "name", v) for v in feed_vars]
+    program._normalized_fetches = list(fetch_vars)
+    return program
+
+
+def _export_cached(feed_vars, fetch_vars, program):
+    """One export shared by the serialize pair: tracing + StableHLO
+    lowering runs once per (program, feeds, fetches)."""
+    from .export import export_fetches
+
+    prog = program or default_main_program()
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    key = (tuple(id(v) for v in feed_vars), tuple(id(v) for v in fetch_vars))
+    cached = getattr(prog, "_export_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    result = export_fetches(feed_vars, fetch_vars,
+                            dynamic_dims=prog.feed_dynamic)
+    prog._export_cache = (key, result)
+    return result
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Program → bytes (reference static/io.py serialize_program): the
+    versioned StableHLO export WITHOUT weights."""
+    import pickle
+
+    data, state, meta = _export_cached(feed_vars, fetch_vars, program)
+    return pickle.dumps({"data": data, "meta": meta})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    """Weights → bytes, companion of serialize_program."""
+    import pickle
+
+    data, state, meta = _export_cached(feed_vars, fetch_vars, program)
+    return pickle.dumps([np.asarray(a) for a in state])
+
+
+def deserialize_program(data):
+    """bytes → runnable program shell; weights arrive via
+    deserialize_persistables (reference static/io.py pairing)."""
+    import pickle
+
+    blob = pickle.loads(data)
+    prog = InferenceProgram(None)
+    prog._pending = blob
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    """Attach serialized weights to a deserialize_program shell, making it
+    runnable by Executor (fetches via program.fetch_handles())."""
+    import pickle
+
+    from .export import ExportedInference
+
+    state = pickle.loads(data)
+    blob = getattr(program, "_pending", None)
+    if blob is None:
+        raise ValueError("program was not produced by deserialize_program")
+    blob["meta"]["n_state"] = len(state)
+    program.exported = ExportedInference(blob["data"], state, blob["meta"])
+    program._pending = None
+    return program
+
+
+class ParallelExecutor:
+    """reference parallel_executor.py shim: multi-device execution is
+    GSPMD batch sharding (CompiledProgram.with_data_parallel); this class
+    keeps the constructor/run surface."""
+
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            self._program, build_strategy).with_data_parallel(
+                loss_name=loss_name, exec_strategy=exec_strategy)
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._compiled, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+from ..framework.param_attr import WeightNormParamAttr  # noqa: F401,E402

@@ -191,6 +191,10 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     init = default_initializer
     trainable = True
     attr = ParamAttr._to_attr(attr)
+    if getattr(attr, "weight_norm_dim", None) is not None:
+        raise NotImplementedError(
+            "WeightNormParamAttr: apply nn.utils.weight_norm(layer) "
+            "instead — the reparameterization is a layer hook here")
     if isinstance(attr, ParamAttr):
         if attr.initializer is not None:
             init = attr.initializer

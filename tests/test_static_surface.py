@@ -1,0 +1,332 @@
+"""paddle.static + static.nn parity surface (reference
+python/paddle/static/__init__.py, static/nn/__init__.py) and the extended
+padded-dense sequence op family (reference sequence_ops/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+RNG = np.random.default_rng(23)
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+class TestSequenceFamily:
+    def test_sequence_pool_modes(self):
+        x = RNG.random((2, 4, 3)).astype(np.float32)
+        lens = np.array([2, 4])
+        got_sum = F.sequence_pool(_t(x), _t(lens), "sum").numpy()
+        want_sum = np.stack([x[0, :2].sum(0), x[1, :4].sum(0)])
+        np.testing.assert_allclose(got_sum, want_sum, rtol=1e-5)
+        got_avg = F.sequence_pool(_t(x), _t(lens), "average").numpy()
+        np.testing.assert_allclose(
+            got_avg, want_sum / lens[:, None], rtol=1e-5)
+        got_sqrt = F.sequence_pool(_t(x), _t(lens), "sqrt").numpy()
+        np.testing.assert_allclose(
+            got_sqrt, want_sum / np.sqrt(lens)[:, None], rtol=1e-5)
+        got_max = F.sequence_pool(_t(x), _t(lens), "max").numpy()
+        np.testing.assert_allclose(
+            got_max, np.stack([x[0, :2].max(0), x[1, :4].max(0)]), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.sequence_last_step(_t(x), _t(lens)).numpy(),
+            np.stack([x[0, 1], x[1, 3]]))
+        np.testing.assert_allclose(
+            F.sequence_first_step(_t(x), _t(lens)).numpy(), x[:, 0])
+
+    def test_sequence_concat(self):
+        a = RNG.random((2, 3, 2)).astype(np.float32)
+        b = RNG.random((2, 2, 2)).astype(np.float32)
+        la, lb = np.array([2, 3]), np.array([2, 1])
+        out, lens = F.sequence_concat([_t(a), _t(b)], [_t(la), _t(lb)])
+        assert lens.numpy().tolist() == [4, 4]
+        np.testing.assert_allclose(out.numpy()[0, :4],
+                                   np.concatenate([a[0, :2], b[0, :2]]))
+        np.testing.assert_allclose(out.numpy()[1, :4],
+                                   np.concatenate([a[1, :3], b[1, :1]]))
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3, 4]], np.int64)
+        got = F.sequence_enumerate(_t(x), 2, pad_value=0).numpy()
+        np.testing.assert_allclose(got[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    def test_sequence_conv_matches_manual(self):
+        x = RNG.random((1, 5, 2)).astype(np.float32)
+        lens = np.array([4])
+        w = RNG.random((3 * 2, 3)).astype(np.float32)   # ctx=3 centered
+        got = F.sequence_conv(_t(x), _t(lens), _t(w)).numpy()
+        xm = x.copy()
+        xm[0, 4:] = 0
+        want = np.zeros((1, 5, 3), np.float32)
+        for t in range(5):
+            ctx = []
+            for off in (-1, 0, 1):
+                ctx.append(xm[0, t + off] if 0 <= t + off < 5
+                           else np.zeros(2, np.float32))
+            want[0, t] = np.concatenate(ctx) @ w
+        want[0, 4:] = 0
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_sequence_reshape_slice_scatter(self):
+        x = RNG.random((2, 4, 4)).astype(np.float32)
+        lens = np.array([2, 4])
+        out, nl = F.sequence_reshape(_t(x), _t(lens), 8)
+        assert list(out.shape) == [2, 2, 8]
+        assert nl.numpy().tolist() == [1, 2]
+
+        s, sl = F.sequence_slice(_t(x), _t(np.array([1, 0])),
+                                 _t(np.array([2, 3])))
+        assert list(s.shape)[:2] == [2, 3]
+        np.testing.assert_allclose(s.numpy()[0, :2], x[0, 1:3])
+        np.testing.assert_allclose(s.numpy()[1, :3], x[1, :3])
+        np.testing.assert_allclose(s.numpy()[0, 2], 0)
+
+        base = np.zeros((6, 2), np.float32)
+        idx = np.array([[0, 2], [5, 5]])
+        upd = np.ones((2, 2, 2), np.float32)
+        got = F.sequence_scatter(_t(base), _t(idx), _t(upd),
+                                 _t(np.array([2, 1]))).numpy()
+        want = base.copy()
+        want[0] += 1
+        want[2] += 1
+        want[5] += 1     # second row only first entry valid
+        np.testing.assert_allclose(got, want)
+
+
+class TestStaticNN:
+    def test_conv_norm_wrappers_shapes(self):
+        x = _t(RNG.random((2, 3, 8, 8)).astype(np.float32))
+        assert static.nn.conv2d(x, 4, 3, act="relu").shape == [2, 4, 6, 6]
+        assert static.nn.conv2d_transpose(x, 4, filter_size=3).shape \
+            == [2, 4, 10, 10]
+        assert static.nn.conv2d_transpose(x, 4, output_size=16,
+                                          stride=2).shape == [2, 4, 16, 16]
+        assert static.nn.batch_norm(x).shape == [2, 3, 8, 8]
+        assert static.nn.layer_norm(x).shape == [2, 3, 8, 8]
+        assert static.nn.group_norm(x, 3).shape == [2, 3, 8, 8]
+        assert static.nn.instance_norm(x).shape == [2, 3, 8, 8]
+        assert static.nn.prelu(x, "channel").shape == [2, 3, 8, 8]
+
+    def test_spectral_norm_unit_sigma(self):
+        w = RNG.random((4, 12)).astype(np.float32)
+        wn = static.nn.spectral_norm(_t(w), power_iters=30).numpy()
+        assert np.linalg.svd(wn, compute_uv=False)[0] == pytest.approx(
+            1.0, rel=1e-3)
+
+    def test_row_conv_manual(self):
+        x = RNG.random((1, 4, 2)).astype(np.float32)
+        out = static.nn.row_conv(_t(x), future_context_size=1)
+        assert out.shape == [1, 4, 2]
+
+    def test_data_norm_formula(self):
+        x = RNG.random((4, 6)).astype(np.float32)
+        got = static.nn.data_norm(_t(x)).numpy()
+        # fresh stats: mean 0, var 1 (size=1e4, sum=0, sqsum=1e4)
+        np.testing.assert_allclose(got, x / np.sqrt(1 + 1e-5), rtol=1e-4)
+
+    def test_bilinear_tensor_product_and_nce(self):
+        a = _t(RNG.random((3, 4)).astype(np.float32))
+        b = _t(RNG.random((3, 5)).astype(np.float32))
+        assert static.nn.bilinear_tensor_product(a, b, 6).shape == [3, 6]
+        lab = _t(np.array([[1], [2], [0]]))
+        loss = static.nn.nce(a, lab, 7, num_neg_samples=3)
+        assert loss.shape == [3, 1] and np.isfinite(loss.numpy()).all()
+
+    def test_crf_decoding_prefers_high_emission(self):
+        emis = np.full((1, 3, 3), -1.0, np.float32)
+        emis[0, 0, 1] = emis[0, 1, 2] = emis[0, 2, 0] = 5.0
+        trans = np.zeros((5, 3), np.float32)
+        path = static.nn.crf_decoding(
+            _t(emis), _t(trans), length=_t(np.array([3])))
+        assert path.numpy()[0].tolist() == [1, 2, 0]
+
+    def test_py_func_roundtrip_and_embedding(self):
+        out_t = paddle.zeros([4])
+        r = static.nn.py_func(lambda a: np.asarray(a) * 3,
+                              _t(np.ones(4, np.float32)), out_t)
+        np.testing.assert_allclose(r.numpy(), 3.0)
+        ids = _t(np.array([[1, 2], [3, 0]]))
+        assert static.nn.embedding(ids, (10, 5)).shape == [2, 2, 5]
+        assert static.nn.sparse_embedding(ids, (10, 5)).shape == [2, 2, 5]
+
+    def test_multi_box_head_consistent(self):
+        f1 = _t(RNG.random((1, 8, 8, 8)).astype(np.float32))
+        f2 = _t(RNG.random((1, 8, 4, 4)).astype(np.float32))
+        img = _t(RNG.random((1, 3, 32, 32)).astype(np.float32))
+        locs, confs, pb, pv = static.nn.multi_box_head(
+            [f1, f2], img, 32, 4, [[2.0], [2.0, 3.0]],
+            min_ratio=20, max_ratio=90)
+        assert locs.shape[1] == pb.shape[0] == pv.shape[0]
+        assert confs.shape[2] == 4
+
+    def test_deform_conv2d_static(self):
+        x = _t(RNG.random((2, 3, 8, 8)).astype(np.float32))
+        off = paddle.zeros([2, 18, 8, 8])
+        mask = paddle.ones([2, 9, 8, 8])
+        out = static.nn.deform_conv2d(x, off, mask, 4, 3, padding=1)
+        assert out.shape == [2, 4, 8, 8]
+
+
+class TestStaticModule:
+    def test_places_scope_globals(self):
+        assert len(static.cpu_places(2)) == 2
+        assert len(static.cuda_places()) >= 1
+        sc = static.Scope()
+        with static.scope_guard(sc):
+            assert static.global_scope() is sc
+        assert static.global_scope() is not sc
+        g = static.create_global_var([2], 1.5, "float32", name="gv_test")
+        np.testing.assert_allclose(g.numpy(), 1.5)
+        assert static.global_scope().find_var("gv_test") is not None
+
+    def test_print_passthrough_and_accuracy_auc(self):
+        x = _t(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(static.Print(x).numpy(), [1, 2])
+        scores = _t(np.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7],
+                              [0.6, 0.4]], np.float32))
+        lab = _t(np.array([[1], [0], [1], [0]]))
+        assert float(static.accuracy(scores, lab)) == 1.0
+        a, batch_a, states = static.auc(scores, lab)
+        assert float(a) == pytest.approx(1.0)
+        assert len(states) == 4
+
+    def test_save_load_program_state(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            y = static.nn.fc(x, 3)
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        want = exe.run(prog, feed=feed, fetch_list=[y])[0]
+        static.save(prog, str(tmp_path / "m"))
+        p0 = prog.all_parameters()[0]
+        orig = p0.numpy().copy()
+        p0.set_value(np.zeros_like(orig))
+        static.load(prog, str(tmp_path / "m"))
+        np.testing.assert_allclose(p0.numpy(), orig)
+        state = static.load_program_state(str(tmp_path / "m"))
+        p0.set_value(np.zeros_like(orig))
+        static.set_program_state(prog, state)
+        np.testing.assert_allclose(p0.numpy(), orig)
+        np.testing.assert_allclose(exe.run(prog, feed=feed,
+                                           fetch_list=[y])[0], want)
+
+    def test_serialize_deserialize_pair(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            y = static.nn.fc(x, 3)
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        want = exe.run(prog, feed=feed, fetch_list=[y])[0]
+        sp = static.serialize_program([x], [y], program=prog)
+        sv = static.serialize_persistables([x], [y], program=prog)
+        static.save_to_file(str(tmp_path / "m.pdmodel"), sp)
+        prog2 = static.deserialize_program(
+            static.load_from_file(str(tmp_path / "m.pdmodel")))
+        static.deserialize_persistables(prog2, sv)
+        got = exe.run(prog2, feed=feed, fetch_list=None)
+        np.testing.assert_allclose(np.asarray(got[0]), want, rtol=1e-5)
+
+    def test_parallel_executor_shim(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            y = static.nn.fc(x, 3)
+        pe = static.ParallelExecutor(main_program=prog)
+        out = pe.run([y], feed={"x": np.ones((4, 4), np.float32)})[0]
+        assert out.shape == (4, 3)
+
+    def test_normalize_program_and_weight_norm_attr(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            y = x * 2
+        p = static.normalize_program(prog, [x], [y])
+        assert p is prog and p._normalized_feeds == ["x"]
+        wn = static.WeightNormParamAttr(dim=1, name="w")
+        assert wn.weight_norm_dim == 1 and wn.name == "w"
+
+    def test_surface_complete(self):
+        import json
+
+        ref_static = ['ExponentialMovingAverage', 'ParallelExecutor',
+                      'Print', 'WeightNormParamAttr', 'accuracy', 'auc',
+                      'cpu_places', 'create_global_var', 'create_parameter',
+                      'cuda_places', 'deserialize_persistables',
+                      'deserialize_program', 'global_scope', 'load',
+                      'load_from_file', 'load_program_state',
+                      'normalize_program', 'npu_places', 'save',
+                      'save_to_file', 'scope_guard', 'serialize_persistables',
+                      'serialize_program', 'set_program_state', 'xpu_places']
+        missing = [n for n in ref_static if not hasattr(static, n)]
+        assert not missing, missing
+        ref_nn = ['batch_norm', 'bilinear_tensor_product', 'conv2d',
+                  'conv2d_transpose', 'conv3d', 'conv3d_transpose',
+                  'crf_decoding', 'data_norm', 'deform_conv2d', 'embedding',
+                  'group_norm', 'instance_norm', 'layer_norm',
+                  'multi_box_head', 'nce', 'prelu', 'py_func', 'row_conv',
+                  'sequence_concat', 'sequence_conv', 'sequence_enumerate',
+                  'sequence_expand', 'sequence_expand_as',
+                  'sequence_first_step', 'sequence_last_step',
+                  'sequence_pad', 'sequence_pool', 'sequence_reshape',
+                  'sequence_reverse', 'sequence_scatter', 'sequence_slice',
+                  'sequence_softmax', 'sequence_unpad', 'sparse_embedding',
+                  'spectral_norm']
+        missing_nn = [n for n in ref_nn if not hasattr(static.nn, n)]
+        assert not missing_nn, missing_nn
+
+
+class TestReviewRegressions:
+    def test_auc_pr_differs_from_roc(self):
+        scores = _t(np.array([[0.3, 0.7], [0.4, 0.6], [0.8, 0.2],
+                              [0.9, 0.1], [0.35, 0.65]], np.float32))
+        lab = _t(np.array([[1], [0], [0], [0], [1]]))
+        roc, _, _ = static.auc(scores, lab, curve="ROC")
+        pr, _, _ = static.auc(scores, lab, curve="PR")
+        assert float(roc) != pytest.approx(float(pr))
+        with pytest.raises(ValueError, match="curve"):
+            static.auc(scores, lab, curve="bogus")
+
+    def test_nce_resamples_negatives_per_call(self):
+        paddle.seed(0)
+        a = _t(RNG.random((3, 4)).astype(np.float32))
+        lab = _t(np.array([[1], [2], [0]]))
+        l1 = static.nn.nce(a, lab, 50, num_neg_samples=5).numpy()
+        l2 = static.nn.nce(a, lab, 50, num_neg_samples=5).numpy()
+        # same weights are re-created per call, but negatives also differ;
+        # with 50 classes two draws of 5 negatives almost surely differ
+        assert not np.allclose(l1, l2)
+
+    def test_weight_norm_param_attr_directs_to_hook(self):
+        with pytest.raises(NotImplementedError, match="weight_norm"):
+            paddle.nn.Linear(3, 2,
+                             weight_attr=static.WeightNormParamAttr(dim=0))
+        with pytest.raises(NotImplementedError, match="weight_norm"):
+            paddle.create_parameter([3, 2], "float32",
+                                    attr=static.WeightNormParamAttr(dim=0))
+
+    def test_data_norm_stats_frozen(self):
+        x = _t(RNG.random((4, 6)).astype(np.float32))
+        prog = static.Program()
+        with static.program_guard(prog):
+            xv = static.data("x", [-1, 6], "float32")
+            static.nn.data_norm(xv)
+        for p in prog.all_parameters():
+            if ".size" in (p.name or "") or ".sum" in (p.name or "") \
+                    or ".sq" in (p.name or ""):
+                assert p.stop_gradient
+
+    def test_hsigmoid_exact_bit_length_large_classes(self):
+        # c = 2^24 - 1 rounds UP under float32 log2; exact integer length
+        # must not add a wrapped extra bit term
+        num_classes = 2 ** 23
+        x = _t(np.ones((1, 2), np.float32))
+        w = _t(np.zeros((num_classes - 1, 2), np.float32))
+        lab = _t(np.array([num_classes - 1]))
+        got = float(F.hsigmoid_loss(x, lab, num_classes, w))
+        # all pre-activations are 0 => each of the 23 path terms is log(2)
+        assert got == pytest.approx(23 * np.log(2), rel=1e-4)
