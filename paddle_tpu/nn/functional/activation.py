@@ -192,9 +192,14 @@ def maxout(x, groups, axis=1, name=None):
 
 def _prelu(x, weight):
     if weight.size > 1:
-        shape = [1] * x.ndim
-        shape[1] = weight.size
-        weight = weight.reshape(shape)
+        if weight.shape == tuple(x.shape[1:]):
+            # element mode (reference prelu_op "element"): one alpha per
+            # element of a sample
+            weight = weight.reshape((1,) + weight.shape)
+        else:
+            shape = [1] * x.ndim
+            shape[1] = weight.size
+            weight = weight.reshape(shape)
     return jnp.where(x >= 0, x, weight * x)
 
 

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...framework.core import Tensor, apply_op
+from ...framework.core import Tensor, apply_op, _is_tracer
 
 __all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_reverse", "sequence_softmax", "sequence_expand",
@@ -168,7 +168,7 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     Returns (distances [B, 1] float32, sequence_num [1] int64). With
     ``normalized`` each distance is divided by the reference length.
     """
-    from ...framework.core import Tensor, apply_op
+    from ...framework.core import Tensor, apply_op, _is_tracer
 
     hyp = input._data if isinstance(input, Tensor) else jnp.asarray(input)
     ref = label._data if isinstance(label, Tensor) else jnp.asarray(label)
@@ -335,8 +335,18 @@ def _seq_reshape(x, lengths, new_dim):
 
 def sequence_reshape(x, lengths, new_dim, name=None):
     """Re-chunk each sequence's flattened payload to rows of new_dim
-    (reference sequence_reshape_op; lengths scale by D/new_dim)."""
-    return apply_op(_seq_reshape, x, lengths, new_dim=int(new_dim),
+    (reference sequence_reshape_op: every sequence's length*D must divide
+    new_dim; lengths scale by D/new_dim)."""
+    new_dim = int(new_dim)
+    larr = getattr(lengths, "_data", lengths)
+    D = int(x.shape[-1])
+    if not _is_tracer(larr):
+        bad = np.asarray(larr) * D % new_dim
+        if np.any(bad):
+            raise ValueError(
+                "sequence_reshape: every length*input_dim must be "
+                "divisible by new_dim=%d" % new_dim)
+    return apply_op(_seq_reshape, x, lengths, new_dim=new_dim,
                     op_name="sequence_reshape")
 
 

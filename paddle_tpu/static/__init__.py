@@ -902,8 +902,14 @@ def save(program, model_path, protocol=4, **configs):
     save: <path>.pdparams + .pdopt)."""
     from ..framework.io import save as _save
 
-    state = {(t.name or f"param_{i}"): np.asarray(t._data)
-             for i, t in enumerate(program.all_parameters())}
+    params = program.all_parameters()
+    names = [t.name or f"param_{i}" for i, t in enumerate(params)]
+    dup = {n for n in names if names.count(n) > 1}
+    if dup:
+        raise ValueError(
+            "static.save: duplicate parameter names %s — give layers "
+            "unique name= arguments" % sorted(dup))
+    state = {n: np.asarray(t._data) for n, t in zip(names, params)}
     _save(state, model_path + ".pdparams")
 
 
@@ -913,7 +919,8 @@ def load(program, model_path, executor=None, var_list=None):
     from ..framework.io import load as _load
 
     state = _load(model_path + ".pdparams")
-    by_name = {t.name: t for t in program.all_parameters() if t.name}
+    params = program.all_parameters()
+    by_name = {(t.name or f"param_{i}"): t for i, t in enumerate(params)}
     for name, arr in state.items():
         if var_list is not None and name not in {
                 getattr(v, "name", v) for v in var_list}:
@@ -930,7 +937,8 @@ def load_program_state(model_path, var_list=None):
 
 
 def set_program_state(program, state_dict):
-    by_name = {t.name: t for t in program.all_parameters() if t.name}
+    params = program.all_parameters()
+    by_name = {(t.name or f"param_{i}"): t for i, t in enumerate(params)}
     for name, arr in state_dict.items():
         if name in by_name:
             by_name[name].set_value(np.asarray(arr))
@@ -965,7 +973,11 @@ def _export_cached(feed_vars, fetch_vars, program):
         fetch_vars = [fetch_vars]
     if not isinstance(feed_vars, (list, tuple)):
         feed_vars = [feed_vars]
-    key = (tuple(id(v) for v in feed_vars), tuple(id(v) for v in fetch_vars))
+    # the key includes parameter BUFFER identities: set_value/static.load
+    # rebind t._data, so weight updates invalidate the cache and the pair
+    # cannot ship stale state
+    key = (tuple(id(v) for v in feed_vars), tuple(id(v) for v in fetch_vars),
+           tuple(id(t._data) for t in prog.all_parameters()))
     cached = getattr(prog, "_export_cache", None)
     if cached is not None and cached[0] == key:
         return cached[1]

@@ -32,6 +32,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     """Static fully-connected helper (reference static/nn/common.py fc):
     flattens trailing dims, applies xW+b and optional activation."""
+    name = _uname("fc", name)
     import numpy as np
 
     from .. import tensor as T
@@ -44,11 +45,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     if num_flatten_dims != len(shape) - 1 or len(shape) > 2:
         x = T.reshape(x, shape[:num_flatten_dims] + [in_features])
     w = Parameter(I.XavierNormal()((in_features, size), "float32"),
-                  name=(name or "fc") + ".w")
+                  name=name + ".w")
     out = T.matmul(x, w)
     if bias_attr is not False:
         b = Parameter(I.Constant(0.0)((size,), "float32"),
-                      name=(name or "fc") + ".b")
+                      name=name + ".b")
         out = out + b
     if activation == "relu":
         out = F.relu(out)
@@ -68,8 +69,23 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 # without a LayerHelper.
 # ---------------------------------------------------------------------------
 
-def _layer_call(layer_cls, x, *args, **kwargs):
-    return layer_cls(*args, **kwargs)(x)
+def _uname(base, name):
+    """Auto-unique parameter-name prefix (the reference LayerHelper
+    uniquifies every created var; fixed names would collide in
+    static.save's name-keyed state dict). Counters live ON the active
+    Program so rebuilding the same graph reproduces the same names and
+    save/rebuild/load round-trips."""
+    if name is not None:
+        return name
+    from . import default_main_program
+
+    prog = default_main_program()
+    counters = getattr(prog, "_uname_counters", None)
+    if counters is None:
+        counters = prog._uname_counters = {}
+    n = counters.get(base, 0)
+    counters[base] = n + 1
+    return "%s_%d" % (base, n)
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
@@ -206,6 +222,7 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
     """Reference data_norm_op.cc: normalization by accumulated batch
     statistics (batch_size/batch_sum/batch_square_sum), no learned gamma:
     out = (x - sum/size) / sqrt(square_sum/size - mean^2 + eps)."""
+    name = _uname("dn", name)
     import jax.numpy as jnp
 
     from ..framework.core import Parameter, apply_op
@@ -215,11 +232,11 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
     # accumulated statistics, NOT gradient-trained (reference data_norm_op
     # updates them by in-place accumulation, not SGD)
     size = Parameter(I.Constant(1e4)((D,), "float32"),
-                     name=(name or "dn") + ".size", trainable=False)
+                     name=name + ".size", trainable=False)
     sums = Parameter(I.Constant(0.0)((D,), "float32"),
-                     name=(name or "dn") + ".sum", trainable=False)
+                     name=name + ".sum", trainable=False)
     sqs = Parameter(I.Constant(1e4)((D,), "float32"),
-                    name=(name or "dn") + ".sq", trainable=False)
+                    name=name + ".sq", trainable=False)
 
     def _dn(x, size, sums, sqs, epsilon):
         mean = sums / size
@@ -285,13 +302,14 @@ def prelu(x, mode="all", param_attr=None, name=None):
     else:
         raise ValueError("mode must be all/channel/element")
     alpha = Parameter(I.Constant(0.25)(shape, "float32"),
-                      name=(name or "prelu") + ".alpha")
+                      name=_uname("prelu", name) + ".alpha")
     return F.prelu(x, alpha)
 
 
 def bilinear_tensor_product(x, y, size, act=None, name=None,
                             param_attr=None, bias_attr=None):
     """out_k = x W_k y^T + b (reference bilinear_tensor_product_op.cc)."""
+    name = _uname("btp", name)
     import jax.numpy as jnp
 
     from ..framework.core import Parameter, apply_op
@@ -299,9 +317,9 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
 
     dx, dy = int(x.shape[-1]), int(y.shape[-1])
     w = Parameter(I.XavierNormal()((size, dx, dy), "float32"),
-                  name=(name or "btp") + ".w")
+                  name=name + ".w")
     b = Parameter(I.Constant(0.0)((size,), "float32"),
-                  name=(name or "btp") + ".b")
+                  name=name + ".b")
 
     def _btp(x, y, w, b):
         return jnp.einsum("bd,kde,be->bk", x, w, y) + b
@@ -320,7 +338,7 @@ def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A0
 
     D = int(input.shape[-1])
     ctx = int(future_context_size) + 1
-    w = Parameter(I.XavierNormal()((ctx, D), "float32"), name="row_conv.w")
+    w = Parameter(I.XavierNormal()((ctx, D), "float32"), name=_uname("row_conv", None) + ".w")
 
     def _rc(x, w):
         T = x.shape[1]
@@ -371,11 +389,15 @@ def crf_decoding(input, param_attr, label=None, length=None):  # noqa: A002
     return path
 
 
+_nce_counter = [0]
+
+
 def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
         param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
         sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
     """Noise-contrastive estimation loss (reference nce_op.h): binary
     logistic on the true class vs num_neg_samples uniform negatives."""
+    name = _uname("nce", name)
     import jax
     import jax.numpy as jnp
 
@@ -388,13 +410,20 @@ def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
             "nce: only the uniform sampler is implemented")
     D = int(input.shape[-1])
     w = Parameter(I.XavierNormal()((num_total_classes, D), "float32"),
-                  name=(name or "nce") + ".w")
+                  name=name + ".w")
     b = Parameter(I.Constant(0.0)((num_total_classes,), "float32"),
-                  name=(name or "nce") + ".b")
-    # negatives are sampled INSIDE the op from a per-call key, so each
-    # training step draws fresh noise classes like the reference nce_op
-    # (a key captured at trace time would freeze them)
-    key = jax.random.PRNGKey(seed) if seed else next_key()
+                  name=name + ".b")
+    # Eager mode: negatives refresh per call — seed=0 draws from the
+    # advancing global PRNG; an explicit seed gets a deterministic but
+    # still advancing stream (fold_in of a call counter), matching the
+    # reference sampler. Static mode captures the build-time key, the same
+    # frozen-randomness semantics as every random op in a traced Program
+    # (see nn/functional/common.py dropout).
+    if seed:
+        _nce_counter[0] += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), _nce_counter[0])
+    else:
+        key = next_key()
     from ..framework.core import Tensor as _T
 
     def _nce(x, lab, w, b, key, num_neg_samples, num_total_classes):
@@ -476,6 +505,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
                   padding=0, dilation=1, groups=1, deformable_groups=1,
                   im2col_step=1, weight_attr=None, bias_attr=None, name=None):
+    name = _uname("dcn", name)
     from ..framework.core import Parameter
     from ..nn import initializer as I
     from ..vision.ops import deform_conv2d as _dc
@@ -484,11 +514,11 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
         else (filter_size, filter_size)
     cin = int(x.shape[1])
     w = Parameter(I.XavierNormal()((num_filters, cin // groups, k[0], k[1]),
-                                   "float32"), name=(name or "dcn") + ".w")
+                                   "float32"), name=name + ".w")
     b = None
     if bias_attr is not False:
         b = Parameter(I.Constant(0.0)((num_filters,), "float32"),
-                      name=(name or "dcn") + ".b")
+                      name=name + ".b")
     return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
                dilation=dilation, deformable_groups=deformable_groups,
                groups=groups, mask=mask)

@@ -7,3 +7,7 @@ from .resnet import (  # noqa: F401
     wide_resnet50_2, wide_resnet101_2,
 )
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2  # noqa: F401
+from .resnext import (  # noqa: F401
+    ResNeXt, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+)

@@ -1,0 +1,59 @@
+"""ResNeXt (reference python/paddle/vision/models/resnext.py:129).
+
+Aggregated residual transformations = the ResNet bottleneck with grouped
+3x3 convs; on TPU the grouped conv lowers to a feature-group XLA
+convolution that tiles onto the MXU, so this reuses the ResNet trunk with
+(groups=cardinality, width=group width) rather than a parallel tower copy.
+"""
+from __future__ import annotations
+
+from .resnet import BottleneckBlock, ResNet
+
+__all__ = ["ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d"]
+
+
+class ResNeXt(ResNet):
+    """Reference ResNeXt class surface (depth, cardinality, num_classes,
+    with_pool); 152 uses [3, 8, 36, 3] like the reference."""
+
+    def __init__(self, depth=50, cardinality=32, num_classes=1000,
+                 with_pool=True):
+        self.cardinality = cardinality
+        # reference uses 4-wide groups for 32-card, 64-card models alike
+        super().__init__(BottleneckBlock, depth, width=4,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+
+
+def _resnext(arch, depth, cardinality, pretrained, **kwargs):
+    model = ResNeXt(depth=depth, cardinality=cardinality, **kwargs)
+    if pretrained:
+        raise RuntimeError(
+            "zero-egress environment: pretrained weights unavailable")
+    return model
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext("resnext50_32x4d", 50, 32, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext("resnext50_64x4d", 50, 64, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext("resnext101_32x4d", 101, 32, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext("resnext101_64x4d", 101, 64, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext("resnext152_32x4d", 152, 32, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext("resnext152_64x4d", 152, 64, pretrained, **kwargs)

@@ -7,8 +7,8 @@ its data-dependent loop on host via a fixed-iteration lax.while formulation
 when traced sizes allow, else eager numpy — dynamic output shapes are
 inherently host-side, as in the reference's CPU kernel.
 
-read_file / decode_jpeg are intentionally absent: file IO ops belong to
-the input pipeline (paddle_tpu.io + PIL/numpy), not the graph.
+read_file / decode_jpeg run host-side (PIL): image IO is input-pipeline
+work that never belongs on the TPU.
 deform_conv2d is implemented as vectorized bilinear gathers + grouped
 einsum — gather-heavy (VPU-bound, not MXU-peak) but numerically exact vs
 the reference's modulated im2col.
@@ -30,7 +30,8 @@ __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "multiclass_nms", "matrix_nms", "deform_conv2d", "iou_similarity",
            "box_clip", "anchor_generator", "generate_proposals",
            "distribute_fpn_proposals", "collect_fpn_proposals",
-           "RoIAlign", "RoIPool"]
+           "RoIAlign", "RoIPool", "yolo_loss", "DeformConv2D", "PSRoIPool",
+           "read_file", "decode_jpeg"]
 
 
 def _arr(x):
@@ -996,3 +997,199 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     rois_num = np.bincount(sel_img, minlength=n_img).astype(np.int32)
     return (Tensor(jnp.asarray(out_rois)),
             Tensor(jnp.asarray(rois_num)))
+
+
+# -- YOLOv3 loss + layer wrappers + image IO --------------------------------
+
+def _bce_logits_soft(x, t):
+    # SigmoidCrossEntropy (yolov3_loss_op.h:35) with soft targets
+    return jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _yolo_loss_impl(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                    class_num, ignore_thresh, downsample_ratio,
+                    use_label_smooth, scale_x_y):
+    N, C, H, W = x.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    input_size = downsample_ratio * H
+    anc = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+    amask = jnp.asarray(anchor_mask, jnp.int32)
+    p = x.reshape(N, mask_num, 5 + class_num, H, W)
+    scale, bias = scale_x_y, -0.5 * (scale_x_y - 1.0)
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+
+    # --- ignore mask: best IoU of each prediction vs any valid gt
+    gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+    masked_anc = anc[amask]                                    # [m, 2]
+    px = (jax.nn.sigmoid(p[:, :, 0]) * scale + bias + gx) / W  # [N,m,H,W]
+    py = (jax.nn.sigmoid(p[:, :, 1]) * scale + bias + gy) / H
+    pw = jnp.exp(p[:, :, 2]) * masked_anc[:, 0][None, :, None, None] / input_size
+    ph = jnp.exp(p[:, :, 3]) * masked_anc[:, 1][None, :, None, None] / input_size
+
+    gt_valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0)   # [N,B]
+
+    def iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+        li = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+        ri = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+        ti = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+        bi = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+        inter = jnp.maximum(ri - li, 0) * jnp.maximum(bi - ti, 0)
+        return inter / (w1 * h1 + w2 * h2 - inter + 1e-10)
+
+    ious = iou_cwh(px[..., None], py[..., None], pw[..., None], ph[..., None],
+                   gt_box[:, None, None, None, :, 0],
+                   gt_box[:, None, None, None, :, 1],
+                   gt_box[:, None, None, None, :, 2],
+                   gt_box[:, None, None, None, :, 3])          # [N,m,H,W,B]
+    ious = jnp.where(gt_valid[:, None, None, None, :], ious, 0.0)
+    best_iou = jnp.max(ious, axis=-1)
+    obj = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)       # [N,m,H,W]
+
+    # --- per-gt best anchor (wh IoU at origin) over ALL anchors
+    aw = anc[:, 0] / input_size
+    ah = anc[:, 1] / input_size
+    inter = (jnp.minimum(gt_box[..., 2][..., None], aw)
+             * jnp.minimum(gt_box[..., 3][..., None], ah))     # [N,B,an]
+    a_iou = inter / (gt_box[..., 2][..., None] * gt_box[..., 3][..., None]
+                     + aw * ah - inter + 1e-10)
+    best_n = jnp.argmax(a_iou, axis=-1)                        # [N,B]
+    mask_idx = jnp.argmax(best_n[..., None] == amask, axis=-1)
+    in_mask = jnp.any(best_n[..., None] == amask, axis=-1) & gt_valid
+
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+    # gather responsible predictions per gt: [N,B,5+cls]
+    ni = jnp.arange(N)[:, None]
+    pred_at = p[ni, mask_idx, :, gj, gi]
+    tx = gt_box[..., 0] * W - gi
+    ty = gt_box[..., 1] * H - gj
+    tw = jnp.log(jnp.maximum(gt_box[..., 2], 1e-9) * input_size
+                 / anc[best_n, 0])
+    th = jnp.log(jnp.maximum(gt_box[..., 3], 1e-9) * input_size
+                 / anc[best_n, 1])
+    loc_scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * gt_score
+    loc = (_bce_logits_soft(pred_at[..., 0], tx)
+           + _bce_logits_soft(pred_at[..., 1], ty)
+           + jnp.abs(pred_at[..., 2] - tw)
+           + jnp.abs(pred_at[..., 3] - th)) * loc_scale
+    cls_t = jnp.where(jnp.arange(class_num)[None, None, :]
+                      == gt_label[..., None], label_pos, label_neg)
+    cls = jnp.sum(_bce_logits_soft(pred_at[..., 5:], cls_t), -1) * gt_score
+    per_gt = jnp.where(in_mask, loc + cls, 0.0)
+    loss = jnp.sum(per_gt, axis=1)                             # [N]
+
+    # positive cells override the ignore mask with the gt score
+    flat_obj = obj.reshape(N, -1)
+    pos_flat = (mask_idx * H + gj) * W + gi                    # [N,B]
+    safe_idx = jnp.where(in_mask, pos_flat, mask_num * H * W)
+    grown = jnp.concatenate([flat_obj, jnp.zeros((N, 1))], axis=1)
+    grown = grown.at[ni, safe_idx].set(
+        jnp.where(in_mask, gt_score, 0.0))
+    obj = grown[:, :-1].reshape(N, mask_num, H, W)
+
+    conf = p[:, :, 4]
+    obj_loss = jnp.where(
+        obj > 1e-5, _bce_logits_soft(conf, 1.0) * obj,
+        jnp.where(obj > -0.5, _bce_logits_soft(conf, 0.0), 0.0))
+    return loss + jnp.sum(obj_loss, axis=(1, 2, 3))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference detection/yolov3_loss_op.h: per-gt best-anchor
+    assignment, soft-target sigmoid CE on x/y, L1 on w/h scaled by
+    (2 - w*h), objectness with IoU>thresh ignore zone, label smoothing).
+    Returns per-image loss [N]."""
+    from ..framework.core import Tensor, apply_op
+
+    if gt_score is None:
+        gt_score = Tensor(jnp.ones(tuple(gt_label.shape), jnp.float32))
+    return apply_op(
+        _yolo_loss_impl, x, gt_box, gt_label, gt_score,
+        anchors=tuple(int(a) for a in anchors),
+        anchor_mask=tuple(int(a) for a in anchor_mask),
+        class_num=int(class_num), ignore_thresh=float(ignore_thresh),
+        downsample_ratio=int(downsample_ratio),
+        use_label_smooth=bool(use_label_smooth),
+        scale_x_y=float(scale_x_y), op_name="yolo_loss")
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference vision/ops.py:626 DeformConv2D)
+    over the deform_conv2d functional."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self._attrs = (stride, padding, dilation, deformable_groups, groups)
+        from ..nn import initializer as I
+
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, k[0], k[1]],
+            attr=weight_attr, default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._attrs
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             stride=s, padding=p, dilation=d,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+class PSRoIPool(Layer):
+    """Position-sensitive RoI pooling layer (reference vision/ops.py:978)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference vision/ops.py:819)."""
+    from ..framework.core import Tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference vision/ops.py:864,
+    decode_jpeg op over nvjpeg). Host-side decode via PIL — image IO is
+    input-pipeline work that belongs on CPU, not the TPU."""
+    import io
+
+    from PIL import Image
+
+    from ..framework.core import Tensor
+
+    raw = bytes(np.asarray(x._data if hasattr(x, "_data") else x,
+                           np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -18,6 +18,8 @@ __all__ = [
     "RandomResizedCrop", "BrightnessTransform", "ContrastTransform",
     "SaturationTransform", "HueTransform", "ColorJitter", "Grayscale",
     "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop", "crop",
+    "RandomRotation", "adjust_brightness", "adjust_contrast", "adjust_hue",
+    "pad", "rotate", "to_grayscale",
 ]
 
 
@@ -297,7 +299,10 @@ class HueTransform(BaseTransform):
         self.value = value
 
     def _apply_image(self, img):
-        return img  # full HSV hue rotation: host-side nicety, not on hot path
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, factor)
 
 
 class ColorJitter(BaseTransform):
@@ -325,3 +330,126 @@ class Grayscale(BaseTransform):
         if self.num_output_channels == 3:
             gray = np.repeat(gray, 3, axis=2)
         return gray.astype(np.uint8)
+
+
+# --- functional color/geometry ops (reference vision/transforms/functional.py)
+
+def adjust_brightness(img, brightness_factor):
+    """img * factor, clipped (reference functional.adjust_brightness)."""
+    arr = _as_hwc(img).astype(np.float32) * float(brightness_factor)
+    return np.clip(arr, 0, 255).astype(np.uint8)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the mean gray level (reference functional.adjust_contrast)."""
+    arr = _as_hwc(img).astype(np.float32)
+    gray = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+            + arr[..., 2] * 0.114) if arr.shape[-1] == 3 else arr[..., 0]
+    mean = gray.mean()
+    out = mean + float(contrast_factor) * (arr - mean)
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc, gc, bc = (maxc - r) / dz, (maxc - g) / dz, (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta > 0, (h / 6.0) % 1.0, 0.0)
+    return np.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    out = np.choose(i[..., None], [
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor ∈ [-0.5, 0.5] via HSV
+    (reference functional.adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _as_hwc(img).astype(np.float32) / 255.0
+    if arr.shape[-1] == 1:
+        return _as_hwc(img)
+    hsv = _rgb_to_hsv(arr)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv)
+    return np.clip(out * 255.0, 0, 255).astype(np.uint8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Pad H/W (reference functional.pad): padding int | (lr, tb) |
+    (l, t, r, b)."""
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l = r = int(padding[0])
+        t = b = int(padding[1])
+    else:
+        l, t, r, b = (int(v) for v in padding)
+    width = [(t, b), (l, r), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(arr, width, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}.get(padding_mode)
+    if mode is None:
+        raise ValueError("unknown padding_mode %r" % (padding_mode,))
+    return np.pad(arr, width, mode=mode)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees via PIL
+    (reference functional.rotate)."""
+    from PIL import Image
+
+    arr = _as_hwc(img)
+    squeeze = arr.shape[-1] == 1
+    pil = Image.fromarray(arr[..., 0] if squeeze else arr)
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    out = np.asarray(pil.rotate(angle, resample=resample, expand=expand,
+                                center=center, fillcolor=fill))
+    return out[..., None] if squeeze else out
+
+
+class RandomRotation(BaseTransform):
+    """Rotate by a random angle from [-degrees, degrees] (reference
+    transforms.RandomRotation)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            self.degrees = (-float(degrees), float(degrees))
+        else:
+            self.degrees = (float(degrees[0]), float(degrees[1]))
+        self.args = (interpolation, expand, center, fill)
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(self.degrees[0], self.degrees[1])
+        interpolation, expand, center, fill = self.args
+        return rotate(img, angle, interpolation, expand, center, fill)

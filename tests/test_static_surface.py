@@ -330,3 +330,62 @@ class TestReviewRegressions:
         got = float(F.hsigmoid_loss(x, lab, num_classes, w))
         # all pre-activations are 0 => each of the 23 path terms is log(2)
         assert got == pytest.approx(23 * np.log(2), rel=1e-4)
+
+
+class TestNameUniquing:
+    def test_duplicate_layer_names_roundtrip(self, tmp_path):
+        """Two unnamed fc layers must save/load distinctly, and rebuilding
+        the same graph reproduces the same auto names (reference
+        LayerHelper + unique_name semantics)."""
+        def build():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [-1, 4], "float32")
+                y = static.nn.fc(static.nn.fc(x, 5), 3)
+            return prog, y
+
+        p1, y1 = build()
+        names = [p.name for p in p1.all_parameters()]
+        assert len(set(names)) == len(names) == 4
+        static.save(p1, str(tmp_path / "m"))
+        p2, y2 = build()
+        assert [p.name for p in p2.all_parameters()] == names
+        static.load(p2, str(tmp_path / "m"))
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        np.testing.assert_allclose(
+            exe.run(p1, feed=feed, fetch_list=[y1])[0],
+            exe.run(p2, feed=feed, fetch_list=[y2])[0], rtol=1e-6)
+
+    def test_save_rejects_duplicate_explicit_names(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            static.nn.fc(x, 3, name="same")
+            static.nn.fc(x, 3, name="same")
+        with pytest.raises(ValueError, match="duplicate"):
+            static.save(prog, str(tmp_path / "m"))
+
+    def test_serialize_cache_invalidates_on_weight_update(self):
+        import pickle
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            y = static.nn.fc(x, 3)
+        s1 = static.serialize_persistables([x], [y], program=prog)
+        p0 = prog.all_parameters()[0]
+        p0.set_value(p0.numpy() * 2.0)
+        s2 = static.serialize_persistables([x], [y], program=prog)
+        a1, a2 = pickle.loads(s1), pickle.loads(s2)
+        assert any(not np.allclose(u, v) for u, v in zip(a1, a2))
+
+    def test_prelu_element_mode(self):
+        x = _t(RNG.random((2, 3, 4, 4)).astype(np.float32) - 0.5)
+        out = static.nn.prelu(x, "element")
+        assert out.shape == [2, 3, 4, 4]
+
+    def test_sequence_reshape_rejects_indivisible_rows(self):
+        with pytest.raises(ValueError, match="divisible"):
+            F.sequence_reshape(_t(np.ones((2, 4, 4), np.float32)),
+                               _t(np.array([1, 2])), 8)

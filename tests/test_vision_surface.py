@@ -1,0 +1,202 @@
+"""Vision surface batch: yolo_loss, DeformConv2D/PSRoIPool layers,
+read_file/decode_jpeg, transforms functional ops, ResNeXt (reference
+python/paddle/vision/{ops,transforms,models}).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops, transforms as T
+
+RNG = np.random.default_rng(31)
+
+ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+           116, 90, 156, 198, 373, 326]
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+class TestYoloLoss:
+    def _inputs(self, cls=4, H=8):
+        x = RNG.standard_normal((2, 3 * (5 + cls), H, H)).astype(np.float32) * 0.1
+        gtb = np.array([[[0.3, 0.4, 0.2, 0.3], [0.7, 0.2, 0.1, 0.1],
+                         [0, 0, 0, 0]]] * 2, np.float32)
+        gtl = np.array([[1, 3, 0]] * 2)
+        return x, gtb, gtl
+
+    def test_shape_finite_grad(self):
+        x, gtb, gtl = self._inputs()
+        xt = _t(x)
+        xt.stop_gradient = False
+        loss = ops.yolo_loss(xt, _t(gtb), _t(gtl), ANCHORS, [0, 1, 2], 4,
+                             0.7, 32)
+        assert loss.shape == [2]
+        paddle.sum(loss).backward()
+        assert np.isfinite(xt.grad.numpy()).all()
+        assert np.abs(xt.grad.numpy()).sum() > 0
+
+    def test_perfect_prediction_lowers_loss(self):
+        """Loss at a fitted prediction must be far below a random one."""
+        cls, H = 2, 8
+        gtb = np.array([[[0.40625, 0.40625, 0.3, 0.4]]], np.float32)
+        gtl = np.array([[1]])
+        input_size = 32 * H
+        # best anchor for w,h=(0.3,0.4)*256=(76.8,102.4): anchor idx 5
+        # (59,119) -> mask [3,4,5] position 2
+        x = np.zeros((1, 3 * (5 + cls), H, H), np.float32)
+        x[:, :] = -8.0  # all confidences/classes ~0
+        v = x.reshape(1, 3, 5 + cls, H, H)
+        gi = gj = 3  # 0.40625*8 = 3.25
+        a_w, a_h = 59.0, 119.0
+        v[0, 2, 0, gj, gi] = np.log(0.25 / 0.75)       # sigmoid -> 0.25
+        v[0, 2, 1, gj, gi] = np.log(0.25 / 0.75)
+        v[0, 2, 2, gj, gi] = np.log(0.3 * input_size / a_w)
+        v[0, 2, 3, gj, gi] = np.log(0.4 * input_size / a_h)
+        v[0, 2, 4, gj, gi] = 8.0                        # objectness ~1
+        v[0, 2, 5 + 1, gj, gi] = 8.0                    # class 1 ~1
+        fitted = float(ops.yolo_loss(
+            _t(x), _t(gtb), _t(gtl), ANCHORS, [3, 4, 5], cls, 0.7, 32,
+            use_label_smooth=False)[0])
+        rand = float(ops.yolo_loss(
+            _t(RNG.standard_normal(x.shape).astype(np.float32)),
+            _t(gtb), _t(gtl), ANCHORS, [3, 4, 5], cls, 0.7, 32,
+            use_label_smooth=False)[0])
+        # soft-label BCE bottoms out at the target entropy: the x/y terms
+        # contribute scale * 2 * H(0.25) even at the exact prediction
+        h = -(0.25 * np.log(0.25) + 0.75 * np.log(0.75))
+        floor = (2.0 - 0.3 * 0.4) * 2 * h
+        assert fitted == pytest.approx(floor, abs=0.2)
+        assert fitted < 0.2 * rand
+
+    def test_gt_score_weights_loss(self):
+        x, gtb, gtl = self._inputs()
+        full = ops.yolo_loss(_t(x), _t(gtb), _t(gtl), ANCHORS, [0, 1, 2],
+                             4, 0.7, 32).numpy()
+        half = ops.yolo_loss(_t(x), _t(gtb), _t(gtl), ANCHORS, [0, 1, 2],
+                             4, 0.7, 32,
+                             gt_score=_t(np.full((2, 3), 0.5, np.float32))
+                             ).numpy()
+        assert (half < full).all()
+
+
+class TestVisionLayers:
+    def test_deform_conv2d_layer_matches_plain_conv_at_zero_offset(self):
+        paddle.seed(7)
+        layer = ops.DeformConv2D(3, 4, 3, padding=1)
+        x = _t(RNG.random((1, 3, 6, 6)).astype(np.float32))
+        off = paddle.zeros([1, 18, 6, 6])
+        out = layer(x, off)
+        import paddle_tpu.nn.functional as F
+
+        want = F.conv2d(x, layer.weight, layer.bias, padding=1)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_psroi_pool_layer(self):
+        feat = _t(RNG.random((1, 8, 6, 6)).astype(np.float32))
+        boxes = _t(np.array([[0, 0, 4, 4]], np.float32))
+        out = ops.PSRoIPool(2, 1.0)(feat, boxes, _t(np.array([1])))
+        assert out.shape == [1, 2, 2, 2]
+
+    def test_read_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+
+        # smooth gradient image: random noise does not survive the lossy
+        # codec within any useful tolerance
+        gy, gx = np.mgrid[0:8, 0:10]
+        arr = np.stack([gy * 20, gx * 18, (gy + gx) * 10],
+                       axis=-1).astype(np.uint8)
+        p = str(tmp_path / "img.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        raw = ops.read_file(p)
+        assert raw.dtype == np.uint8 and raw.ndim == 1
+        dec = ops.decode_jpeg(raw).numpy()
+        assert dec.shape == (3, 8, 10)
+        assert np.abs(dec.astype(int).transpose(1, 2, 0)
+                      - arr.astype(int)).mean() < 12  # lossy codec
+        gray = ops.decode_jpeg(raw, mode="gray").numpy()
+        assert gray.shape == (1, 8, 10)
+
+
+class TestTransformsFunctional:
+    def test_brightness_contrast(self):
+        img = (RNG.random((6, 8, 3)) * 255).astype(np.uint8)
+        np.testing.assert_allclose(
+            T.adjust_brightness(img, 1.0), img)
+        bright = T.adjust_brightness(img, 2.0)
+        assert bright.mean() > img.mean()
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   atol=1.0)
+        flat = T.adjust_contrast(img, 0.0)
+        assert flat.std() < 1.0
+
+    def test_hue_roundtrip(self):
+        img = (RNG.random((6, 8, 3)) * 255).astype(np.uint8)
+        back = T.adjust_hue(T.adjust_hue(img, 0.3), -0.3)
+        assert np.abs(back.astype(int) - img.astype(int)).mean() < 6
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_pad_modes_and_rotate(self):
+        img = (RNG.random((6, 8, 3)) * 255).astype(np.uint8)
+        assert T.pad(img, 2).shape == (10, 12, 3)
+        assert T.pad(img, (1, 2)).shape == (10, 10, 3)
+        assert T.pad(img, (1, 2, 3, 4)).shape == (12, 12, 3)
+        assert T.pad(img, 2, padding_mode="reflect").shape == (10, 12, 3)
+        r = T.rotate(img, 90)
+        assert r.shape == (6, 8, 3)
+        np.testing.assert_allclose(T.rotate(img, 0), img)
+        assert T.rotate(img, 45, expand=True).shape[0] > 6
+
+    def test_grayscale_and_random_rotation(self):
+        img = (RNG.random((6, 8, 3)) * 255).astype(np.uint8)
+        assert T.to_grayscale(img).shape == (6, 8, 1)
+        assert T.to_grayscale(img, 3).shape == (6, 8, 3)
+        rr = T.RandomRotation(15)
+        assert rr(img).shape == (6, 8, 3)
+        with pytest.raises(ValueError):
+            T.RandomRotation(-3)
+
+
+class TestResNeXt:
+    def test_forward_and_grouped_width(self):
+        m = paddle.vision.models.resnext50_32x4d(num_classes=10)
+        x = _t(RNG.random((1, 3, 64, 64)).astype(np.float32))
+        assert m(x).shape == [1, 10]
+        assert m.cardinality == 32
+        # 32x4d bottleneck widens 64->128 in stage 1
+        names = dict(m.named_parameters())
+        assert any(p.shape[:1] == [128] or p.shape[:1] == (128,)
+                   for p in m.parameters())
+
+    def test_factories_exist(self):
+        for n in ["resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+                  "resnext101_64x4d", "resnext152_32x4d",
+                  "resnext152_64x4d", "ResNeXt"]:
+            assert hasattr(paddle.vision.models, n)
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            paddle.vision.models.resnext50_32x4d(pretrained=True)
+
+
+class TestWholeSurfaceParity:
+    def test_no_missing_names_vs_reference_inventory(self):
+        """The full extracted reference __all__ inventory resolves."""
+        import importlib
+        import json
+        import os
+
+        inv = os.path.join(os.path.dirname(__file__),
+                           "ref_api_inventory.json")
+        ref = json.load(open(inv))
+        missing = {}
+        for ns, names in ref.items():
+            if not names:
+                continue
+            mod = importlib.import_module(
+                ns.replace("paddle", "paddle_tpu", 1))
+            miss = [n for n in names if not hasattr(mod, n)]
+            if miss:
+                missing[ns] = miss
+        assert not missing, missing
