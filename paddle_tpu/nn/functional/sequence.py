@@ -168,7 +168,7 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     Returns (distances [B, 1] float32, sequence_num [1] int64). With
     ``normalized`` each distance is divided by the reference length.
     """
-    from ...framework.core import Tensor, apply_op, _is_tracer
+    from ...framework.core import Tensor, apply_op
 
     hyp = input._data if isinstance(input, Tensor) else jnp.asarray(input)
     ref = label._data if isinstance(label, Tensor) else jnp.asarray(label)

@@ -125,3 +125,34 @@ class Profiler:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+def reset_profiler():
+    """Clear accumulated events without changing the collection state
+    (reference fluid/profiler.py:168)."""
+    from ..core import native
+
+    native_reset = getattr(native, "profiler_reset", None)
+    if native_reset is not None:
+        native_reset()
+    _events.clear()
+
+
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Reference fluid/profiler.py:39 wraps nvprof; the TPU analog is the
+    jax profiler trace already driven by start/stop_profiler, so this is a
+    documented alias for porting scripts."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        start_profiler()
+        try:
+            yield
+        finally:
+            stop_profiler()
+
+    return _ctx()
+
+
+npu_profiler = cuda_profiler

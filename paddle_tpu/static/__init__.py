@@ -973,17 +973,20 @@ def _export_cached(feed_vars, fetch_vars, program):
         fetch_vars = [fetch_vars]
     if not isinstance(feed_vars, (list, tuple)):
         feed_vars = [feed_vars]
-    # the key includes parameter BUFFER identities: set_value/static.load
-    # rebind t._data, so weight updates invalidate the cache and the pair
-    # cannot ship stale state
-    key = (tuple(id(v) for v in feed_vars), tuple(id(v) for v in fetch_vars),
-           tuple(id(t._data) for t in prog.all_parameters()))
+    # the cache HOLDS the parameter buffers and compares them by identity:
+    # set_value/static.load rebind t._data, so weight updates invalidate
+    # the cache — and because the references are kept alive, a freed
+    # buffer's id can never be recycled into a false hit
+    key = (tuple(id(v) for v in feed_vars), tuple(id(v) for v in fetch_vars))
+    bufs = [t._data for t in prog.all_parameters()]
     cached = getattr(prog, "_export_cache", None)
-    if cached is not None and cached[0] == key:
-        return cached[1]
+    if (cached is not None and cached[0] == key
+            and len(cached[1]) == len(bufs)
+            and all(a is b for a, b in zip(cached[1], bufs))):
+        return cached[2]
     result = export_fetches(feed_vars, fetch_vars,
                             dynamic_dims=prog.feed_dynamic)
-    prog._export_cache = (key, result)
+    prog._export_cache = (key, bufs, result)
     return result
 
 

@@ -389,9 +389,6 @@ def crf_decoding(input, param_attr, label=None, length=None):  # noqa: A002
     return path
 
 
-_nce_counter = [0]
-
-
 def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
         param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
         sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
@@ -420,8 +417,15 @@ def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
     # frozen-randomness semantics as every random op in a traced Program
     # (see nn/functional/common.py dropout).
     if seed:
-        _nce_counter[0] += 1
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), _nce_counter[0])
+        # per-Program call index (like _uname): rebuilding the same graph
+        # reproduces the same seeded negatives, while repeated eager calls
+        # still advance
+        from . import default_main_program
+
+        prog = default_main_program()
+        idx = getattr(prog, "_nce_counter", 0) + 1
+        prog._nce_counter = idx
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), idx)
     else:
         key = next_key()
     from ..framework.core import Tensor as _T

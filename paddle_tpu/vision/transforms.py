@@ -296,12 +296,20 @@ class SaturationTransform(BaseTransform):
 
 class HueTransform(BaseTransform):
     def __init__(self, value, keys=None):
-        self.value = value
+        if isinstance(value, numbers.Number):
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value must be in [0, 0.5]")
+            self.value = (-float(value), float(value))
+        else:
+            lo, hi = float(value[0]), float(value[1])
+            if not -0.5 <= lo <= hi <= 0.5:
+                raise ValueError("hue range must lie within [-0.5, 0.5]")
+            self.value = (lo, hi)
 
     def _apply_image(self, img):
-        if self.value == 0:
+        if self.value == (0.0, 0.0):
             return img
-        factor = np.random.uniform(-self.value, self.value)
+        factor = np.random.uniform(self.value[0], self.value[1])
         return adjust_hue(img, factor)
 
 

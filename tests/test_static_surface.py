@@ -389,3 +389,53 @@ class TestNameUniquing:
         with pytest.raises(ValueError, match="divisible"):
             F.sequence_reshape(_t(np.ones((2, 4, 4), np.float32)),
                                _t(np.array([1, 2])), 8)
+
+
+class TestPolishRegressions:
+    def test_export_cache_survives_id_reuse(self):
+        """Repeated set_value cycles must not produce a false cache hit
+        (CPython recycles freed buffer ids)."""
+        import pickle
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            y = static.nn.fc(x, 3)
+        s1 = static.serialize_persistables([x], [y], program=prog)
+        p0 = prog.all_parameters()[0]
+        for _ in range(6):
+            p0.set_value(p0.numpy() + 1.0)
+        s2 = static.serialize_persistables([x], [y], program=prog)
+        a1, a2 = pickle.loads(s1), pickle.loads(s2)
+        assert any(not np.allclose(u, v) for u, v in zip(a1, a2))
+
+    def test_nce_seeded_rebuild_reproduces(self):
+        def build():
+            paddle.seed(42)
+            pr = static.Program()
+            with static.program_guard(pr):
+                xv = static.data("x", [-1, 4], "float32")
+                o = static.nn.nce(xv, static.data("l", [-1, 1], "int64"),
+                                  20, num_neg_samples=4, seed=7)
+            return pr, o
+
+        pr1, o1 = build()
+        pr2, o2 = build()
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32), "l": np.array([[1], [2]])}
+        np.testing.assert_allclose(
+            exe.run(pr1, feed=feed, fetch_list=[o1])[0],
+            exe.run(pr2, feed=feed, fetch_list=[o2])[0])
+
+    def test_reset_profiler_keeps_state(self):
+        import paddle_tpu.profiler as prof
+
+        prof.reset_profiler()
+        assert not prof._active[0]
+
+    def test_hue_transform_validation(self):
+        from paddle_tpu.vision import transforms as TT
+
+        TT.HueTransform((0.1, 0.3))
+        with pytest.raises(ValueError):
+            TT.HueTransform(0.7)
