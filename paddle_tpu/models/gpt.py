@@ -180,11 +180,14 @@ def _attention(cfg: GPTConfig, q, k, v):
         return ring_attention_sharded(q, k, v, causal=True, scale=scale,
                                       seq_axis=cfg.seq_axis,
                                       batch_axis="data", head_axis="model")
-    # auto: measured crossover on v5e — XLA's fused attention wins at seq
-    # 512 (219 vs 214 sps BERT-base), the Pallas flash kernel wins at 2048
-    # (38.1 vs 26.0 sps, +47%); see bench.py flash_ab
+    # auto: measured on v5e — flash wins at seq >= 1024 always, and at 512
+    # whenever remat is off (278 vs 260 sps BERT-base; the 512 loss only
+    # appears under remat, which recomputes the fused kernel in the
+    # backward); see bench.py flash_ab + tools/exp_bert.py
     use_flash = (cfg.use_flash if cfg.use_flash is not None
-                 else (_on_tpu() and q.shape[2] >= 1024))
+                 else (_on_tpu() and (q.shape[2] >= 1024
+                                      or (q.shape[2] >= 512
+                                          and not cfg.remat))))
     if use_flash:
         from ..ops.flash_attention import flash_attention_arrays
         return flash_attention_arrays(q, k, v, causal=True, scale=scale)

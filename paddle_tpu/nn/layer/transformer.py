@@ -93,24 +93,20 @@ class MultiHeadAttention(Layer):
         return self.Cache(k, v)
 
     def core_attention(self, q, k, v, attn_mask=None):
-        # length-based auto-dispatch: at seq >= 1024 the Pallas flash
-        # kernel beats XLA's fused attention on v5e (bench.py flash_ab:
-        # 41.4 vs 24.8 sps at 2048); below that XLA wins, and flash cannot
-        # produce the weights matrix or apply an arbitrary additive mask,
-        # so those paths keep the dense softmax.
+        # length-based auto-dispatch: the Pallas flash kernel beats XLA's
+        # fused attention on v5e from seq 512 up (bench.py flash_ab: 278
+        # vs 260 sps at 512, 41.4 vs 24.8 at 2048 — measured without
+        # remat, which is the eager-layer case); flash cannot produce the
+        # weights matrix or apply an arbitrary additive mask, so those
+        # paths keep the dense softmax.
         if (attn_mask is None and not self.need_weights and not self.dropout
-                and q.shape[2] == k.shape[2] and q.shape[2] >= 1024):
+                and q.shape[2] == k.shape[2] and q.shape[2] >= 512):
             from ...ops.flash_attention import _on_tpu
 
             if _on_tpu():
-                from ...ops.flash_attention import flash_attention_arrays
-                from ...framework.core import apply_op
+                from ...ops.flash_attention import flash_attention
 
-                out = apply_op(flash_attention_arrays, q, k, v,
-                               causal=False,
-                               scale=float(self.head_dim ** -0.5),
-                               op_name="flash_attention")
-                return out, None
+                return flash_attention(q, k, v, causal=False), None
         product = matmul(q, k, transpose_y=True) * (self.head_dim ** -0.5)
         if attn_mask is not None:
             product = product + attn_mask

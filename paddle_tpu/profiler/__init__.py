@@ -14,7 +14,8 @@ from collections import defaultdict
 import jax
 
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
-           "Profiler", "summary"]
+           "Profiler", "summary", "reset_profiler", "cuda_profiler", "npu_profiler",
+]
 
 _events = defaultdict(list)
 _active = [False]

@@ -973,20 +973,32 @@ def _export_cached(feed_vars, fetch_vars, program):
         fetch_vars = [fetch_vars]
     if not isinstance(feed_vars, (list, tuple)):
         feed_vars = [feed_vars]
-    # the cache HOLDS the parameter buffers and compares them by identity:
-    # set_value/static.load rebind t._data, so weight updates invalidate
-    # the cache — and because the references are kept alive, a freed
-    # buffer's id can never be recycled into a false hit
-    key = (tuple(id(v) for v in feed_vars), tuple(id(v) for v in fetch_vars))
+    # identity-compared cache with no id() keys: feed/fetch var objects
+    # are held strongly (tiny wrappers, prevents address-recycling false
+    # hits) and parameter buffers via weakref (set_value rebinds t._data,
+    # so updates invalidate the cache, and a dead ref is a miss instead
+    # of pinning a stale model copy in device memory)
+    import weakref
+
     bufs = [t._data for t in prog.all_parameters()]
     cached = getattr(prog, "_export_cache", None)
-    if (cached is not None and cached[0] == key
-            and len(cached[1]) == len(bufs)
-            and all(a is b for a, b in zip(cached[1], bufs))):
-        return cached[2]
+    if cached is not None:
+        c_feeds, c_fetches, c_refs, c_result = cached
+        c_bufs = [r() for r in c_refs]
+        if (len(c_feeds) == len(feed_vars) and len(c_fetches) == len(fetch_vars)
+                and all(a is b for a, b in zip(c_feeds, feed_vars))
+                and all(a is b for a, b in zip(c_fetches, fetch_vars))
+                and len(c_bufs) == len(bufs)
+                and all(a is not None and a is b
+                        for a, b in zip(c_bufs, bufs))):
+            return c_result
     result = export_fetches(feed_vars, fetch_vars,
                             dynamic_dims=prog.feed_dynamic)
-    prog._export_cache = (key, bufs, result)
+    try:
+        refs = [weakref.ref(b) for b in bufs]
+    except TypeError:
+        refs = [(lambda v: (lambda: v))(b) for b in bufs]  # non-weakrefable
+    prog._export_cache = (list(feed_vars), list(fetch_vars), refs, result)
     return result
 
 

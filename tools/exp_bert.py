@@ -1,8 +1,11 @@
 """Experiment: BERT-base xla_512 throughput vs (batch, remat, loss_chunk)."""
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(batch, remat, loss_chunk, K=10):
