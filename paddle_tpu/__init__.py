@@ -80,6 +80,7 @@ from . import ops  # noqa: F401
 from . import distribution  # noqa: F401
 from . import onnx  # noqa: F401
 from . import fft  # noqa: F401
+from . import fluid  # noqa: F401
 # NOT `from . import linalg`: the tensor star-import above already bound
 # `linalg` to tensor.linalg, which would stop the submodule import; the
 # absolute import always loads paddle_tpu/linalg.py and rebinds the attr.

@@ -1,0 +1,6 @@
+"""fluid.clip compatibility (reference fluid/clip.py)."""
+from ..nn import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,101 @@
+"""fluid.dygraph compatibility (reference python/paddle/fluid/dygraph/)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..framework.core import Tensor, grad, no_grad  # noqa: F401
+from ..nn import (  # noqa: F401
+    BatchNorm, Dropout, GroupNorm, InstanceNorm2D, Layer,
+    LayerList, LayerNorm, ParameterList, Sequential, SpectralNorm,
+)
+from ..nn import Embedding as _Embedding2
+from ..nn import Linear as _Linear2
+from ..nn import Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from ..nn import DataParallel  # noqa: F401
+from ..distributed import ParallelEnv  # noqa: F401
+from ..jit import ProgramTranslator, TracedLayer, to_static  # noqa: F401
+from ..optimizer.lr import LRScheduler as LearningRateDecay  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard: eager IS the default mode here; the guard
+    only ensures static mode is off within the block."""
+    from .. import disable_static, enable_static
+    from ..static import _static_mode
+
+    was_static = _static_mode[0]
+    disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    from ..framework.core import to_tensor
+
+    return to_tensor(np.asarray(value), dtype=dtype, stop_gradient=True)
+
+
+def enabled():
+    from .. import in_dynamic_mode
+
+    return in_dynamic_mode()
+
+
+def save_dygraph(state_dict, model_path):
+    """Suffix rule mirrors the reference (dygraph/checkpoint.py): a dict
+    containing Parameters is the model (.pdparams); anything else —
+    optimizer slots, empty SGD state — is .pdopt, so saving both under
+    one prefix never clobbers the weights."""
+    from ..framework.core import Parameter
+    from ..framework.io import save
+
+    is_params = any(isinstance(v, Parameter) for v in state_dict.values())
+    save(state_dict, model_path + (".pdparams" if is_params else ".pdopt"))
+
+
+def load_dygraph(model_path):
+    import os
+
+    from ..framework.io import load
+
+    params = load(model_path + ".pdparams") \
+        if os.path.exists(model_path + ".pdparams") else None
+    opt = load(model_path + ".pdopt") \
+        if os.path.exists(model_path + ".pdopt") else None
+    return params, opt
+
+
+class Linear(_Linear2):
+    """fluid.dygraph.Linear(input_dim, output_dim, param_attr, bias_attr,
+    act, dtype) — the 1.x signature carries an activation."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(input_dim, output_dim, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Embedding(_Embedding2):
+    """fluid.dygraph.Embedding(size=[vocab, dim], ...) — 1.x passes the
+    table shape as one list."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(int(size[0]), int(size[1]),
+                         padding_idx=padding_idx, sparse=is_sparse,
+                         weight_attr=param_attr)

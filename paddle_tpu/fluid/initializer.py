@@ -1,0 +1,15 @@
+"""fluid.initializer compatibility (reference fluid/initializer.py)."""
+from ..nn.initializer import (  # noqa: F401
+    Assign, Bilinear, Constant, Dirac, KaimingNormal, KaimingUniform,
+    Normal, Orthogonal, TruncatedNormal, Uniform, XavierNormal,
+    XavierUniform, set_global_initializer,
+)
+from ..nn.initializer import (  # noqa: F401
+    ConstantInitializer, MSRAInitializer, NormalInitializer,
+    NumpyArrayInitializer, TruncatedNormalInitializer, UniformInitializer,
+    XavierInitializer,
+)
+
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+BilinearInitializer = Bilinear

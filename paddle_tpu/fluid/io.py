@@ -1,0 +1,56 @@
+"""fluid.io compatibility (reference fluid/io.py): the 1.x dirname-based
+save/load_inference_model conventions over the 2.x artifact format."""
+from __future__ import annotations
+
+import os
+
+from ..framework.io import load, save  # noqa: F401
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kwargs):
+    from ..static import default_main_program
+    from ..static import save_inference_model as _save
+
+    prog = main_program or default_main_program()
+    # 1.x passes feed NAMES; resolve them against the program's recorded
+    # feed placeholders (static.data registers into prog.feed_vars)
+    feeds = []
+    for n in feeded_var_names:
+        if isinstance(n, str):
+            if n not in prog.feed_vars:
+                raise KeyError(
+                    "save_inference_model: feed name %r is not a "
+                    "fluid.data placeholder of this program" % (n,))
+            feeds.append(prog.feed_vars[n])
+        else:
+            feeds.append(n)
+    os.makedirs(dirname, exist_ok=True)
+    _save(os.path.join(dirname, "model"), feeds, target_vars, executor,
+          program=prog)
+    return feeded_var_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, **kwargs):
+    from ..static import load_inference_model as _load
+
+    return _load(os.path.join(dirname, "model"), executor)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+    from ..static import save as _save
+
+    os.makedirs(dirname, exist_ok=True)
+    _save(main_program or default_main_program(),
+          os.path.join(dirname, "persist"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+    from ..static import load as _loadp
+
+    _loadp(main_program or default_main_program(),
+           os.path.join(dirname, "persist"))

@@ -1,0 +1,433 @@
+"""fluid.layers compatibility surface (reference python/paddle/fluid/layers/).
+
+Re-exports the 2.x ops under their fluid-1.x names, with thin adapters
+where the fluid signature differs (reduce_* dim/keep_dim, elementwise_*
+axis broadcasting, probability-input cross_entropy, expand's repeat-times
+semantics, 2-D flatten). LoD-coupled ops (dynamic_lstm/dynamic_gru,
+lod_reset, op-level beam_search) follow the padded-dense decision in the
+README — their replacements are paddle.nn.RNN/LSTM/GRU, the lengths-based
+sequence ops, and nn.decode.BeamSearchDecoder/dynamic_decode.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op
+from .. import tensor as _T
+from ..nn import functional as _F
+from ..static import accuracy, auc, py_func, Print  # noqa: F401
+from ..static.nn import (  # noqa: F401
+    batch_norm, bilinear_tensor_product, case, cond, conv2d,
+    conv2d_transpose, conv3d, conv3d_transpose, crf_decoding, data_norm,
+    deform_conv2d, embedding, group_norm, instance_norm, layer_norm,
+    multi_box_head, nce, prelu, row_conv, sequence_concat, sequence_conv,
+    sequence_enumerate, sequence_expand, sequence_expand_as,
+    sequence_first_step, sequence_last_step, sequence_pad, sequence_pool,
+    sequence_reshape, sequence_reverse, sequence_scatter, sequence_slice,
+    sequence_softmax, sequence_unpad, sparse_embedding, spectral_norm,
+    switch_case, while_loop,
+)
+from ..static import create_global_var  # noqa: F401
+from ..tensor.creation import create_parameter  # noqa: F401
+
+# direct re-exports: same name, same semantics
+from ..tensor import (  # noqa: F401
+    abs, cast, ceil, clip, concat, cos, cumsum, equal, exp, floor, gather,
+    gather_nd, greater_equal, greater_than, increment, less_equal,
+    less_than, log, logical_and, logical_not, logical_or, logical_xor,
+    not_equal, ones, ones_like, pow, reciprocal, round, rsqrt, scale,
+    scatter, shard_index, sign, sin, slice, sqrt, square, squeeze, stack,
+    tanh, transpose, unsqueeze, unstack, zeros, zeros_like, shape,
+    reverse, scatter_nd, scatter_nd_add, argmax, argmin, argsort, sort,
+    topk, nonzero, split,
+)
+from ..nn.functional import (  # noqa: F401
+    elu, gelu, hardshrink, hardsigmoid, hardswish, leaky_relu, log_loss,
+    log_softmax, maxout, relu, relu6, selu, sigmoid, softmax, softplus,
+    softshrink, softsign, swish, thresholded_relu, label_smooth,
+    sigmoid_focal_loss, square_error_cost, softmax_with_cross_entropy,
+    gather_tree, temporal_shift, affine_grid, one_hot,
+    kl_div, npair_loss, edit_distance, sequence_mask, unfold,
+    pixel_shuffle,
+)
+from ..nn.functional import grid_sample as grid_sampler  # noqa: F401
+from ..vision.ops import (  # noqa: F401
+    anchor_generator, box_clip, box_coder, bipartite_match,
+    collect_fpn_proposals, distribute_fpn_proposals, generate_proposals,
+    iou_similarity, matrix_nms, multiclass_nms, prior_box, psroi_pool,
+    roi_align, roi_pool, yolo_box,
+)
+from ..vision.ops import yolo_loss as yolov3_loss  # noqa: F401
+from ..text import viterbi_decode  # noqa: F401
+
+
+def fc(input=None, size=None, num_flatten_dims=1, param_attr=None,  # noqa: A002
+       bias_attr=None, act=None, name=None, x=None, activation=None,
+       weight_attr=None):
+    """1.x fc spelling (input=/act=/param_attr=) over static.nn.fc."""
+    from ..static.nn import fc as _fc
+
+    return _fc(input if input is not None else x, size,
+               num_flatten_dims=num_flatten_dims,
+               weight_attr=param_attr if param_attr is not None else weight_attr,
+               bias_attr=bias_attr,
+               activation=act if act is not None else activation, name=name)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    from ..tensor.creation import full
+
+    return full(shape, value, dtype=dtype)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,  # noqa: A002
+                                  input_dim_idx=0, output_dim_idx=0):
+    from ..tensor.creation import full
+
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return full(shape, value, dtype=dtype)
+
+
+def assign(input, output=None):  # noqa: A002
+    from ..tensor.creation import assign as _assign
+
+    return _assign(input, output)
+
+
+def _reduce(fn, input, dim, keep_dim):  # noqa: A002
+    if isinstance(dim, (list, tuple)):
+        dim = [int(d) for d in dim]
+    return fn(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.sum, input, dim, keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.mean, input, dim, keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.max, input, dim, keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.min, input, dim, keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.prod, input, dim, keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.all, input, dim, keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce(_T.any, input, dim, keep_dim)
+
+
+def mean(x, name=None):
+    return _T.mean(x)
+
+
+def _ew_axis(y, x_ndim, axis):
+    """fluid elementwise axis semantics: y's dims align to x starting at
+    ``axis`` (elementwise_op_function.h GetMidDims)."""
+    if axis == -1 or y.ndim == x_ndim:
+        return y
+    pad_right = x_ndim - axis - y.ndim
+    return _T.reshape(y, list(y.shape) + [1] * pad_right)
+
+
+def _make_elementwise(fn, name):
+    def op(x, y, axis=-1, act=None, name=None):
+        out = fn(x, _ew_axis(y, x.ndim, axis))
+        if act is not None:
+            out = getattr(_F, act)(out)
+        return out
+
+    op.__name__ = name
+    return op
+
+
+elementwise_add = _make_elementwise(_T.add, "elementwise_add")
+elementwise_sub = _make_elementwise(_T.subtract, "elementwise_sub")
+elementwise_mul = _make_elementwise(_T.multiply, "elementwise_mul")
+elementwise_div = _make_elementwise(_T.divide, "elementwise_div")
+elementwise_max = _make_elementwise(_T.maximum, "elementwise_max")
+elementwise_min = _make_elementwise(_T.minimum, "elementwise_min")
+elementwise_pow = _make_elementwise(_T.pow, "elementwise_pow")
+elementwise_mod = _make_elementwise(_T.remainder, "elementwise_mod")
+elementwise_floordiv = _make_elementwise(_T.floor_divide,
+                                         "elementwise_floordiv")
+
+
+def _mul_impl(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    xm = x.reshape((int(np.prod(x.shape[:x_num_col_dims])), -1))
+    ym = y.reshape((int(np.prod(y.shape[:y_num_col_dims])), -1))
+    out = xm @ ym
+    # mul_op shape inference: x.shape[:xd] + y.shape[yd:]
+    return out.reshape(x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:])
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """fluid.layers.mul (mul_op.cc): flatten both sides to 2-D and matmul."""
+    return apply_op(_mul_impl, x, y, x_num_col_dims=int(x_num_col_dims),
+                    y_num_col_dims=int(y_num_col_dims), op_name="mul")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    out = _T.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def _ce_soft_impl(p, l):
+    return -jnp.sum(l * jnp.log(jnp.maximum(p, 1e-20)), axis=-1,
+                    keepdims=True)
+
+
+def _ce_prob_impl(p, label, ignore_index):
+    lab = label.reshape(p.shape[:-1])
+    picked = jnp.take_along_axis(p, lab[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    loss = jnp.where(lab == ignore_index, 0.0, loss)
+    return loss[..., None]
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):  # noqa: A002
+    """fluid cross_entropy takes PROBABILITIES (post-softmax), unlike 2.x
+    F.cross_entropy's logits (reference cross_entropy_op.h)."""
+    if soft_label:
+        return apply_op(_ce_soft_impl, input, label,
+                        op_name="cross_entropy_soft")
+    return apply_op(_ce_prob_impl, input, label,
+                    ignore_index=int(ignore_index), op_name="cross_entropy")
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else "upscale_in_train")
+    return _F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    if global_pooling:
+        if pool_type == "max":
+            return _F.adaptive_max_pool2d(input, 1)
+        return _F.adaptive_avg_pool2d(input, 1)
+    if pool_type == "max":
+        return _F.max_pool2d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode)
+    return _F.avg_pool2d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive)
+
+
+def flatten(x, axis=1, name=None):
+    """fluid flatten → 2-D [prod(shape[:axis]), prod(shape[axis:])]."""
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return _T.reshape(x, [lead, -1])
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):  # noqa: A002
+    out = _T.reshape(x, shape)
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def expand(x, expand_times, name=None):
+    """fluid expand repeats each dim ``expand_times[i]`` times (2.x tile)."""
+    return _T.tile(x, expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _T.expand_as(x, target_tensor)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,  # noqa: A002
+                   name=None):
+    from ..tensor.random import uniform
+
+    return uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    from ..tensor.random import normal
+
+    out = normal(mean=mean, std=std, shape=shape)
+    return _T.cast(out, dtype) if str(out.dtype) != dtype else out
+
+
+def range(start, end, step, dtype, name=None):  # noqa: A002
+    from ..tensor.creation import arange
+
+    return arange(start, end, step, dtype)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    from ..tensor.creation import linspace as _linspace
+
+    return _linspace(start, stop, num, dtype)
+
+
+def _smooth_l1_impl(x, y, ow, sigma2):
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / sigma2, 0.5 * d * d * sigma2,
+                     ad - 0.5 / sigma2)
+    loss = loss * ow  # elementwise, BEFORE the per-row sum (smooth_l1_op.h)
+    return jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """smooth_l1_op.cc: per-row summed smooth-L1; inside_weight scales the
+    diff, outside_weight scales each element's loss."""
+    sigma2 = (sigma if sigma is not None else 1.0) ** 2
+    if inside_weight is not None:
+        x = _T.multiply(x, inside_weight)
+        y = _T.multiply(y, inside_weight)
+    if outside_weight is None:
+        outside_weight = Tensor(jnp.ones((1, 1), jnp.float32))
+    return apply_op(_smooth_l1_impl, x, y, outside_weight,
+                    sigma2=float(sigma2), op_name="smooth_l1")
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False, name=None):
+    def _impl(x, lab, ignore_index, normalize):
+        loss = jnp.maximum(x, 0.0) - x * lab + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        keep = lab != ignore_index
+        loss = jnp.where(keep, loss, 0.0)
+        if normalize:
+            loss = loss / jnp.maximum(jnp.sum(keep), 1)
+        return loss
+
+    return apply_op(_impl, x, label, ignore_index=int(ignore_index),
+                    normalize=bool(normalize),
+                    op_name="sigmoid_cross_entropy_with_logits")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def _impl(x, max_norm):
+        n = jnp.sqrt(jnp.sum(x * x))
+        return jnp.where(n > max_norm, x * (max_norm / n), x)
+
+    return apply_op(_impl, x, max_norm=float(max_norm),
+                    op_name="clip_by_norm")
+
+
+def where(condition):
+    """fluid.layers.where = indices of True (2.x nonzero)."""
+    return _T.nonzero(condition)
+
+
+def has_nan(x):
+    return _T.any(_T.isnan(x))
+
+
+def has_inf(x):
+    return _T.any(_T.isinf(x))
+
+
+def isfinite(x):
+    return _T.all(_T.isfinite(x))
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                    align_mode=1, data_format="NCHW", name=None):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="bilinear", align_corners=align_corners)
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                   data_format="NCHW", name=None):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="nearest")
+
+
+def _pad_impl(x, paddings, pad_value):
+    pw = []
+    for i in builtins.range(x.ndim):
+        pw.append((paddings[2 * i], paddings[2 * i + 1]))
+    return jnp.pad(x, pw, constant_values=pad_value)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    """fluid pad: flat (before, after) per dim."""
+    pw = tuple(int(p) for p in paddings)
+    return apply_op(_pad_impl, x, paddings=pw, pad_value=float(pad_value),
+                    op_name="pad")
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _F.hardsigmoid(x, slope=slope, offset=offset)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _F.hardtanh(x, min=t_min, max=t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return apply_op(
+        lambda x, threshold: jnp.log1p(jnp.exp(jnp.clip(x, -threshold,
+                                                        threshold))),
+        x, threshold=float(threshold), op_name="soft_relu")
+
+
+def relu_clipped(x, threshold=6.0, name=None):
+    return _T.clip(_F.relu(x), 0.0, threshold)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    def _impl(x, axis, epsilon):
+        n = jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True),
+                                 epsilon))
+        return x / n
+
+    return apply_op(_impl, x, axis=int(axis), epsilon=float(epsilon),
+                    op_name="l2_normalize")
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    from ..framework import dtype as dtypes
+
+    return Tensor(jnp.zeros((), dtypes.convert_dtype(dtype)), name=name)
+
+
+def array_write(x, i, array=None):
+    """LoDTensorArray shim: python list + index (control-flow arrays are
+    lax.scan carries in compiled code; this covers eager parity tests)."""
+    if array is None:
+        array = []
+    idx = int(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def create_array(dtype):
+    return []

@@ -1,0 +1,21 @@
+"""fluid.optimizer compatibility: the 1.x *Optimizer class names
+(reference python/paddle/fluid/optimizer.py)."""
+from ..optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, DecayedAdagrad, Dpsgd, Ftrl,
+    Lamb, LarsMomentum, Momentum, RMSProp, SGD, ExponentialMovingAverage,
+)
+from ..incubate import LookAhead as LookaheadOptimizer  # noqa: F401
+from ..incubate import ModelAverage  # noqa: F401
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DpsgdOptimizer = Dpsgd
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum

@@ -1,0 +1,5 @@
+"""fluid.profiler compatibility (reference fluid/profiler.py)."""
+from ..profiler import (  # noqa: F401
+    cuda_profiler, npu_profiler, profiler, reset_profiler, start_profiler,
+    stop_profiler,
+)

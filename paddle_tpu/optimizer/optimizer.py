@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Parameter, Tensor, backward
+from ..framework.core import Parameter, Tensor
 from ..regularizer import L1Decay, L2Decay
 from .lr import LRScheduler
 
@@ -147,7 +147,10 @@ class Optimizer:
                 or default_main_program()
             prog.train_specs.append((self, loss))
             return None, []
-        backward(loss)
+        # eager: the reference's dygraph minimize HARVESTS grads already
+        # produced by loss.backward() (Optimizer.backward in dygraph mode
+        # only collects param._grad_ivar()); it never runs autograd
+        # itself. Call loss.backward() first, exactly like the reference.
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]
 

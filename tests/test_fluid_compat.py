@@ -1,0 +1,262 @@
+"""paddle.fluid 1.x compatibility namespace (reference python/paddle/fluid):
+a reference-era script should run with only the top-level import rename.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+fluid = paddle.fluid
+
+RNG = np.random.default_rng(41)
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+class TestStaticStyle:
+    def test_fc_regression_script(self):
+        """Canonical fluid 1.x static training block."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[-1, 13], dtype="float32")
+            y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+            hidden = fluid.layers.fc(x, size=32, activation="relu")
+            pred = fluid.layers.fc(hidden, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=0.01)
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"x": RNG.random((8, 13)).astype("float32"),
+                "y": RNG.random((8, 1)).astype("float32")}
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]  # training happens
+
+    def test_places_and_scope(self):
+        assert isinstance(fluid.CPUPlace(), object)
+        with fluid.scope_guard(fluid.Scope()):
+            pass
+
+
+class TestDygraphStyle:
+    def test_guard_linear_backward_minimize(self):
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(5, 3, act="relu")
+            opt = fluid.optimizer.AdamOptimizer(
+                learning_rate=0.1, parameters=lin.parameters())
+            v = fluid.dygraph.to_variable(
+                RNG.standard_normal((4, 5)).astype("float32"))
+            before = lin.weight.numpy().copy()
+            loss = fluid.layers.reduce_mean(lin(v) ** 2)
+            loss.backward()
+            opt.minimize(loss)
+            opt.clear_gradients()
+            assert not np.allclose(lin.weight.numpy(), before)
+
+    def test_embedding_size_list_and_save_load(self, tmp_path):
+        with fluid.dygraph.guard():
+            emb = fluid.dygraph.Embedding(size=[10, 4])
+            out = emb(_t(np.array([[1, 2], [3, 0]])))
+            assert out.shape == [2, 2, 4]
+            fluid.dygraph.save_dygraph(emb.state_dict(),
+                                       str(tmp_path / "m"))
+            params, opt = fluid.dygraph.load_dygraph(str(tmp_path / "m"))
+            assert params is not None and "weight" in params
+
+    def test_to_variable_and_enabled(self):
+        v = fluid.dygraph.to_variable(np.ones(3, np.float32))
+        assert isinstance(v, paddle.Tensor)
+        with fluid.dygraph.guard():
+            assert fluid.dygraph.enabled()
+
+
+class TestLayerAdapters:
+    def test_reduce_family(self):
+        t = _t(RNG.random((2, 3, 4)).astype("float32"))
+        assert fluid.layers.reduce_sum(t, dim=1).shape == [2, 4]
+        assert fluid.layers.reduce_mean(t, dim=[1, 2],
+                                        keep_dim=True).shape == [2, 1, 1]
+        np.testing.assert_allclose(float(fluid.layers.reduce_max(t)),
+                                   t.numpy().max(), rtol=1e-6)
+
+    def test_elementwise_axis_broadcast(self):
+        t = _t(RNG.random((2, 3, 4)).astype("float32"))
+        b = _t(RNG.random((3,)).astype("float32"))
+        got = fluid.layers.elementwise_add(t, b, axis=1).numpy()
+        np.testing.assert_allclose(got, t.numpy() + b.numpy()[None, :, None],
+                                   rtol=1e-6)
+        got2 = fluid.layers.elementwise_mul(t, b, axis=1, act="relu").numpy()
+        assert (got2 >= 0).all()
+
+    def test_cross_entropy_takes_probabilities(self):
+        probs = _t(np.full((2, 4), 0.25, np.float32))
+        lab = _t(np.array([[1], [2]]))
+        np.testing.assert_allclose(
+            fluid.layers.cross_entropy(probs, lab).numpy(), np.log(4),
+            rtol=1e-5)
+        soft = fluid.layers.cross_entropy(
+            probs, _t(np.full((2, 4), 0.25, np.float32)), soft_label=True)
+        np.testing.assert_allclose(soft.numpy(), np.log(4), rtol=1e-5)
+
+    def test_mul_flatten_and_matmul_alpha(self):
+        t = _t(RNG.random((2, 3, 4)).astype("float32"))
+        w = _t(RNG.random((12, 7)).astype("float32"))
+        np.testing.assert_allclose(
+            fluid.layers.mul(t, w).numpy(),
+            t.numpy().reshape(2, 12) @ w.numpy(), rtol=1e-4)
+        a = _t(RNG.random((2, 3)).astype("float32"))
+        b = _t(RNG.random((3, 2)).astype("float32"))
+        np.testing.assert_allclose(
+            fluid.layers.matmul(a, b, alpha=2.0).numpy(),
+            2 * a.numpy() @ b.numpy(), rtol=1e-5)
+
+    def test_expand_flatten_fill(self):
+        b = _t(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(fluid.layers.expand(b, [3]).numpy(),
+                                   np.tile(b.numpy(), 3))
+        t = _t(RNG.random((2, 3, 4)).astype("float32"))
+        assert fluid.layers.flatten(t, axis=2).shape == [6, 4]
+        np.testing.assert_allclose(
+            fluid.layers.fill_constant([2, 2], "float32", 3.0).numpy(), 3.0)
+        z = fluid.layers.fill_constant_batch_size_like(t, [-1, 5],
+                                                       "float32", 1.0)
+        assert z.shape == [2, 5]
+
+    def test_dropout_modes_and_pool(self):
+        t = _t(np.ones((2, 8), np.float32))
+        # downgrade_in_infer: inference scales by (1-p) — the 1.x default
+        out = fluid.layers.dropout(t, 0.5, is_test=True)
+        np.testing.assert_allclose(out.numpy(), 0.5)
+        img = _t(RNG.random((1, 2, 8, 8)).astype("float32"))
+        assert fluid.layers.pool2d(img, 2, "max", 2).shape == [1, 2, 4, 4]
+        assert fluid.layers.pool2d(img, global_pooling=True).shape \
+            == [1, 2, 1, 1]
+
+    def test_misc_ops(self):
+        t = _t(RNG.random((2, 3)).astype("float32"))
+        assert fluid.layers.where(
+            _t(np.array([True, False, True]))).shape[0] == 2
+        np.testing.assert_allclose(
+            fluid.layers.l2_normalize(t, axis=1).numpy(),
+            t.numpy() / np.linalg.norm(t.numpy(), axis=1, keepdims=True),
+            rtol=1e-5)
+        assert not bool(fluid.layers.has_nan(t))
+        x = _t(np.array([3.0, 4.0], np.float32))
+        np.testing.assert_allclose(
+            fluid.layers.clip_by_norm(x, 1.0).numpy(), [0.6, 0.8], rtol=1e-5)
+        p = fluid.layers.pad(t, [1, 1, 0, 0], pad_value=9.0)
+        assert p.shape == [4, 3] and p.numpy()[0, 0] == 9.0
+        sl1 = fluid.layers.smooth_l1(t, t * 0.0)
+        assert sl1.shape == [2, 1]
+        logits = _t(np.array([[2.0, -1.0]], np.float32))
+        scel = fluid.layers.sigmoid_cross_entropy_with_logits(
+            logits, _t(np.array([[1.0, 0.0]], np.float32)))
+        assert np.isfinite(scel.numpy()).all()
+
+    def test_array_ops(self):
+        arr = fluid.layers.create_array("float32")
+        i = _t(np.array(0, np.int64))
+        arr = fluid.layers.array_write(_t(np.ones(2, np.float32)), i, arr)
+        got = fluid.layers.array_read(arr, i)
+        np.testing.assert_allclose(got.numpy(), 1.0)
+        assert int(fluid.layers.array_length(arr)) == 1
+
+
+class TestSubmodules:
+    def test_initializer_regularizer_clip_aliases(self):
+        assert fluid.initializer.Xavier is fluid.initializer.XavierInitializer
+        assert fluid.regularizer.L2DecayRegularizer is \
+            fluid.regularizer.L2Decay
+        clip = fluid.clip.GradientClipByGlobalNorm(1.0)
+        assert clip is not None
+
+    def test_optimizer_aliases(self):
+        for n in ["SGDOptimizer", "MomentumOptimizer", "AdamOptimizer",
+                  "AdamaxOptimizer", "RMSPropOptimizer", "FtrlOptimizer",
+                  "LambOptimizer", "LarsMomentumOptimizer",
+                  "AdadeltaOptimizer", "DecayedAdagradOptimizer"]:
+            assert hasattr(fluid.optimizer, n), n
+
+    def test_io_dirname_roundtrip(self, tmp_path):
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = fluid.data(name="x", shape=[-1, 4], dtype="float32")
+            y = fluid.layers.fc(x, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"x": np.ones((2, 4), np.float32)}
+        want = exe.run(main, feed=feed, fetch_list=[y])[0]
+        d = str(tmp_path / "model_dir")
+        fluid.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+        prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        got = exe.run(prog2, feed=feed, fetch_list=fetches)[0]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_data_feeder(self):
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = fluid.data(name="x", shape=[-1, 2], dtype="float32")
+            y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+        feeder = fluid.DataFeeder(feed_list=[x, y])
+        batch = feeder.feed([(np.ones(2, np.float32),
+                              np.zeros(1, np.float32))] * 3)
+        assert batch["x"].shape == (3, 2) and batch["y"].shape == (3, 1)
+        with pytest.raises(TypeError):
+            fluid.data_feeder.check_dtype("int32", "x", ["float32"], "op")
+
+    def test_backward_and_framework(self):
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = fluid.data(name="x", shape=[-1, 2], dtype="float32")
+            loss = fluid.layers.reduce_mean(fluid.layers.fc(x, size=1))
+            grads = fluid.backward.append_backward(loss)
+        assert grads
+        assert fluid.framework.in_dygraph_mode() in (True, False)
+
+
+class TestReviewRegressions:
+    def test_save_dygraph_order_independent(self, tmp_path):
+        """Model then optimizer (or reverse) under one prefix must not
+        clobber the weights (suffix decided by Parameter content)."""
+        lin = paddle.nn.Linear(3, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        fluid.dygraph.save_dygraph(lin.state_dict(), str(tmp_path / "m"))
+        fluid.dygraph.save_dygraph(opt.state_dict(), str(tmp_path / "m"))
+        params, _ = fluid.dygraph.load_dygraph(str(tmp_path / "m"))
+        assert params and "weight" in params
+
+    def test_minimize_is_harvest_only(self):
+        """Reference dygraph minimize applies existing grads; it never
+        runs autograd itself."""
+        lin = paddle.nn.Linear(3, 2)
+        opt = paddle.optimizer.SGD(0.5, parameters=lin.parameters())
+        before = lin.weight.numpy().copy()
+        loss = paddle.sum(lin(paddle.ones([1, 3])))
+        opt.minimize(loss)  # no backward -> no grads -> no update
+        np.testing.assert_allclose(lin.weight.numpy(), before)
+        loss2 = paddle.sum(lin(paddle.ones([1, 3])))
+        loss2.backward()
+        opt.minimize(loss2)
+        assert not np.allclose(lin.weight.numpy(), before)
+
+    def test_fc_1x_spelling(self):
+        with fluid.program_guard(fluid.Program()):
+            x = fluid.data(name="x", shape=[-1, 4], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3, act="relu",
+                                param_attr=None, bias_attr=None)
+        assert y.shape[-1] == 3
+
+    def test_mul_restores_shape(self):
+        x = _t(RNG.random((2, 3, 4)).astype("float32"))
+        w = _t(RNG.random((4, 5)).astype("float32"))
+        assert fluid.layers.mul(x, w, x_num_col_dims=2).shape == [2, 3, 5]
+
+    def test_smooth_l1_outside_weight_elementwise(self):
+        sl = fluid.layers.smooth_l1(
+            _t(np.zeros((1, 2), np.float32)),
+            _t(np.ones((1, 2), np.float32)),
+            outside_weight=_t(np.array([[0.0, 2.0]], np.float32)))
+        np.testing.assert_allclose(sl.numpy(), [[1.0]])
