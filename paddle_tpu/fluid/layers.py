@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import builtins
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Tensor, apply_op
+from ..framework.core import Tensor, apply_op, _is_tracer
+from ..static.nn import _uname
 from .. import tensor as _T
 from ..nn import functional as _F
 from ..static import accuracy, auc, py_func, Print  # noqa: F401
@@ -431,3 +433,846 @@ def array_length(array):
 
 def create_array(dtype):
     return []
+
+
+# --- second batch: remaining fluid.layers names -----------------------------
+# re-exports
+from ..tensor import (  # noqa: F401,E402
+    crop, diag, eye, multiplex, rank, strided_slice, sum, triu, unbind,
+    unique, unique_consecutive, stanh, numel as size,
+)
+from ..tensor import add_n as sums  # noqa: F401,E402  (sum_op: elementwise list add)
+from ..nn.functional import (  # noqa: F401,E402
+    dice_loss, mse_loss, mish, ctc_loss as warpctc,
+    hardswish as hard_swish, kl_div as kldiv_loss,
+    adaptive_avg_pool2d as adaptive_pool2d,
+    adaptive_avg_pool3d as adaptive_pool3d, interpolate as image_resize,
+    pixel_unshuffle as space_to_depth,
+)
+
+
+def _huber_impl(x, y, delta):
+    d = y - x
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+def huber_loss(input, label, delta):  # noqa: A002
+    """huber_loss_op: elementwise Huber residual (no reduction),
+    1.x positional delta."""
+    return apply_op(_huber_impl, input, label, delta=float(delta),
+                    op_name="huber_loss")
+from ..nn.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401,E402
+from ..nn import GRUCell, LSTMCell, RNNCellBase as RNNCell  # noqa: F401,E402
+from ..distribution import Categorical, Normal, Uniform  # noqa: F401,E402
+from ..static import data  # noqa: F401,E402
+from ..text import linear_chain_crf  # noqa: F401,E402
+from ..vision.ops import (  # noqa: F401,E402
+    deform_conv2d as deformable_conv, psroi_pool as prroi_pool,
+    read_file,
+)
+
+crop_tensor = crop
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,  # noqa: A002
+          data_format="NCHW", name=None):
+    """fluid pad2d: [top, bottom, left, right] on the spatial dims."""
+    t, b, lft, r = (int(v) for v in paddings)
+    return _F.pad(input, [lft, r, t, b], mode=mode, value=pad_value,
+                  data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with trailing constants (pad_constant_like_op)."""
+    pads = []
+    for xs, ys in zip(x.shape, y.shape):
+        pads += [0, int(xs) - int(ys)]
+    return _pad_via_flat(y, pads, pad_value)
+
+
+def _pad_via_flat(y, pads, pad_value):
+    return pad(y, pads, pad_value=pad_value)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", name=None):
+    if global_pooling:
+        if pool_type == "max":
+            return _F.adaptive_max_pool3d(input, 1)
+        return _F.adaptive_avg_pool3d(input, 1)
+    if pool_type == "max":
+        return _F.max_pool3d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode)
+    return _F.avg_pool3d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode)
+
+
+def resize_linear(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                  align_mode=1, data_format="NCW", name=None):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="linear", align_corners=align_corners)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                     align_mode=1, data_format="NCDHW", name=None):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="trilinear", align_corners=align_corners)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    ratio = out_short_len / float(short)
+    return _F.interpolate(input, size=[int(round(h * ratio)),
+                                       int(round(w * ratio))],
+                          mode=resample.lower())
+
+
+def cos_sim(X, Y):  # noqa: N803
+    """cos_sim_op: row-wise cosine similarity → [N, 1]."""
+    out = _F.cosine_similarity(X, Y, axis=-1)
+    return _T.unsqueeze(out, -1)
+
+
+def _mean_iou_impl(pred, label, num_classes):
+    pred = pred.reshape(-1).astype(jnp.int32)
+    lab = label.reshape(-1).astype(jnp.int32)
+    idx = pred * num_classes + lab
+    cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx].add(1.0)
+    cm = cm.reshape(num_classes, num_classes)
+    inter = jnp.diagonal(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    # reference output order: (mean_iou, out_wrong, out_correct)
+    return miou, (union - inter).astype(jnp.int32), inter.astype(jnp.int32)
+
+
+def mean_iou(input, label, num_classes):  # noqa: A002
+    """mean_iou_op outputs (mean_iou, out_wrong, out_correct): per-class
+    difference counts then intersection counts, like the reference."""
+    return apply_op(_mean_iou_impl, input, label,
+                    num_classes=int(num_classes), op_name="mean_iou")
+
+
+def _rank_loss_impl(label, left, right):
+    p = jax.nn.sigmoid(left - right)
+    return -label * jnp.log(jnp.maximum(p, 1e-20)) \
+        - (1.0 - label) * jnp.log(jnp.maximum(1.0 - p, 1e-20))
+
+
+def rank_loss(label, left, right, name=None):
+    """rank_loss_op: RankNet pairwise loss."""
+    return apply_op(_rank_loss_impl, label, left, right, op_name="rank_loss")
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """margin_rank_loss_op: max(0, -label*(left-right) + margin)."""
+    def _impl(label, left, right, margin):
+        return jnp.maximum(0.0, -label * (left - right) + margin)
+
+    return apply_op(_impl, label, left, right, margin=float(margin),
+                    op_name="margin_rank_loss")
+
+
+def _bpr_loss_impl(x, label):
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lab[:, None], axis=1)
+    diff = x - pos
+    # exclude the positive column itself, like bpr_loss_op
+    mask = jnp.arange(x.shape[1])[None, :] != lab[:, None]
+    loss = jnp.where(mask, jnp.log1p(jnp.exp(diff)), 0.0)
+    return jnp.sum(loss, axis=1, keepdims=True) / jnp.maximum(
+        x.shape[1] - 1, 1)
+
+
+def bpr_loss(input, label, name=None):  # noqa: A002
+    """bpr_loss_op: Bayesian personalized ranking over score rows."""
+    return apply_op(_bpr_loss_impl, input, label, op_name="bpr_loss")
+
+
+def shuffle_channel(x, group, name=None):
+    def _impl(x, group):
+        n, c, h, w = x.shape
+        return x.reshape(n, group, c // group, h, w).swapaxes(1, 2).reshape(
+            n, c, h, w)
+
+    return apply_op(_impl, x, group=int(group), op_name="shuffle_channel")
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
+    """sampling_id_op: sample a category per row of probabilities."""
+    from ..framework.random import next_key
+
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    probs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    idx = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-20)),
+                                 axis=-1)
+    return Tensor(idx)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(
+        (x.size if isinstance(x, Tensor) else np.size(x)) == 0))
+
+
+def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
+    ok = bool(cond.numpy()) if isinstance(cond, Tensor) else bool(cond)
+    if not ok:
+        raise AssertionError(
+            "fluid.layers.Assert failed"
+            + ("" if data is None else ": %s" % ([np.asarray(getattr(
+                d, "_data", d)) for d in data],)))
+    return cond
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    key = counter_name or "@STEP_COUNTER@"
+    from ..static import default_main_program
+
+    prog = default_main_program()
+    counters = getattr(prog, "_step_counters", None)
+    if counters is None:
+        counters = prog._step_counters = {}
+    val = counters.get(key, begin - step) + step
+    counters[key] = val
+    return Tensor(jnp.asarray(val, jnp.int64))
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",  # noqa: A002
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):  # noqa: A002
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return uniform_random(shape, dtype, min, max, seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,  # noqa: A002
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return gaussian_random(shape, mean, std, seed, dtype)
+
+
+# --- LR decay functions → the scheduler objects our optimizers consume
+#     (reference layers/learning_rate_scheduler.py builds graph ops; here
+#     schedules are host-side LRScheduler state, the 2.x design) ----------
+
+def _ratio(step, decay_steps, staircase):
+    r = step / float(decay_steps)
+    return np.floor(r) if staircase else r
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr = base * rate^(step/decay_steps) (learning_rate_scheduler.py)."""
+    from ..optimizer.lr import LambdaDecay
+
+    return LambdaDecay(learning_rate, lambda step: decay_rate ** _ratio(
+        step, decay_steps, staircase))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer.lr import LambdaDecay
+
+    return LambdaDecay(learning_rate, lambda step: float(np.exp(
+        -decay_rate * _ratio(step, decay_steps, staircase))))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from ..optimizer.lr import LambdaDecay
+
+    return LambdaDecay(learning_rate, lambda step: 1.0 / (
+        1.0 + decay_rate * _ratio(step, decay_steps, staircase)))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from ..optimizer.lr import PolynomialDecay
+
+    return PolynomialDecay(learning_rate, decay_steps,
+                           end_lr=end_learning_rate, power=power, cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    from ..optimizer.lr import PiecewiseDecay
+
+    return PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """lr = base * 0.5 * (cos(epoch*pi/epochs) + 1), epoch = step //
+    step_each_epoch (learning_rate_scheduler.py cosine_decay)."""
+    from ..optimizer.lr import LambdaDecay
+
+    return LambdaDecay(learning_rate, lambda step: 0.5 * (float(np.cos(
+        (step // step_each_epoch) * np.pi / epochs)) + 1.0))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from ..optimizer.lr import NoamDecay
+
+    return NoamDecay(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from ..optimizer.lr import LinearWarmup
+
+    return LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# --- control-flow class shims over the functional forms ------------------
+
+class While:
+    """fluid.layers.While block → use while_loop; kept as a guidance shim
+    (the reference's block-style API writes into a Program block, which the
+    traced design expresses as lax.while via static.nn.while_loop)."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        raise NotImplementedError(
+            "block-style While is not supported: express the loop with "
+            "fluid.layers.while_loop(cond_fn, body_fn, loop_vars) — same "
+            "semantics, compiled to lax.while_loop")
+
+
+class Switch:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "block-style Switch is not supported: use "
+            "fluid.layers.case/switch_case")
+
+
+class IfElse:
+    def __init__(self, cond, name=None):
+        raise NotImplementedError(
+            "block-style IfElse is not supported: use fluid.layers.cond")
+
+
+# --- third batch: functional rnn, remaining impls, guided refusals ----------
+
+from ..nn.functional import local_response_norm as lrn  # noqa: F401,E402
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Functional rnn over a cell (reference layers/rnn.py rnn)."""
+    from ..nn import RNN
+
+    return RNN(cell, is_reverse=is_reverse, time_major=time_major)(
+        inputs, initial_states, sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    from ..nn import BiRNN
+
+    return BiRNN(cell_fw, cell_bw, time_major=time_major)(
+        inputs, initial_states, sequence_length)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,  # noqa: A002
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """fluid cudnn-style lstm → nn.LSTM (weights created per call like the
+    other static helpers)."""
+    from ..nn import LSTM
+
+    # 1.x cudnn lstm is sequence-major: input [seq_len, batch, input_dim]
+    net = LSTM(int(input.shape[-1]), hidden_size, num_layers=num_layers,
+               direction="bidirect" if is_bidirec else "forward",
+               dropout=dropout_prob, time_major=True)
+    out, (h, c) = net(input, (init_h, init_c))
+    return out, h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,  # noqa: A002
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """Single GRU step (gru_unit_op) via GRUCell; size = 3*hidden_dim."""
+    from ..nn import GRUCell
+
+    hidden_dim = size // 3
+    cell = GRUCell(int(input.shape[-1]), hidden_dim)
+    out, new_h = cell(input, hidden)
+    return out, out, new_h  # (hidden, reset_hidden_prev, gate) parity-ish
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    from ..nn import LSTMCell
+
+    cell = LSTMCell(int(x_t.shape[-1]), int(hidden_t_prev.shape[-1]))
+    out, (h, c) = cell(x_t, (hidden_t_prev, cell_t_prev))
+    return h, c
+
+
+def unique_with_counts(x, dtype="int32"):
+    out, idx, counts = _T.unique(x, return_inverse=True, return_counts=True)
+    return out, idx, counts
+
+
+def affine_channel(x, scale=None, bias=None, data_format="NCHW", act=None,
+                   name=None):
+    def _impl(x, scale, bias):
+        s = scale.reshape(1, -1, *([1] * (x.ndim - 2)))
+        b = bias.reshape(1, -1, *([1] * (x.ndim - 2)))
+        return x * s + b
+
+    out = apply_op(_impl, x, scale, bias, op_name="affine_channel")
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def _add_pos_enc_impl(x, alpha, beta):
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    half = D // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return alpha * x + beta * enc[None, :, :D]
+
+
+def add_position_encoding(input, alpha, beta, name=None):  # noqa: A002
+    """add_position_encoding_op: alpha*x + beta*sinusoid(position)."""
+    return apply_op(_add_pos_enc_impl, input, alpha=float(alpha),
+                    beta=float(beta), op_name="add_position_encoding")
+
+
+def fsp_matrix(x, y):
+    """fsp_op: flow-of-solution-procedure Gram matrix for distillation."""
+    def _impl(x, y):
+        B, C1 = x.shape[0], x.shape[1]
+        C2 = y.shape[1]
+        hw = x.shape[2] * x.shape[3]
+        xf = x.reshape(B, C1, hw)
+        yf = y.reshape(B, C2, hw)
+        return jnp.einsum("bch,bdh->bcd", xf, yf) / hw
+
+    return apply_op(_impl, x, y, op_name="fsp_matrix")
+
+
+def _ts_bce(z, t):
+    return jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+
+
+def _ts_loss_impl(z, lab, ub, lb):
+    z = jnp.clip(z, lb, ub)
+    # teacher_student_sigmoid_loss_op.h:44-62: label encodes
+    # (teacher-score presence, click) — {-2, -1, [0,1), [1,2)}
+    return jnp.where(
+        lab < -1.0, _ts_bce(z, 0.0),
+        jnp.where(lab < 0.0, _ts_bce(z, 1.0),
+                  jnp.where(lab < 1.0, _ts_bce(z, 0.0) + _ts_bce(z, lab),
+                            _ts_bce(z, 1.0) + _ts_bce(z, lab - 1.0))))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,  # noqa: A002
+                                 soft_max_lower_bound=-15.0):
+    """teacher_student_sigmoid_loss_op: hard-click CE plus the soft
+    teacher-score CE when the label carries one."""
+    return apply_op(_ts_loss_impl, input, label,
+                    ub=float(soft_max_up_bound),
+                    lb=float(soft_max_lower_bound),
+                    op_name="teacher_student_sigmoid_loss")
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,  # noqa: A002
+                       name=None):
+    """ctc_align_op greedy mode: argmax, merge repeats, drop blanks.
+    Dynamic output → host-side (eager), like the reference CPU kernel."""
+    probs = np.asarray(input._data if isinstance(input, Tensor)
+                       else input)                    # [B, T, C]
+    lens = (np.asarray(getattr(input_length, "_data", input_length)).reshape(-1)
+            if input_length is not None
+            else np.full((probs.shape[0],), probs.shape[1]))
+    ids = probs.argmax(-1)                            # [B, T]
+    rows = []
+    for b in builtins.range(ids.shape[0]):
+        seq, prev = [], None
+        for t in builtins.range(int(lens[b])):
+            tok = int(ids[b, t])
+            if tok != prev and tok != blank:
+                seq.append(tok)
+            prev = tok
+        rows.append(seq)
+    T_out = builtins.max([len(r) for r in rows] + [1])
+    out = np.full((len(rows), T_out), padding_value, np.int64)
+    for b, r in enumerate(rows):
+        out[b, :len(r)] = r
+    out_lens = np.asarray([len(r) for r in rows], np.int64)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(out_lens))
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """sample_logits_op: softmax CE over the true class + sampled classes
+    (uniform sampler, like nce)."""
+    from ..framework.random import next_key
+
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    C = int(logits.shape[-1])
+    samp = jax.random.randint(key, (int(num_samples),), 0, C)
+
+    def _impl(logits, label, samp):
+        lab = label.reshape(-1)
+        pos = jnp.take_along_axis(logits, lab[:, None], axis=1)  # [B,1]
+        neg = logits[:, samp]                                     # [B,S]
+        z = jnp.concatenate([pos, neg], axis=1)
+        return -jax.nn.log_softmax(z, axis=1)[:, :1]
+
+    return apply_op(_impl, logits, label, Tensor(samp),
+                    op_name="sampled_softmax_with_cross_entropy")
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD detection_output = decode_center_size box_coder + multiclass_nms
+    (reference detection.py detection_output composition)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", box_normalized=True)
+    return multiclass_nms(decoded, scores, background_label=background_label,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, nms_threshold=nms_threshold,
+                          keep_top_k=keep_top_k, nms_eta=nms_eta,
+                          return_index=return_index)
+
+
+class MultivariateNormalDiag:
+    """fluid.layers.distributions.MultivariateNormalDiag: independent
+    Normal per dim (diagonal covariance)."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc._data if isinstance(loc, Tensor) else jnp.asarray(loc)
+        sc = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+        # reference passes a diagonal MATRIX; accept vector or matrix
+        self.scale = jnp.diagonal(sc, axis1=-2, axis2=-1) if sc.ndim >= 2 \
+            else sc
+
+    def sample(self, shape=()):
+        from ..framework.random import next_key
+
+        z = jax.random.normal(next_key(),
+                              tuple(shape) + self.loc.shape, jnp.float32)
+        return Tensor(self.loc + z * self.scale)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        return Tensor(0.5 * d * (1.0 + np.log(2 * np.pi))
+                      + jnp.sum(jnp.log(self.scale), axis=-1))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * jnp.sum(
+            var_ratio + t1 - 1.0 - jnp.log(var_ratio), axis=-1))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):  # noqa: A002
+    """Pairs with the array_write/create_array shims."""
+    ts = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+          for t in input]
+    out = jnp.stack(ts, axis=axis) if use_stack \
+        else jnp.concatenate(ts, axis=axis)
+    sizes = np.asarray([t.shape[axis] for t in ts] if not use_stack
+                       else [1] * len(ts), np.int64)
+    return Tensor(out), Tensor(jnp.asarray(sizes))
+
+
+def random_crop(x, shape, seed=None):
+    """random_crop_op: host-side random spatial crop (input pipeline)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    out_sh = list(shape)
+    nd = arr.ndim
+    starts = []
+    rng = np.random.default_rng(seed)
+    lead = nd - len(out_sh)
+    for i, s in enumerate(out_sh):
+        lim = arr.shape[lead + i] - s
+        starts.append(rng.integers(0, lim + 1) if lim > 0 else 0)
+    idx = tuple([builtins.slice(None)] * lead
+                + [builtins.slice(st, st + s)
+                   for st, s in zip(starts, out_sh)])
+    return Tensor(jnp.asarray(arr[idx]))
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,  # noqa: A002
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """fluid hsigmoid: creates the tree weights and applies
+    F.hsigmoid_loss (hierarchical_sigmoid_op)."""
+    from ..framework.core import Parameter
+    from ..nn import initializer as I
+
+    D = int(input.shape[-1])
+    rows = num_classes - 1 if not is_custom else int(
+        np.asarray(getattr(path_table, "_data", path_table)).max()) + 1
+    w = Parameter(I.XavierNormal()((rows, D), "float32"), name="hsig.w")
+    b = Parameter(I.Constant(0.0)((rows,), "float32"), name="hsig.b")
+    return _F.hsigmoid_loss(input, label, num_classes, w, bias=b,
+                            path_table=path_table, path_code=path_code,
+                            is_sparse=is_sparse)
+
+
+# doc decorators the reference exposes (internal helpers, identity here)
+def templatedoc(op_type=None):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+autodoc = templatedoc
+
+
+def generate_layer_fn(op_type):
+    raise NotImplementedError(
+        "generate_layer_fn builds wrappers from the C++ OpProto registry; "
+        "this framework has no OpProto — every op is a python function "
+        "already present in this namespace")
+
+
+generate_activation_fn = generate_layer_fn
+generate_inplace_fn = generate_layer_fn
+
+
+def _lod_refusal(name, replacement):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            "%s is LoD-coupled; per the README LoDTensor decision use the "
+            "padded-dense equivalent: %s" % (name, replacement))
+
+    fn.__name__ = name
+    return fn
+
+
+dynamic_lstm = _lod_refusal("dynamic_lstm", "paddle.nn.LSTM + lengths")
+dynamic_lstmp = _lod_refusal("dynamic_lstmp", "paddle.nn.LSTM + projection")
+dynamic_gru = _lod_refusal("dynamic_gru", "paddle.nn.GRU + lengths")
+lod_reset = _lod_refusal("lod_reset", "sequence_pad/sequence_unpad")
+lod_append = _lod_refusal("lod_append", "sequence_pad/sequence_unpad")
+im2sequence = _lod_refusal("im2sequence", "unfold + reshape")
+reorder_lod_tensor_by_rank = _lod_refusal(
+    "reorder_lod_tensor_by_rank", "gather over a lengths argsort")
+get_tensor_from_selected_rows = _lod_refusal(
+    "get_tensor_from_selected_rows", "dense grads (SelectedRows decision)")
+merge_selected_rows = _lod_refusal(
+    "merge_selected_rows", "dense grads (SelectedRows decision)")
+py_reader = _lod_refusal("py_reader", "paddle.io.DataLoader")
+create_py_reader_by_data = _lod_refusal("create_py_reader_by_data",
+                                        "paddle.io.DataLoader")
+double_buffer = _lod_refusal("double_buffer",
+                             "paddle.io.DataLoader (prefetches natively)")
+load = _lod_refusal("load", "paddle.static.load / framework.io.load")
+
+
+def _decode_refusal(name):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            "%s (op-level beam search) is replaced by the compiled decoder: "
+            "fluid.layers.BeamSearchDecoder + dynamic_decode "
+            "(paddle_tpu.nn.decode)" % name)
+
+    fn.__name__ = name
+    return fn
+
+
+beam_search = _decode_refusal("beam_search")
+beam_search_decode = _decode_refusal("beam_search_decode")
+DynamicRNN = _decode_refusal("DynamicRNN")
+StaticRNN = _decode_refusal("StaticRNN")
+Decoder = _decode_refusal("Decoder")
+BasicDecoder = _decode_refusal("BasicDecoder")
+DecodeHelper = _decode_refusal("DecodeHelper")
+TrainingHelper = _decode_refusal("TrainingHelper")
+GreedyEmbeddingHelper = _decode_refusal("GreedyEmbeddingHelper")
+SampleEmbeddingHelper = _decode_refusal("SampleEmbeddingHelper")
+
+
+def _det_refusal(name, parts):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            "%s: compose from the implemented detection primitives (%s) — "
+            "the reference op is this composition fused in C++" % (name, parts))
+
+    fn.__name__ = name
+    return fn
+
+
+ssd_loss = _det_refusal("ssd_loss",
+                        "bipartite_match + box_coder + softmax/smooth_l1")
+target_assign = _det_refusal("target_assign", "bipartite_match + gather")
+rpn_target_assign = _det_refusal("rpn_target_assign",
+                                 "iou_similarity + anchor sampling")
+retinanet_target_assign = _det_refusal("retinanet_target_assign",
+                                       "iou_similarity + anchor sampling")
+retinanet_detection_output = _det_refusal(
+    "retinanet_detection_output", "yolo-style decode + multiclass_nms")
+locality_aware_nms = _det_refusal("locality_aware_nms", "nms/matrix_nms")
+polygon_box_transform = _det_refusal("polygon_box_transform", "box_coder")
+box_decoder_and_assign = _det_refusal("box_decoder_and_assign",
+                                      "box_coder + argmax gather")
+roi_perspective_transform = _det_refusal("roi_perspective_transform",
+                                         "grid_sampler + affine_grid")
+deformable_roi_pooling = _det_refusal("deformable_roi_pooling",
+                                      "deform_conv2d + roi_align")
+generate_proposal_labels = _det_refusal("generate_proposal_labels",
+                                        "bipartite_match + sampling")
+generate_mask_labels = _det_refusal("generate_mask_labels",
+                                    "roi_align over gt masks")
+density_prior_box = _det_refusal("density_prior_box", "prior_box variants")
+
+
+def _ps_refusal(name):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            "%s belongs to the parameter-server/rec-sys stack the README "
+            "documents out of the TPU critical path" % name)
+
+    fn.__name__ = name
+    return fn
+
+
+continuous_value_model = _ps_refusal("continuous_value_model")
+filter_by_instag = _ps_refusal("filter_by_instag")
+hash = _ps_refusal("hash")  # noqa: A001
+
+
+def similarity_focus(input, axis, indexes, name=None):  # noqa: A002
+    raise NotImplementedError(
+        "similarity_focus: compose from argmax + one-hot scatter masks; "
+        "the reference op is that composition fused")
+
+
+def inplace_abn(input, **kwargs):  # noqa: A002
+    raise NotImplementedError(
+        "inplace_abn exists to reuse the activation buffer in-place — a "
+        "memory optimization XLA's buffer assignment performs on the "
+        "plain batch_norm(act=...) composition; use that")
+
+
+_center_registry = {}
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
+                update_center=True):
+    """center_loss_op: 0.5*||x - c_y||^2 with RUNNING class centers:
+    the centers live in a per-(name, shape) registry so every call of the
+    training loop updates the same buffer, like the reference's
+    persistable centers parameter."""
+    from ..framework.core import Parameter
+    from ..framework.param_attr import ParamAttr
+    from ..nn import initializer as I
+
+    D = int(input.shape[-1])
+    attr = ParamAttr._to_attr(param_attr)
+    cname = (attr.name if attr is not None and attr.name
+             else "center_loss.centers")
+    key = (cname, int(num_classes), D)
+    centers = _center_registry.get(key)
+    if centers is None:
+        centers = Parameter(
+            I.Constant(0.0)((int(num_classes), D), "float32"),
+            name=cname, trainable=False)
+        _center_registry[key] = centers
+
+    def _impl(x, lab, c):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        diff = x - c[lab]
+        return 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+
+    loss = apply_op(_impl, input, label, centers, op_name="center_loss")
+    if update_center and not _is_tracer(getattr(input, "_data", input)):
+        x = np.asarray(getattr(input, "_data", input))
+        lab = np.asarray(getattr(label, "_data", label)).reshape(-1)
+        c = np.asarray(centers._data)
+        a = (alpha._data if isinstance(alpha, Tensor)
+             else alpha)
+        a = float(np.asarray(a).reshape(-1)[0])
+        for cls in np.unique(lab):
+            rows = x[lab == cls]
+            resid = c[cls] - rows.mean(0)
+            c = c.copy()
+            c[cls] -= a * resid * len(rows) / (1.0 + len(rows))
+        centers.set_value(c)
+    return loss
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None):
+    """chunk_eval_op: chunk extraction P/R/F1 for IOB/IOE/IOBES tagging.
+    Host-side metric (eager), like the reference CPU-only kernel."""
+    schemes = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in schemes:
+        raise ValueError("chunk_scheme must be IOB/IOE/IOBES/plain")
+    n_tag = schemes[chunk_scheme]
+    excluded = set(excluded_chunk_types or [])
+
+    def extract(seq):
+        chunks, start, ctype = [], None, None
+        for i, t in enumerate(seq):
+            t = int(t)
+            if t == num_chunk_types * n_tag:  # outside tag
+                if start is not None:
+                    chunks.append((start, i, ctype))
+                    start = None
+                continue
+            # reference encoding: tag = label % num_tag_types,
+            # type = label // num_tag_types (chunk_eval_op.h)
+            pos, typ = t % n_tag, t // n_tag
+            begin = (pos == 0) if chunk_scheme in ("IOB", "IOBES")                 else (start is None)
+            if chunk_scheme == "IOBES" and pos == 3:   # S = single
+                if start is not None:
+                    chunks.append((start, i, ctype))
+                    start = None
+                chunks.append((i, i + 1, typ))
+                continue
+            if begin or typ != ctype:
+                if start is not None:
+                    chunks.append((start, i, ctype))
+                start, ctype = i, typ
+            # IOE tags: I=0, E=1; IOBES: B=0, I=1, E=2, S=3
+            end_here = (chunk_scheme == "IOE" and pos == 1) or (
+                chunk_scheme == "IOBES" and pos == 2)
+            if end_here and start is not None:
+                chunks.append((start, i + 1, ctype))
+                start = None
+        if start is not None:
+            chunks.append((start, len(seq), ctype))
+        return {c for c in chunks if c[2] not in excluded}
+
+    pred = np.asarray(getattr(input, "_data", input))
+    lab = np.asarray(getattr(label, "_data", label))
+    if pred.ndim == 1:
+        pred, lab = pred[None], lab[None]
+    lens = (np.asarray(getattr(seq_length, "_data", seq_length)).reshape(-1)
+            if seq_length is not None
+            else np.full((pred.shape[0],), pred.shape[-1]))
+    n_inf = n_lab = n_correct = 0
+    for b in builtins.range(pred.shape[0]):
+        L = int(lens[b])
+        pc = extract(pred[b].reshape(-1)[:L])
+        lc = extract(lab[b].reshape(-1)[:L])
+        n_inf += len(pc)
+        n_lab += len(lc)
+        n_correct += len(pc & lc)
+    precision = n_correct / n_inf if n_inf else 0.0
+    recall = n_correct / n_lab if n_lab else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+    mk = lambda v, dt=jnp.float32: Tensor(jnp.asarray(v, dt))  # noqa: E731
+    return (mk(precision), mk(recall), mk(f1),
+            mk(n_inf, jnp.int32), mk(n_lab, jnp.int32),
+            mk(n_correct, jnp.int32))

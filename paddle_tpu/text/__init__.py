@@ -38,7 +38,7 @@ __all__ = [
     "WMT14",
     "WMT16",
     "ViterbiDecoder",
-    "viterbi_decode",
+    "viterbi_decode", "linear_chain_crf",
 ]
 
 
@@ -441,3 +441,54 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+def _crf_nll_impl(emis, label, trans, lengths):
+    # reference linear_chain_crf_op.h: cost = logZ - score(gold path),
+    # start/stop rows 0/1 of the transition matrix, pairwise = trans[2:]
+    B, T, C = emis.shape
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    lab = label.reshape(B, T).astype(jnp.int32)
+    mask = jnp.arange(T)[None, :] < lengths.reshape(-1, 1)      # [B, T]
+
+    # forward algorithm (logZ) via scan over time
+    alpha0 = start[None, :] + emis[:, 0]                         # [B, C]
+
+    def step(alpha, xs):
+        e_t, m_t = xs                                            # [B,C],[B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + pair[None], axis=1) + e_t
+        return jnp.where(m_t[:, None], nxt, alpha), None
+
+    alphaT, _ = jax.lax.scan(
+        step, alpha0, (emis[:, 1:].swapaxes(0, 1),
+                       mask[:, 1:].swapaxes(0, 1)))
+    logz = jax.nn.logsumexp(alphaT + stop[None, :], axis=1)      # [B]
+
+    # gold-path score
+    bi = jnp.arange(B)
+    e_score = jnp.sum(jnp.where(
+        mask, jnp.take_along_axis(emis, lab[..., None], axis=2)[..., 0],
+        0.0), axis=1)
+    p_score = jnp.sum(jnp.where(mask[:, 1:],
+                                pair[lab[:, :-1], lab[:, 1:]], 0.0), axis=1)
+    last = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+    gold = (start[lab[:, 0]] + e_score + p_score
+            + stop[lab[bi, last]])
+    return (logz - gold)[:, None]
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):  # noqa: A002
+    """CRF negative log-likelihood (reference linear_chain_crf_op.h):
+    ``param_attr`` IS the transition tensor [num_tags + 2, num_tags]
+    (rows 0/1 = start/stop), the learned companion of crf_decoding —
+    the traced program captures it directly where the reference resolves
+    a parameter name through the Scope. Returns the per-sequence cost
+    [B, 1] (minimize its mean)."""
+    from ..framework.core import Tensor, apply_op
+
+    trans = param_attr
+    B, T = int(input.shape[0]), int(input.shape[1])
+    if length is None:
+        length = Tensor(jnp.full((B,), T, jnp.int32))
+    return apply_op(_crf_nll_impl, input, label, trans, length,
+                    op_name="linear_chain_crf")

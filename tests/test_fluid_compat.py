@@ -260,3 +260,182 @@ class TestReviewRegressions:
             _t(np.ones((1, 2), np.float32)),
             outside_weight=_t(np.array([[0.0, 2.0]], np.float32)))
         np.testing.assert_allclose(sl.numpy(), [[1.0]])
+
+
+class TestLayersBatch2:
+    def test_full_fluid_layers_inventory_resolves(self):
+        import json
+        import os
+
+        inv = json.load(open(os.path.join(os.path.dirname(__file__),
+                                          "ref_api_inventory.json")))
+        miss = [n for n in inv["paddle.fluid.layers"]
+                if not hasattr(fluid.layers, n)]
+        assert not miss, miss
+
+    def test_functional_rnn_and_lstm(self):
+        cell = paddle.nn.GRUCell(4, 6)
+        x = _t(RNG.random((2, 5, 4)).astype("float32"))
+        out, state = fluid.layers.rnn(cell, x)
+        assert out.shape == [2, 5, 6]
+        h0 = paddle.zeros([1, 2, 8])
+        c0 = paddle.zeros([1, 2, 8])
+        xs = _t(RNG.random((5, 2, 4)).astype("float32"))  # time-major
+        o, h, c = fluid.layers.lstm(xs, h0, c0, 5, 8, 1)
+        assert o.shape == [5, 2, 8] and h.shape == [1, 2, 8]
+        hh, cc = fluid.layers.lstm_unit(
+            _t(RNG.random((2, 4)).astype("float32")),
+            paddle.zeros([2, 6]), paddle.zeros([2, 6]))
+        assert hh.shape == [2, 6] and cc.shape == [2, 6]
+
+    def test_linear_chain_crf_pairs_with_decoding(self):
+        """Training cost decreases exactly when transitions favor the gold
+        path that crf_decoding then recovers."""
+        from paddle_tpu.text import linear_chain_crf
+
+        emis = np.zeros((1, 3, 3), np.float32)
+        emis[0, 0, 1] = emis[0, 1, 2] = emis[0, 2, 0] = 4.0
+        trans = paddle.to_tensor(np.zeros((5, 3), np.float32))
+        lab = _t(np.array([[1, 2, 0]]))
+        cost = float(linear_chain_crf(_t(emis), lab, trans)[0])
+        assert cost > 0  # -log p < 1
+        path = fluid.layers.crf_decoding(_t(emis), trans,
+                                         length=_t(np.array([3])))
+        assert path.numpy()[0].tolist() == [1, 2, 0]
+
+    def test_ctc_greedy_decoder(self):
+        probs = np.zeros((1, 5, 4), np.float32)
+        for t, c in enumerate([1, 1, 3, 2, 2]):  # blank=3
+            probs[0, t, c] = 5.0
+        out, lens = fluid.layers.ctc_greedy_decoder(_t(probs), blank=3)
+        assert out.numpy()[0].tolist()[: int(lens[0])] == [1, 2]
+
+    def test_mean_iou_and_cos_sim(self):
+        pred = _t(np.array([[0, 1], [1, 1]]))
+        lab = _t(np.array([[0, 1], [0, 1]]))
+        miou, inter, diff = fluid.layers.mean_iou(pred, lab, 2)
+        # class0: inter 1 union 2 -> 0.5; class1: inter 2 union 3 -> 2/3
+        np.testing.assert_allclose(float(miou), (0.5 + 2 / 3) / 2, rtol=1e-5)
+        a = _t(np.array([[1.0, 0.0]], np.float32))
+        b = _t(np.array([[1.0, 1.0]], np.float32))
+        np.testing.assert_allclose(fluid.layers.cos_sim(a, b).numpy(),
+                                   [[1 / np.sqrt(2)]], rtol=1e-5)
+
+    def test_detection_output_composes(self):
+        pb = _t(np.array([[0.1, 0.1, 0.5, 0.5]], np.float32))
+        pbv = _t(np.array([[0.1, 0.1, 0.2, 0.2]], np.float32))
+        loc = _t(np.zeros((1, 1, 4), np.float32))
+        scores = _t(np.array([[[0.1, 0.9]]], np.float32))
+        out, nums = fluid.layers.detection_output(
+            loc, scores, pb, pbv, score_threshold=0.5)
+        assert out.shape[1] == 6  # [label, score, x1, y1, x2, y2]
+
+    def test_sampled_softmax_and_misc(self):
+        logits = _t(RNG.random((3, 50)).astype("float32"))
+        lab = _t(np.array([[4], [7], [0]]))
+        loss = fluid.layers.sampled_softmax_with_cross_entropy(
+            logits, lab, num_samples=10)
+        assert loss.shape == [3, 1] and np.isfinite(loss.numpy()).all()
+        x = _t(RNG.random((1, 4, 6, 6)).astype("float32"))
+        assert fluid.layers.shuffle_channel(x, 2).shape == [1, 4, 6, 6]
+        assert fluid.layers.affine_channel(
+            x, _t(np.ones(4, np.float32)),
+            _t(np.zeros(4, np.float32))).shape == [1, 4, 6, 6]
+        pe = fluid.layers.add_position_encoding(
+            _t(RNG.random((1, 5, 8)).astype("float32")), 1.0, 1.0)
+        assert pe.shape == [1, 5, 8]
+        f = fluid.layers.fsp_matrix(x, x)
+        assert f.shape == [1, 4, 4]
+        assert fluid.layers.unique_with_counts(
+            _t(np.array([1, 1, 2])))[2].numpy().tolist() == [2, 1]
+
+    def test_lr_decay_functions_return_schedulers(self):
+        from paddle_tpu.optimizer.lr import LRScheduler
+
+        for sched in [
+            fluid.layers.exponential_decay(0.1, 100, 0.9),
+            fluid.layers.piecewise_decay([10, 20], [0.1, 0.05, 0.01]),
+            fluid.layers.polynomial_decay(0.1, 100),
+            fluid.layers.cosine_decay(0.1, 10, 5),
+            fluid.layers.noam_decay(512, 4000),
+            fluid.layers.linear_lr_warmup(0.1, 100, 0.0, 0.1),
+        ]:
+            assert isinstance(sched, LRScheduler)
+
+    def test_guided_refusals_point_to_replacements(self):
+        with pytest.raises(NotImplementedError, match="padded-dense"):
+            fluid.layers.dynamic_lstm(None, 4)
+        with pytest.raises(NotImplementedError, match="BeamSearchDecoder"):
+            fluid.layers.beam_search(None, None, None, None, None, 4)
+        with pytest.raises(NotImplementedError, match="bipartite_match"):
+            fluid.layers.ssd_loss(None, None, None, None, None, None)
+        with pytest.raises(NotImplementedError, match="DataLoader"):
+            fluid.layers.py_reader(64, [[2]], ["float32"])
+
+    def test_center_loss_and_chunk_eval(self):
+        x = _t(np.ones((4, 3), np.float32))
+        lab = _t(np.array([0, 0, 1, 1]))
+        loss = fluid.layers.center_loss(x, lab, 5, 0.5)
+        assert (loss.numpy() > 0).all()
+        # B-t0, B-t1, O, I-t0 (I after O opens a chunk, conll semantics)
+        pred = _t(np.array([[0, 2, 4, 1]]))
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(pred, pred, "IOB", 2)
+        assert float(f1) == 1.0 and int(nc) == 3
+
+
+class TestLayersBatch2Regressions:
+    def test_mean_iou_output_order(self):
+        miou, wrong, correct = fluid.layers.mean_iou(
+            _t(np.array([[0, 1], [1, 1]])), _t(np.array([[0, 1], [0, 1]])), 2)
+        assert correct.numpy().tolist() == [1, 2]
+        assert wrong.numpy().tolist() == [1, 1]
+
+    def test_huber_loss_elementwise_delta(self):
+        h = fluid.layers.huber_loss(
+            _t(np.zeros((2, 1), np.float32)),
+            _t(np.array([[0.5], [3.0]], np.float32)), 1.0)
+        np.testing.assert_allclose(h.numpy(), [[0.125], [2.5]], rtol=1e-5)
+
+    def test_sums_elementwise_list(self):
+        a = _t(np.ones((2, 2), np.float32))
+        np.testing.assert_allclose(fluid.layers.sums([a, a]).numpy(), 2.0)
+
+    def test_teacher_student_soft_term(self):
+        z = 1.5
+        got = float(fluid.layers.teacher_student_sigmoid_loss(
+            _t(np.array([[z]], np.float32)),
+            _t(np.array([[0.3]], np.float32)))[0])
+
+        def bce(z, t):
+            return max(z, 0) - z * t + np.log1p(np.exp(-abs(z)))
+
+        np.testing.assert_allclose(got, bce(z, 0) + bce(z, 0.3), rtol=1e-5)
+
+    def test_exponential_decay_honors_decay_steps(self):
+        sch = fluid.layers.exponential_decay(0.1, 10000, 0.9)
+        for _ in range(100):
+            sch.step()
+        assert sch() > 0.0999 * 0.91  # ~0.9^(100/10000), not 0.9^100
+
+    def test_chunk_eval_type_tag_decomposition(self):
+        # num_chunk_types=3, IOB (n_tag=2): label = type*2 + tag
+        seq = _t(np.array([[4, 5, 6]]))  # B-type2 I-type2 Outside
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(seq, seq, "IOB", 3)
+        assert float(f1) == 1.0 and int(ni) == 1
+        # IOE: I=0 E=1; one chunk [I-t0 E-t0]
+        ioe = _t(np.array([[0, 1, 4]]))  # I-t0, E-t0, outside(2*2=4)
+        p2, r2, f2, ni2, _, _ = fluid.layers.chunk_eval(ioe, ioe, "IOE", 2)
+        assert float(f2) == 1.0 and int(ni2) == 1
+
+    def test_center_loss_centers_persist(self):
+        x = _t(np.full((4, 3), 2.0, np.float32))
+        lab = _t(np.array([2, 2, 3, 3]))
+        l1 = float(paddle.mean(fluid.layers.center_loss(x, lab, 6, 0.5)))
+        l2 = float(paddle.mean(fluid.layers.center_loss(x, lab, 6, 0.5)))
+        assert l2 < l1  # running centers moved toward the features
+
+    def test_lstm_is_time_major(self):
+        h0, c0 = paddle.zeros([1, 2, 8]), paddle.zeros([1, 2, 8])
+        o, h, c = fluid.layers.lstm(
+            _t(RNG.random((5, 2, 4)).astype("float32")), h0, c0, 5, 8, 1)
+        assert o.shape == [5, 2, 8]
