@@ -17,7 +17,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, apply_op, _is_tracer
-from ..static.nn import _uname
 from .. import tensor as _T
 from ..nn import functional as _F
 from ..static import accuracy, auc, py_func, Print  # noqa: F401
@@ -1111,9 +1110,7 @@ def _det_refusal(name, parts):
     return fn
 
 
-ssd_loss = _det_refusal("ssd_loss",
-                        "bipartite_match + box_coder + softmax/smooth_l1")
-target_assign = _det_refusal("target_assign", "bipartite_match + gather")
+from ..vision.ops import ssd_loss, target_assign  # noqa: F401,E402
 rpn_target_assign = _det_refusal("rpn_target_assign",
                                  "iou_similarity + anchor sampling")
 retinanet_target_assign = _det_refusal("retinanet_target_assign",
@@ -1132,7 +1129,7 @@ generate_proposal_labels = _det_refusal("generate_proposal_labels",
                                         "bipartite_match + sampling")
 generate_mask_labels = _det_refusal("generate_mask_labels",
                                     "roi_align over gt masks")
-density_prior_box = _det_refusal("density_prior_box", "prior_box variants")
+from ..vision.ops import density_prior_box  # noqa: F401,E402
 
 
 def _ps_refusal(name):

@@ -31,7 +31,8 @@ __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "box_clip", "anchor_generator", "generate_proposals",
            "distribute_fpn_proposals", "collect_fpn_proposals",
            "RoIAlign", "RoIPool", "yolo_loss", "DeformConv2D", "PSRoIPool",
-           "read_file", "decode_jpeg"]
+           "read_file", "decode_jpeg", "ssd_loss", "target_assign",
+           "density_prior_box"]
 
 
 def _arr(x):
@@ -1193,3 +1194,194 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+# -- SSD training losses ----------------------------------------------------
+
+def _softmax_ce_rows(logits, labels):
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return lse - picked
+
+
+def _ssd_loss_impl(loc, conf, loc_t, conf_t, pos_mask, sel_mask,
+                   loc_loss_weight, conf_loss_weight, normalizer):
+    d = loc - loc_t
+    ad = jnp.abs(d)
+    sl1 = jnp.sum(jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5), axis=-1)
+    loc_l = sl1 * pos_mask * loc_loss_weight
+    conf_l = _softmax_ce_rows(conf, conf_t) * sel_mask * conf_loss_weight
+    out = (loc_l + conf_l) / normalizer
+    return out.reshape(-1, 1)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """SSD multibox loss (reference fluid/layers/detection.py:1520 —
+    bipartite/per-prediction matching, encode targets against priors,
+    max-negative hard mining at neg_pos_ratio, smooth-L1 + softmax CE,
+    normalized by the matched count).
+
+    Padded-dense gt convention (README LoD decision): gt_box [N, B, 4]
+    with invalid rows w<=0, gt_label [N, B] or [N, B, 1]. location
+    [N, M, 4]; confidence [N, M, C]; prior_box [M, 4]. Returns the
+    per-prior weighted loss [N*M, 1] (matching the reference's output
+    shape), differentiable w.r.t. location/confidence.
+    """
+    if mining_type != "max_negative":
+        raise NotImplementedError("ssd_loss: only max_negative mining")
+    from ..framework.core import Tensor, apply_op
+
+    loc_a = np.asarray(_arr(location), np.float32)
+    conf_a = np.asarray(_arr(confidence), np.float32)
+    gtb = np.asarray(_arr(gt_box), np.float32)
+    gtl = np.asarray(_arr(gt_label)).reshape(gtb.shape[0], -1)
+    pb = np.asarray(_arr(prior_box), np.float32)
+    pbv = (np.asarray(_arr(prior_box_var), np.float32)
+           if prior_box_var is not None
+           else np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], np.float32),
+                        (len(pb), 1)))
+    N, M, _ = loc_a.shape
+
+    loc_t = np.zeros((N, M, 4), np.float32)
+    conf_t = np.zeros((N, M), np.int64)
+    pos_mask = np.zeros((N, M), np.float32)
+    sel_mask = np.zeros((N, M), np.float32)
+    n_matched = 0
+    for n in range(N):
+        valid = (gtb[n, :, 2] - gtb[n, :, 0]) > 0
+        g = gtb[n][valid]
+        gl = gtl[n][valid]
+        if len(g) == 0:
+            continue
+        iou = np.asarray(_arr(iou_similarity(Tensor(jnp.asarray(g)),
+                                             Tensor(jnp.asarray(pb)))))
+        match, _dist = bipartite_match(Tensor(jnp.asarray(iou)),
+                                       match_type=match_type,
+                                       dist_threshold=overlap_threshold)
+        match = np.asarray(_arr(match)).reshape(-1)       # [M], -1 unmatched
+        pos = match >= 0
+        n_pos = int(pos.sum())
+        n_matched += n_pos
+        if n_pos:
+            mg = g[match[pos]]
+            p = pb[pos]
+            v = pbv[pos]
+            # elementwise EncodeCenterSize (box_coder_op.h:41, normalized
+            # boxes): one target per matched prior, NOT the pairwise grid
+            pw = p[:, 2] - p[:, 0]
+            ph = p[:, 3] - p[:, 1]
+            pcx = (p[:, 0] + p[:, 2]) / 2
+            pcy = (p[:, 1] + p[:, 3]) / 2
+            gw = mg[:, 2] - mg[:, 0]
+            gh = mg[:, 3] - mg[:, 1]
+            gcx = (mg[:, 0] + mg[:, 2]) / 2
+            gcy = (mg[:, 1] + mg[:, 3]) / 2
+            loc_t[n][pos] = np.stack(
+                [(gcx - pcx) / pw / v[:, 0], (gcy - pcy) / ph / v[:, 1],
+                 np.log(np.maximum(gw / pw, 1e-10)) / v[:, 2],
+                 np.log(np.maximum(gh / ph, 1e-10)) / v[:, 3]], axis=1)
+            conf_t[n][pos] = gl[match[pos]]
+        # hard negative mining by conf loss on the background class
+        best_iou = iou.max(axis=0) if len(g) else np.zeros(M)
+        neg_cand = (~pos) & (best_iou < neg_overlap)
+        z = conf_a[n] - conf_a[n].max(-1, keepdims=True)
+        ce_bg = (np.log(np.exp(z).sum(-1))
+                 - z[:, background_label])                 # bg CE per prior
+        n_neg = int(min(neg_pos_ratio * max(n_pos, 1),
+                        neg_cand.sum()))
+        if sample_size is not None:
+            n_neg = min(n_neg, int(sample_size))
+        if n_neg > 0:
+            cand_idx = np.where(neg_cand)[0]
+            hard = cand_idx[np.argsort(-ce_bg[cand_idx])[:n_neg]]
+            sel_mask[n][hard] = 1.0
+            conf_t[n][hard] = background_label
+        sel_mask[n][pos] = 1.0
+        pos_mask[n][pos] = 1.0
+
+    normalizer = float(n_matched) if (normalize and n_matched) else 1.0
+    return apply_op(
+        _ssd_loss_impl, location, confidence,
+        Tensor(jnp.asarray(loc_t)), Tensor(jnp.asarray(conf_t)),
+        Tensor(jnp.asarray(pos_mask)), Tensor(jnp.asarray(sel_mask)),
+        loc_loss_weight=float(loc_loss_weight),
+        conf_loss_weight=float(conf_loss_weight), normalizer=normalizer,
+        op_name="ssd_loss")
+
+
+def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
+                  mismatch_value=0, name=None):
+    """Assign per-column targets by match indices (reference
+    detection/target_assign_op.h): out[j] = input[matched[j]] where
+    matched[j] >= 0 else mismatch_value; weight 1 for matched (and listed
+    negatives), 0 otherwise. input [B, 4] rows (padded-dense gt rows),
+    matched_indices [1, M] or [M]."""
+    from ..framework.core import Tensor
+
+    rows = np.asarray(_arr(input))
+    match = np.asarray(_arr(matched_indices)).reshape(-1)
+    M = len(match)
+    feat = rows.shape[-1] if rows.ndim > 1 else 1
+    out = np.full((M, feat), mismatch_value, rows.dtype)
+    w = np.zeros((M, 1), np.float32)
+    pos = match >= 0
+    out[pos] = rows.reshape(-1, feat)[match[pos]]
+    w[pos] = 1.0
+    if negative_indices is not None:
+        neg = np.asarray(_arr(negative_indices)).reshape(-1).astype(np.int64)
+        w[neg] = 1.0
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(w))
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noqa: A002
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Density prior boxes (reference detection/density_prior_box_op.h):
+    per cell, for each (density, fixed_size, fixed_ratio) emit a density x
+    density shifted grid of boxes of size fixed_size*sqrt(ratio)."""
+    from ..framework.core import Tensor
+
+    feat = np.asarray(_arr(input))
+    img = np.asarray(_arr(image))
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_h = steps[1] if steps[1] else img_h / H
+    step_w = steps[0] if steps[0] else img_w / W
+    boxes = []
+    for y in range(H):
+        for x in range(W):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            step_average = int((step_w + step_h) * 0.5)  # density_prior_box_op.h
+            for dens, fs in zip(densities, fixed_sizes):
+                for ratio in fixed_ratios:
+                    bw = fs * math.sqrt(ratio)
+                    bh = fs / math.sqrt(ratio)
+                    shift = int(step_average / dens)
+                    for dy in range(dens):
+                        for dx in range(dens):
+                            ccx = (cx - step_average / 2.0 + shift / 2.0
+                                   + dx * shift)
+                            ccy = (cy - step_average / 2.0 + shift / 2.0
+                                   + dy * shift)
+                            cell.append([(ccx - bw / 2.0) / img_w,
+                                         (ccy - bh / 2.0) / img_h,
+                                         (ccx + bw / 2.0) / img_w,
+                                         (ccy + bh / 2.0) / img_h])
+            boxes.append(cell)
+    out = np.asarray(boxes, np.float32).reshape(H, W, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    nprior = out.shape[2]
+    var = np.tile(np.asarray(variance, np.float32)[None, None, None, :],
+                  (H, W, nprior, 1))
+    if flatten_to_2d:
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
