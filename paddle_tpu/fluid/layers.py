@@ -1111,10 +1111,9 @@ def _det_refusal(name, parts):
 
 
 from ..vision.ops import ssd_loss, target_assign  # noqa: F401,E402
-rpn_target_assign = _det_refusal("rpn_target_assign",
-                                 "iou_similarity + anchor sampling")
+from ..vision.ops import rpn_target_assign  # noqa: F401,E402
 retinanet_target_assign = _det_refusal("retinanet_target_assign",
-                                       "iou_similarity + anchor sampling")
+                                       "rpn_target_assign with focal thresholds")
 retinanet_detection_output = _det_refusal(
     "retinanet_detection_output", "yolo-style decode + multiclass_nms")
 locality_aware_nms = _det_refusal("locality_aware_nms", "nms/matrix_nms")
@@ -1125,8 +1124,7 @@ roi_perspective_transform = _det_refusal("roi_perspective_transform",
                                          "grid_sampler + affine_grid")
 deformable_roi_pooling = _det_refusal("deformable_roi_pooling",
                                       "deform_conv2d + roi_align")
-generate_proposal_labels = _det_refusal("generate_proposal_labels",
-                                        "bipartite_match + sampling")
+from ..vision.ops import generate_proposal_labels  # noqa: F401,E402
 generate_mask_labels = _det_refusal("generate_mask_labels",
                                     "roi_align over gt masks")
 from ..vision.ops import density_prior_box  # noqa: F401,E402

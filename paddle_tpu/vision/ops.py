@@ -32,7 +32,8 @@ __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "distribute_fpn_proposals", "collect_fpn_proposals",
            "RoIAlign", "RoIPool", "yolo_loss", "DeformConv2D", "PSRoIPool",
            "read_file", "decode_jpeg", "ssd_loss", "target_assign",
-           "density_prior_box"]
+           "density_prior_box", "rpn_target_assign",
+           "generate_proposal_labels"]
 
 
 def _arr(x):
@@ -1257,8 +1258,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         gl = gtl[n][valid]
         if len(g) == 0:
             continue
-        iou = np.asarray(_arr(iou_similarity(Tensor(jnp.asarray(g)),
-                                             Tensor(jnp.asarray(pb)))))
+        iou = _np_iou_norm(g, pb)
         match, _dist = bipartite_match(Tensor(jnp.asarray(iou)),
                                        match_type=match_type,
                                        dist_threshold=overlap_threshold)
@@ -1267,23 +1267,9 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         n_pos = int(pos.sum())
         n_matched += n_pos
         if n_pos:
-            mg = g[match[pos]]
-            p = pb[pos]
-            v = pbv[pos]
-            # elementwise EncodeCenterSize (box_coder_op.h:41, normalized
-            # boxes): one target per matched prior, NOT the pairwise grid
-            pw = p[:, 2] - p[:, 0]
-            ph = p[:, 3] - p[:, 1]
-            pcx = (p[:, 0] + p[:, 2]) / 2
-            pcy = (p[:, 1] + p[:, 3]) / 2
-            gw = mg[:, 2] - mg[:, 0]
-            gh = mg[:, 3] - mg[:, 1]
-            gcx = (mg[:, 0] + mg[:, 2]) / 2
-            gcy = (mg[:, 1] + mg[:, 3]) / 2
-            loc_t[n][pos] = np.stack(
-                [(gcx - pcx) / pw / v[:, 0], (gcy - pcy) / ph / v[:, 1],
-                 np.log(np.maximum(gw / pw, 1e-10)) / v[:, 2],
-                 np.log(np.maximum(gh / ph, 1e-10)) / v[:, 3]], axis=1)
+            # elementwise EncodeCenterSize per matched pair, NOT the
+            # pairwise grid (shared helper, box_coder_op.h:41 semantics)
+            loc_t[n][pos] = _encode_pairs(pb[pos], g[match[pos]], pbv[pos])
             conf_t[n][pos] = gl[match[pos]]
         # hard negative mining by conf loss on the background class
         best_iou = iou.max(axis=0) if len(g) else np.zeros(M)
@@ -1385,3 +1371,223 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noq
         out = out.reshape(-1, 4)
         var = var.reshape(-1, 4)
     return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+# -- RPN / RCNN training target assignment ----------------------------------
+
+# advancing sampler shared by the assign ops: the reference draws a NEW
+# random subset each training step; a per-call fixed seed would freeze it
+_DET_RNG = np.random.default_rng(17)
+
+
+def _np_iou_norm(a, b):
+    """Alias of _np_iou: pairwise IoU in the NORMALIZED-box convention
+    (iou_similarity(box_normalized=True) without the tensor round trip)."""
+    return _np_iou(a, b)
+
+
+def _np_iou(a, b):
+    """Pairwise IoU of [n,4] x [m,4] normalized/absolute corner boxes."""
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    ar_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ar_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(ar_a[:, None] + ar_b[None, :] - inter, 1e-10)
+
+
+def _encode_pairs(priors, gts, var):
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    gw = gts[:, 2] - gts[:, 0]
+    gh = gts[:, 3] - gts[:, 1]
+    gcx = (gts[:, 0] + gts[:, 2]) / 2
+    gcy = (gts[:, 1] + gts[:, 3]) / 2
+    return np.stack(
+        [(gcx - pcx) / pw / var[:, 0], (gcy - pcy) / ph / var[:, 1],
+         np.log(np.maximum(gw / pw, 1e-10)) / var[:, 2],
+         np.log(np.maximum(gh / ph, 1e-10)) / var[:, 3]], axis=1)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor sampling (reference detection/rpn_target_assign_op.cc):
+    straddle filter, force-match each gt's best anchor, IoU thresholds,
+    sample rpn_batch_size_per_im at rpn_fg_fraction. Host-side sampling
+    (data-dependent output size) like the reference CPU kernel.
+
+    Padded-dense gts (rows with w<=0 invalid). Returns (score_pred,
+    loc_pred, score_target, loc_target, bbox_inside_weight) gathered over
+    the sampled anchors, concatenated across the batch.
+    """
+    from ..framework.core import Tensor
+
+    bp = np.asarray(_arr(bbox_pred), np.float32)
+    cl = np.asarray(_arr(cls_logits), np.float32)
+    anchors = np.asarray(_arr(anchor_box), np.float32).reshape(-1, 4)
+    avar = np.asarray(_arr(anchor_var), np.float32).reshape(-1, 4)
+    gtb = np.asarray(_arr(gt_boxes), np.float32)
+    crowd = (np.asarray(_arr(is_crowd)).reshape(gtb.shape[0], -1)
+             if is_crowd is not None else np.zeros(gtb.shape[:2], np.int64))
+    info = np.asarray(_arr(im_info), np.float32)
+    N = bp.shape[0]
+    rng = _DET_RNG
+
+    sp, lp, st, lt, iw = [], [], [], [], []
+    for n in range(N):
+        im_h, im_w = float(info[n, 0]), float(info[n, 1])
+        if rpn_straddle_thresh >= 0:
+            inside = ((anchors[:, 0] >= -rpn_straddle_thresh)
+                      & (anchors[:, 1] >= -rpn_straddle_thresh)
+                      & (anchors[:, 2] < im_w + rpn_straddle_thresh)
+                      & (anchors[:, 3] < im_h + rpn_straddle_thresh))
+        else:
+            inside = np.ones(len(anchors), bool)
+        idx_in = np.where(inside)[0]
+        valid = ((gtb[n, :, 2] - gtb[n, :, 0]) > 0) & (crowd[n] == 0)
+        g = gtb[n][valid]
+        if len(g) == 0 or len(idx_in) == 0:
+            continue
+        iou = _np_iou(anchors[idx_in], g)              # [A, G]
+        max_iou = iou.max(axis=1)
+        argmax_g = iou.argmax(axis=1)
+        labels = -np.ones(len(idx_in), np.int64)
+        labels[max_iou < rpn_negative_overlap] = 0
+        # force-match: each gt's best anchor is positive
+        labels[iou.argmax(axis=0)] = 1
+        labels[max_iou >= rpn_positive_overlap] = 1
+
+        fg_idx = np.where(labels == 1)[0]
+        bg_idx = np.where(labels == 0)[0]
+        n_fg = int(min(len(fg_idx), rpn_fg_fraction * rpn_batch_size_per_im))
+        if len(fg_idx) > n_fg:
+            fg_idx = rng.permutation(fg_idx)[:n_fg] if use_random \
+                else fg_idx[:n_fg]
+        n_bg = int(min(len(bg_idx), rpn_batch_size_per_im - n_fg))
+        if len(bg_idx) > n_bg:
+            bg_idx = rng.permutation(bg_idx)[:n_bg] if use_random \
+                else bg_idx[:n_bg]
+
+        sel = np.concatenate([fg_idx, bg_idx])
+        gidx = idx_in[sel]
+        sp.append(cl[n].reshape(-1)[gidx])
+        lp.append(bp[n].reshape(-1, 4)[gidx])
+        st.append(np.concatenate([np.ones(len(fg_idx), np.int32),
+                                  np.zeros(len(bg_idx), np.int32)]))
+        tgt = np.zeros((len(sel), 4), np.float32)
+        if len(fg_idx):
+            fa = idx_in[fg_idx]
+            tgt[: len(fg_idx)] = _encode_pairs(
+                anchors[fa], g[argmax_g[fg_idx]], avar[fa])
+        lt.append(tgt)
+        w = np.zeros((len(sel), 4), np.float32)
+        w[: len(fg_idx)] = 1.0
+        iw.append(w)
+
+    cat = (lambda xs, sh: np.concatenate(xs)
+           if xs else np.zeros(sh, np.float32))
+    return (Tensor(jnp.asarray(cat(sp, (0,))[:, None])),
+            Tensor(jnp.asarray(cat(lp, (0, 4)))),
+            Tensor(jnp.asarray(cat(st, (0,)).astype(np.int32)[:, None])),
+            Tensor(jnp.asarray(cat(lt, (0, 4)))),
+            Tensor(jnp.asarray(cat(iw, (0, 4)))))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             *, rois_num=None):
+    """RCNN proposal sampling (reference
+    detection/generate_proposal_labels_op.cc SampleRoisForOneImage):
+    append gts to rois, split fg (iou>=fg_thresh) / bg
+    (bg_thresh_lo<=iou<bg_thresh_hi), sample at fg_fraction, emit
+    per-class box targets. rois are grouped per image via ``rois_num``
+    (the padded-dense stand-in for the reference's LoD).
+
+    Returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights, rois_num_out).
+    """
+    from ..framework.core import Tensor
+
+    rois = np.asarray(_arr(rpn_rois), np.float32)
+    rn = (np.asarray(_arr(rois_num)).reshape(-1).astype(np.int64)
+          if rois_num is not None else np.asarray([len(rois)], np.int64))
+    gtb = np.asarray(_arr(gt_boxes), np.float32)
+    gtc = np.asarray(_arr(gt_classes)).reshape(gtb.shape[0], -1)
+    crowd = (np.asarray(_arr(is_crowd)).reshape(gtb.shape[0], -1)
+             if is_crowd is not None else np.zeros(gtb.shape[:2], np.int64))
+    C = int(class_nums) if class_nums else int(gtc.max()) + 1
+    wts = np.asarray(bbox_reg_weights, np.float32)
+    rng = _DET_RNG
+
+    out_rois, out_lab, out_tgt, out_in, out_num = [], [], [], [], []
+    off = 0
+    for n in range(len(rn)):
+        r = rois[off: off + int(rn[n])]
+        off += int(rn[n])
+        valid = ((gtb[n, :, 2] - gtb[n, :, 0]) > 0) & (crowd[n] == 0)
+        g = gtb[n][valid]
+        gcls = gtc[n][valid]
+        cand = np.concatenate([r, g]) if len(g) and not is_cascade_rcnn \
+            else r
+        if len(cand) == 0 or len(g) == 0:
+            out_num.append(0)
+            continue
+        iou = _np_iou(cand, g)
+        max_iou = iou.max(axis=1)
+        gt_of = iou.argmax(axis=1)
+        fg = np.where(max_iou >= fg_thresh)[0]
+        bg = np.where((max_iou < bg_thresh_hi)
+                      & (max_iou >= bg_thresh_lo))[0]
+        n_fg = int(min(len(fg), fg_fraction * batch_size_per_im))
+        if len(fg) > n_fg:
+            fg = rng.permutation(fg)[:n_fg] if use_random else fg[:n_fg]
+        n_bg = int(min(len(bg), batch_size_per_im - n_fg))
+        if len(bg) > n_bg:
+            bg = rng.permutation(bg)[:n_bg] if use_random else bg[:n_bg]
+        sel = np.concatenate([fg, bg]).astype(np.int64)
+        labels = np.concatenate([gcls[gt_of[fg]],
+                                 np.zeros(len(bg), np.int64)])
+        enc = np.zeros((len(sel), 4), np.float32)
+        if len(fg):
+            # reference BoxToDelta divides each delta BY its weight
+            # (0.1 -> delta*10): _encode_pairs' var IS that weight
+            enc[: len(fg)] = _encode_pairs(
+                cand[fg], g[gt_of[fg]], np.tile(wts, (len(fg), 1)))
+        ncls = 1 if is_cls_agnostic else C
+        tgt = np.zeros((len(sel), 4 * ncls), np.float32)
+        inw = np.zeros_like(tgt)
+        for i in range(len(fg)):
+            c = 0 if is_cls_agnostic else int(labels[i])
+            tgt[i, 4 * c: 4 * c + 4] = enc[i]
+            inw[i, 4 * c: 4 * c + 4] = 1.0
+        out_rois.append(cand[sel])
+        out_lab.append(labels)
+        out_tgt.append(tgt)
+        out_in.append(inw)
+        out_num.append(len(sel))
+
+    ncls = 1 if is_cls_agnostic else C
+    cat = (lambda xs, sh: np.concatenate(xs)
+           if xs else np.zeros(sh, np.float32))
+    tgt_all = cat(out_tgt, (0, 4 * ncls))
+    inw_all = cat(out_in, (0, 4 * ncls))
+    outs = (Tensor(jnp.asarray(cat(out_rois, (0, 4)))),
+            Tensor(jnp.asarray(cat(out_lab, (0,)).astype(np.int32)[:, None])),
+            Tensor(jnp.asarray(tgt_all)),
+            Tensor(jnp.asarray(inw_all)),
+            Tensor(jnp.asarray(inw_all.copy())))
+    if rois_num is None:
+        # the reference's 5-output contract (fluid positional unpacking)
+        return outs
+    return outs + (Tensor(jnp.asarray(np.asarray(out_num, np.int32))),)

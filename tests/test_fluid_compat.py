@@ -367,9 +367,9 @@ class TestLayersBatch2:
             fluid.layers.dynamic_lstm(None, 4)
         with pytest.raises(NotImplementedError, match="BeamSearchDecoder"):
             fluid.layers.beam_search(None, None, None, None, None, 4)
-        with pytest.raises(NotImplementedError, match="iou_similarity"):
-            fluid.layers.rpn_target_assign(None, None, None, None, None,
-                                           None)
+        with pytest.raises(NotImplementedError, match="rpn_target_assign"):
+            fluid.layers.retinanet_target_assign(None, None, None, None,
+                                                 None, None, None)
         with pytest.raises(NotImplementedError, match="DataLoader"):
             fluid.layers.py_reader(64, [[2]], ["float32"])
 
