@@ -99,3 +99,216 @@ class Embedding(_Embedding2):
         super().__init__(int(size[0]), int(size[1]),
                          padding_idx=padding_idx, sparse=is_sparse,
                          weight_attr=param_attr)
+
+
+# -- remaining 1.x dygraph names ------------------------------------------
+
+from ..framework.core import Parameter  # noqa: E402
+from ..jit import TranslatedLayer, not_to_static  # noqa: F401,E402
+from ..jit import set_code_level, set_verbosity  # noqa: F401,E402
+from ..jit import to_static as declarative  # noqa: F401,E402
+from ..jit import to_static as dygraph_to_static_func  # noqa: F401,E402
+from ..nn import Conv3DTranspose, GRUCell, LSTMCell  # noqa: F401,E402
+from ..framework.core import no_grad as no_grad_  # noqa: F401,E402
+from ..framework.io import save, load  # noqa: F401,E402
+from ..optimizer.lr import (  # noqa: F401,E402
+    CosineAnnealingDecay as CosineDecay, ExponentialDecay,
+    InverseTimeDecay, LambdaDecay, LinearWarmup as LinearLrWarmup,
+    MultiStepDecay, NaturalExpDecay, NoamDecay, PiecewiseDecay,
+    PolynomialDecay, ReduceOnPlateau as ReduceLROnPlateau, StepDecay,
+)
+
+
+def enable_dygraph(place=None):
+    from .. import disable_static
+
+    disable_static()
+
+
+def disable_dygraph():
+    from .. import enable_static
+
+    enable_static()
+
+
+def prepare_context(strategy=None):
+    """1.x DataParallel bootstrap; the mesh runtime needs no context
+    object — init_parallel_env covers it."""
+    from ..distributed import init_parallel_env
+
+    init_parallel_env()
+    return None
+
+
+def start_gperf_profiler():
+    from ..profiler import start_profiler
+
+    start_profiler()
+
+
+def stop_gperf_profiler():
+    from ..profiler import stop_profiler
+
+    stop_profiler()
+
+
+class Pool2D(Layer):
+    """1.x Pool2D layer over the pooling functionals."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self._args = (pool_size, pool_type, pool_stride, pool_padding,
+                      global_pooling, ceil_mode, exclusive, data_format)
+
+    def forward(self, x):
+        from .layers import pool2d
+
+        size, ptype, stride, pad, gp, ceil, excl, df = self._args
+        return pool2d(x, size, ptype, stride, pad, gp, ceil, excl,
+                      data_format=df)
+
+
+class Flatten(Layer):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from .layers import flatten
+
+        return flatten(x, self.axis)
+
+
+class InstanceNorm(InstanceNorm2D):
+    """1.x name for InstanceNorm2D."""
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [int(channel)]
+        else:
+            shape = [int(s) for s in input_shape[1:]]
+        self.weight = self.create_parameter(shape=shape, attr=param_attr,
+                                            is_bias=False)
+        from ..nn import initializer as I
+
+        if param_attr is None or getattr(param_attr, "initializer",
+                                         None) is None:
+            self.weight.set_value(I.Constant(0.25)(tuple(shape), "float32"))
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        return F.prelu(x, self.weight)
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self.weight = self.create_parameter(
+            shape=[output_dim, input1_dim, input2_dim], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(shape=[output_dim],
+                                          attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ..framework.core import apply_op
+
+        def _btp(x, y, w, b):
+            return jnp.einsum("bd,kde,be->bk", x, w, y) + b
+
+        out = apply_op(_btp, x, y, self.weight, self.bias,
+                       op_name="bilinear_tensor_product")
+        if self._act:
+            from ..nn import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class NCE(Layer):
+    """1.x NCE layer (nce_op): owns the class weights; uniform sampler."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.seed = seed
+        self.weight = self.create_parameter(
+            shape=[num_total_classes, dim], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(shape=[num_total_classes],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):  # noqa: A002
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework.core import Tensor, apply_op
+        from ..framework.random import next_key
+
+        key = (jax.random.PRNGKey(self.seed) if self.seed else next_key())
+
+        def _nce(x, lab, w, b, key, num_neg_samples, num_total_classes):
+            neg = jax.random.randint(key, (num_neg_samples,), 0,
+                                     num_total_classes)
+            lab = lab.reshape(-1)
+            pos_logit = jnp.sum(x * w[lab], -1) + b[lab]
+            neg_logit = x @ w[neg].T + b[neg]
+            log_noise = jnp.log(jnp.asarray(
+                num_neg_samples / num_total_classes, x.dtype))
+            pos = jax.nn.softplus(-(pos_logit - log_noise))
+            negl = jax.nn.softplus(neg_logit - log_noise)
+            return (pos + jnp.sum(negl, -1))[:, None]
+
+        return apply_op(_nce, input, label, self.weight, self.bias,
+                        Tensor(key),
+                        num_neg_samples=int(self.num_neg_samples),
+                        num_total_classes=int(self.num_total_classes),
+                        op_name="nce")
+
+
+class GRUUnit(Layer):
+    """1.x GRUUnit layer over GRUCell (gru_unit_op: input is the
+    pre-projected [B, size] gate vector, hidden dim = size // 3).
+    The cell is created in __init__ so parameters()/state_dict() see the
+    weights before the first forward."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        from ..nn import GRUCell as _GRUCell
+
+        self._hidden = size // 3
+        self._cell = _GRUCell(size, self._hidden)
+
+    def forward(self, input, hidden):  # noqa: A002
+        out, new_h = self._cell(input, hidden)
+        return out, out, new_h
+
+
+class TreeConv(Layer):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "TreeConv (tree_conv_op) consumes LoD edge sets; per the README "
+            "LoD decision express tree convolution as gather + conv over "
+            "padded adjacency")

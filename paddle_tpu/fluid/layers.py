@@ -225,6 +225,10 @@ def dropout(x, dropout_prob, is_test=False, seed=None,
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
            pool_padding=0, global_pooling=False, ceil_mode=False,
            exclusive=True, data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        raise NotImplementedError(
+            "pool2d: NHWC is not wired through the pooling functionals; "
+            "transpose to NCHW (XLA lays out for the TPU regardless)")
     if global_pooling:
         if pool_type == "max":
             return _F.adaptive_max_pool2d(input, 1)
@@ -1111,11 +1115,10 @@ def _det_refusal(name, parts):
 
 
 from ..vision.ops import ssd_loss, target_assign  # noqa: F401,E402
-from ..vision.ops import rpn_target_assign  # noqa: F401,E402
-retinanet_target_assign = _det_refusal("retinanet_target_assign",
-                                       "rpn_target_assign with focal thresholds")
-retinanet_detection_output = _det_refusal(
-    "retinanet_detection_output", "yolo-style decode + multiclass_nms")
+from ..vision.ops import (  # noqa: F401,E402
+    retinanet_target_assign, rpn_target_assign,
+)
+from ..vision.ops import retinanet_detection_output  # noqa: F401,E402
 locality_aware_nms = _det_refusal("locality_aware_nms", "nms/matrix_nms")
 polygon_box_transform = _det_refusal("polygon_box_transform", "box_coder")
 box_decoder_and_assign = _det_refusal("box_decoder_and_assign",

@@ -33,7 +33,8 @@ __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "RoIAlign", "RoIPool", "yolo_loss", "DeformConv2D", "PSRoIPool",
            "read_file", "decode_jpeg", "ssd_loss", "target_assign",
            "density_prior_box", "rpn_target_assign",
-           "generate_proposal_labels"]
+           "generate_proposal_labels", "retinanet_target_assign",
+           "retinanet_detection_output"]
 
 
 def _arr(x):
@@ -1258,7 +1259,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         gl = gtl[n][valid]
         if len(g) == 0:
             continue
-        iou = _np_iou_norm(g, pb)
+        iou = _np_iou(g, pb)
         match, _dist = bipartite_match(Tensor(jnp.asarray(iou)),
                                        match_type=match_type,
                                        dist_threshold=overlap_threshold)
@@ -1378,12 +1379,6 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noq
 # advancing sampler shared by the assign ops: the reference draws a NEW
 # random subset each training step; a per-call fixed seed would freeze it
 _DET_RNG = np.random.default_rng(17)
-
-
-def _np_iou_norm(a, b):
-    """Alias of _np_iou: pairwise IoU in the NORMALIZED-box convention
-    (iou_similarity(box_normalized=True) without the tensor round trip)."""
-    return _np_iou(a, b)
 
 
 def _np_iou(a, b):
@@ -1530,11 +1525,17 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     wts = np.asarray(bbox_reg_weights, np.float32)
     rng = _DET_RNG
 
+    info = np.asarray(_arr(im_info), np.float32)
     out_rois, out_lab, out_tgt, out_in, out_num = [], [], [], [], []
     off = 0
     for n in range(len(rn)):
         r = rois[off: off + int(rn[n])]
         off += int(rn[n])
+        # reference op maps rpn_rois back to the ORIGINAL image frame
+        # (divides by im_info[2]) so they match the gt coordinates
+        scale = float(info[n, 2]) if info.shape[1] > 2 else 1.0
+        if scale != 1.0:
+            r = r / scale
         valid = ((gtb[n, :, 2] - gtb[n, :, 0]) > 0) & (crowd[n] == 0)
         g = gtb[n][valid]
         gcls = gtc[n][valid]
@@ -1591,3 +1592,141 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         # the reference's 5-output contract (fluid positional unpacking)
         return outs
     return outs + (Tensor(jnp.asarray(np.asarray(out_num, np.int32))),)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet anchor assignment (reference
+    detection/retinanet_target_assign): like rpn_target_assign but with NO
+    subsampling (focal loss consumes every anchor), per-class one-hot
+    score targets (-1 = ignore band), and the foreground count output.
+
+    Returns (score_pred [K, num_classes], loc_pred, score_target [K, 1],
+    loc_target, bbox_inside_weight, fg_num [1, 1])."""
+    from ..framework.core import Tensor
+
+    bp = np.asarray(_arr(bbox_pred), np.float32)
+    cl = np.asarray(_arr(cls_logits), np.float32)
+    anchors = np.asarray(_arr(anchor_box), np.float32).reshape(-1, 4)
+    avar = np.asarray(_arr(anchor_var), np.float32).reshape(-1, 4)
+    gtb = np.asarray(_arr(gt_boxes), np.float32)
+    gtl = np.asarray(_arr(gt_labels)).reshape(gtb.shape[0], -1)
+    crowd = (np.asarray(_arr(is_crowd)).reshape(gtb.shape[0], -1)
+             if is_crowd is not None else np.zeros(gtb.shape[:2], np.int64))
+    N = bp.shape[0]
+
+    sp, lp, st, lt, iw = [], [], [], [], []
+    fg_total = 0
+    for n in range(N):
+        valid = ((gtb[n, :, 2] - gtb[n, :, 0]) > 0) & (crowd[n] == 0)
+        g = gtb[n][valid]
+        gl = gtl[n][valid]
+        if len(g) == 0:
+            continue
+        iou = _np_iou(anchors, g)
+        max_iou = iou.max(axis=1)
+        argmax_g = iou.argmax(axis=1)
+        labels = -np.ones(len(anchors), np.int64)     # ignore band
+        labels[max_iou < negative_overlap] = 0
+        labels[iou.argmax(axis=0)] = 1
+        labels[max_iou >= positive_overlap] = 1
+        keep = labels >= 0                            # all non-ignored
+        fg = labels == 1
+        fg_total += int(fg.sum())
+        sel = np.where(keep)[0]
+        sp.append(cl[n].reshape(len(anchors), -1)[sel])
+        lp.append(bp[n].reshape(-1, 4)[sel])
+        # score target: gt CLASS for fg (1-based like the reference,
+        # 0 = background), 0 for bg
+        tgt_lab = np.zeros(len(sel), np.int32)
+        fg_sel = fg[sel]
+        tgt_lab[fg_sel] = gl[argmax_g[sel][fg_sel]].astype(np.int32)
+        st.append(tgt_lab)
+        enc = np.zeros((len(sel), 4), np.float32)
+        if fg_sel.any():
+            fa = sel[fg_sel]
+            enc[fg_sel] = _encode_pairs(anchors[fa], g[argmax_g[fa]],
+                                        avar[fa])
+        lt.append(enc)
+        w = np.zeros((len(sel), 4), np.float32)
+        w[fg_sel] = 1.0
+        iw.append(w)
+
+    cat = (lambda xs, sh: np.concatenate(xs)
+           if xs else np.zeros(sh, np.float32))
+    return (Tensor(jnp.asarray(cat(sp, (0, max(num_classes, 1))))),
+            Tensor(jnp.asarray(cat(lp, (0, 4)))),
+            Tensor(jnp.asarray(cat(st, (0,)).astype(np.int32)[:, None])),
+            Tensor(jnp.asarray(cat(lt, (0, 4)))),
+            Tensor(jnp.asarray(cat(iw, (0, 4)))),
+            Tensor(jnp.asarray(np.asarray([[max(fg_total, 1)]], np.int32))))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference (reference detection/retinanet_detection_output):
+    per FPN level decode bbox deltas against that level's anchors, keep
+    the nms_top_k best above score_threshold, then class-wise NMS merged
+    across levels. Lists are per level; batch N=1 per the reference's
+    per-image kernel looping."""
+    from ..framework.core import Tensor
+
+    info = np.asarray(_arr(im_info), np.float32)
+    N = info.shape[0]
+    all_det = []
+    for n in range(N):
+        boxes_l, scores_l = [], []
+        for bb, sc, an in zip(bboxes, scores, anchors):
+            b = np.asarray(_arr(bb), np.float32)[n]        # [M, 4] deltas
+            s = np.asarray(_arr(sc), np.float32)[n]        # [M, C] sigmoid
+            a = np.asarray(_arr(an), np.float32).reshape(-1, 4)
+            best = s.max(axis=1)
+            ok = best > score_threshold
+            order = np.argsort(-best[ok])[:nms_top_k]
+            idx = np.where(ok)[0][order]
+            if len(idx) == 0:
+                continue
+            # decode against anchors (variance-free, like the reference's
+            # retinanet decode: deltas are already variance-scaled)
+            aw = a[idx, 2] - a[idx, 0]
+            ah = a[idx, 3] - a[idx, 1]
+            acx = (a[idx, 0] + a[idx, 2]) / 2
+            acy = (a[idx, 1] + a[idx, 3]) / 2
+            d = b[idx]
+            cx = d[:, 0] * aw + acx
+            cy = d[:, 1] * ah + acy
+            w = np.exp(np.minimum(d[:, 2], _BBOX_CLIP)) * aw
+            h = np.exp(np.minimum(d[:, 3], _BBOX_CLIP)) * ah
+            # back to the ORIGINAL image frame: divide by im_scale and
+            # clip to the original extent (reference op semantics)
+            scale = float(info[n, 2]) if info.shape[1] > 2 else 1.0
+            im_h = info[n, 0] / scale
+            im_w = info[n, 1] / scale
+            dec = np.stack([np.clip((cx - w / 2) / scale, 0, im_w - 1),
+                            np.clip((cy - h / 2) / scale, 0, im_h - 1),
+                            np.clip((cx + w / 2) / scale, 0, im_w - 1),
+                            np.clip((cy + h / 2) / scale, 0, im_h - 1)],
+                           axis=1)
+            boxes_l.append(dec)
+            scores_l.append(s[idx])
+        if not boxes_l:
+            all_det.append(np.zeros((0, 6), np.float32))
+            continue
+        bx = np.concatenate(boxes_l)
+        scn = np.concatenate(scores_l)
+        # class-wise suppression delegates to multiclass_nms (same
+        # adaptive nms_eta semantics, no duplicated loop);
+        # background_label=-1: every retinanet class is a real class
+        det_t, _n = multiclass_nms(
+            Tensor(jnp.asarray(bx[None])),
+            Tensor(jnp.asarray(scn.T[None])),
+            score_threshold=score_threshold, nms_top_k=-1,
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            nms_eta=nms_eta, background_label=-1)
+        all_det.append(np.asarray(_arr(det_t), np.float32).reshape(-1, 6))
+    out = np.concatenate(all_det) if all_det else np.zeros((0, 6), np.float32)
+    return Tensor(jnp.asarray(out))

@@ -813,3 +813,64 @@ class TestRPNAssign:
             _t(np.array([[32., 32., 1.]], np.float32)), 8,  # positional
             class_nums=2, fg_thresh=0.5)
         assert len(out) == 5  # reference fluid unpack contract
+
+
+class TestRetinaNet:
+    def test_target_assign_no_subsampling_class_targets(self):
+        M = 12
+        anchors = np.array([[x * 8, y * 8, x * 8 + 16, y * 8 + 16]
+                            for x in range(4) for y in range(3)], np.float32)
+        bp = _t(np.zeros((1, M, 4), np.float32))
+        cl = _t(np.random.default_rng(1).standard_normal(
+            (1, M, 3)).astype(np.float32))
+        gtb = _t(np.array([[[0., 0., 16., 16.]]], np.float32))
+        gtl = _t(np.array([[2]]))
+        sp, lp, st, lt, iw, fg = ops.retinanet_target_assign(
+            bp, cl, _t(anchors), _t(np.ones((M, 4), np.float32)), gtb, gtl,
+            None, _t(np.array([[32., 40., 1.]], np.float32)), num_classes=3)
+        labels = st.numpy().ravel()
+        assert 2 in labels          # fg carries the gt class
+        assert int(fg.numpy()[0, 0]) >= 1
+        assert sp.shape[1] == 3     # per-class logits, no subsampling cap
+        # the exact-match anchor's loc target is zero with weight 1
+        fg_rows = np.where(labels == 2)[0]
+        np.testing.assert_allclose(lt.numpy()[fg_rows[0]], 0, atol=1e-5)
+        np.testing.assert_allclose(iw.numpy()[fg_rows[0]], 1.0)
+
+    def test_detection_output_thresholds_and_classes(self):
+        M = 12
+        anchors = np.array([[x * 8, y * 8, x * 8 + 16, y * 8 + 16]
+                            for x in range(4) for y in range(3)], np.float32)
+        deltas = _t(np.zeros((1, M, 4), np.float32))
+        s = np.full((1, M, 2), 0.01, np.float32)
+        s[0, 0, 1] = 0.9            # one confident class-1 box at anchor 0
+        det = ops.retinanet_detection_output(
+            [deltas], [_t(s)], [_t(anchors)],
+            _t(np.array([[32., 40., 1.]], np.float32)),
+            score_threshold=0.5)
+        d = det.numpy()
+        assert d.shape == (1, 6)
+        assert d[0, 0] == 1 and d[0, 1] > 0.89
+        np.testing.assert_allclose(d[0, 2:], [0, 0, 15, 15], atol=1.1)
+
+    def test_scale_aware_frames(self):
+        """im_info scale=2: rois/detections map back to the original
+        image frame (reference divides by im_info[2])."""
+        M = 12
+        anchors = np.array([[x * 8, y * 8, x * 8 + 16, y * 8 + 16]
+                            for x in range(4) for y in range(3)], np.float32)
+        deltas = _t(np.zeros((1, M, 4), np.float32))
+        s = np.full((1, M, 2), 0.01, np.float32)
+        s[0, 0, 1] = 0.9
+        det = ops.retinanet_detection_output(
+            [deltas], [_t(s)], [_t(anchors)],
+            _t(np.array([[64., 80., 2.]], np.float32)), score_threshold=0.5)
+        np.testing.assert_allclose(det.numpy()[0, 2:], [0, 0, 8, 8],
+                                   atol=1.1)
+        rois = _t(np.array([[0., 0., 30., 30.]], np.float32))
+        gtb = _t(np.array([[[0., 0., 16., 16.]]], np.float32))
+        r, lab, tgt, inw, outw = ops.generate_proposal_labels(
+            rois, _t(np.array([[1]])), None, gtb,
+            _t(np.array([[64., 64., 2.]], np.float32)), class_nums=2,
+            batch_size_per_im=8, fg_thresh=0.5, use_random=False)
+        assert 1 in lab.numpy().ravel()

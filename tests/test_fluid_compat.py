@@ -367,9 +367,8 @@ class TestLayersBatch2:
             fluid.layers.dynamic_lstm(None, 4)
         with pytest.raises(NotImplementedError, match="BeamSearchDecoder"):
             fluid.layers.beam_search(None, None, None, None, None, 4)
-        with pytest.raises(NotImplementedError, match="rpn_target_assign"):
-            fluid.layers.retinanet_target_assign(None, None, None, None,
-                                                 None, None, None)
+        with pytest.raises(NotImplementedError, match="nms"):
+            fluid.layers.locality_aware_nms(None, None, 0.5, 0.5, 100)
         with pytest.raises(NotImplementedError, match="DataLoader"):
             fluid.layers.py_reader(64, [[2]], ["float32"])
 
@@ -440,3 +439,45 @@ class TestLayersBatch2Regressions:
         o, h, c = fluid.layers.lstm(
             _t(RNG.random((5, 2, 4)).astype("float32")), h0, c0, 5, 8, 1)
         assert o.shape == [5, 2, 8]
+
+
+class TestDygraphSurface:
+    def test_full_dygraph_inventory_resolves(self):
+        import json
+        import os
+
+        inv = json.load(open(os.path.join(os.path.dirname(__file__),
+                                          "ref_api_inventory.json")))
+        miss = [n for n in inv["paddle.fluid.dygraph"]
+                if not hasattr(fluid.dygraph, n)]
+        assert not miss, miss
+
+    def test_dygraph_layer_shims(self):
+        x = _t(RNG.random((1, 2, 8, 8)).astype("float32"))
+        assert fluid.dygraph.Pool2D(2, "max", 2)(x).shape == [1, 2, 4, 4]
+        assert fluid.dygraph.Flatten()(x).shape == [1, 128]
+        assert fluid.dygraph.InstanceNorm(2)(x).shape == [1, 2, 8, 8]
+        pr = fluid.dygraph.PRelu("channel", channel=2)
+        assert pr(x).shape == [1, 2, 8, 8]
+        btp = fluid.dygraph.BilinearTensorProduct(3, 5, 6)
+        assert btp(_t(RNG.random((2, 3)).astype("float32")),
+                   _t(RNG.random((2, 5)).astype("float32"))).shape == [2, 6]
+        nce = fluid.dygraph.NCE(20, 4)
+        out = nce(_t(RNG.random((3, 4)).astype("float32")),
+                  _t(np.array([[1], [2], [0]])))
+        assert out.shape == [3, 1]
+        g = fluid.dygraph.GRUUnit(18)
+        assert len(list(g.parameters())) > 0  # weights exist pre-forward
+        h, _, _ = g(_t(RNG.random((2, 18)).astype("float32")),
+                    paddle.zeros([2, 6]))
+        assert h.shape == [2, 6]
+
+    def test_dygraph_decay_aliases_and_modes(self):
+        from paddle_tpu.optimizer.lr import LRScheduler
+
+        assert issubclass(fluid.dygraph.CosineDecay, LRScheduler)
+        assert issubclass(fluid.dygraph.NoamDecay, LRScheduler)
+        fluid.dygraph.enable_dygraph()
+        assert fluid.dygraph.enabled()
+        with pytest.raises(NotImplementedError, match="LoD"):
+            fluid.dygraph.TreeConv()
