@@ -265,7 +265,14 @@ class NCE(Layer):
         from ..framework.core import Tensor, apply_op
         from ..framework.random import next_key
 
-        key = (jax.random.PRNGKey(self.seed) if self.seed else next_key())
+        if self.seed:
+            # deterministic but ADVANCING stream: fold a call counter in,
+            # like static.nn.nce (a fixed key would freeze the negatives)
+            self._calls = getattr(self, "_calls", 0) + 1
+            key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                     self._calls)
+        else:
+            key = next_key()
 
         def _nce(x, lab, w, b, key, num_neg_samples, num_total_classes):
             neg = jax.random.randint(key, (num_neg_samples,), 0,
@@ -287,23 +294,44 @@ class NCE(Layer):
 
 
 class GRUUnit(Layer):
-    """1.x GRUUnit layer over GRUCell (gru_unit_op: input is the
-    pre-projected [B, size] gate vector, hidden dim = size // 3).
-    The cell is created in __init__ so parameters()/state_dict() see the
-    weights before the first forward."""
+    """1.x GRUUnit (gru_unit_op.h): input is the pre-projected [B, 3H]
+    gate vector; owns the hidden-to-gate weight [H, 3H]. Returns
+    (hidden, reset_hidden_pre = r*h_prev, gate = [u, r, c~] of width 3H)
+    — the reference's three-output contract."""
 
     def __init__(self, size, param_attr=None, bias_attr=None,
                  activation="tanh", gate_activation="sigmoid",
                  origin_mode=False, dtype="float32"):
         super().__init__()
-        from ..nn import GRUCell as _GRUCell
+        from ..nn import initializer as I
 
         self._hidden = size // 3
-        self._cell = _GRUCell(size, self._hidden)
+        self._origin_mode = origin_mode
+        self.weight = self.create_parameter(
+            shape=[self._hidden, size], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(shape=[size], attr=bias_attr,
+                                          is_bias=True)
 
     def forward(self, input, hidden):  # noqa: A002
-        out, new_h = self._cell(input, hidden)
-        return out, out, new_h
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework.core import apply_op
+
+        def _gru(x, h, w, b, H, origin_mode):
+            g = x + b
+            ur = jax.nn.sigmoid(g[:, : 2 * H] + h @ w[:, : 2 * H])
+            u, r = ur[:, :H], ur[:, H:]
+            rh = r * h
+            c = jnp.tanh(g[:, 2 * H:] + rh @ w[:, 2 * H:])
+            new_h = (u * h + (1 - u) * c) if origin_mode                 else ((1 - u) * h + u * c)
+            gate = jnp.concatenate([u, r, c], axis=1)
+            return new_h, rh, gate
+
+        return apply_op(_gru, input, hidden, self.weight, self.bias,
+                        H=self._hidden, origin_mode=self._origin_mode,
+                        op_name="gru_unit")
 
 
 class TreeConv(Layer):

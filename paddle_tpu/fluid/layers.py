@@ -797,13 +797,12 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,  # noqa: A002
 def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,  # noqa: A002
              activation="tanh", gate_activation="sigmoid",
              origin_mode=False):
-    """Single GRU step (gru_unit_op) via GRUCell; size = 3*hidden_dim."""
-    from ..nn import GRUCell
+    """Single GRU step (gru_unit_op): input is the pre-projected [B, size]
+    gates; returns (hidden, reset_hidden_pre, gate) like the reference."""
+    from .dygraph import GRUUnit
 
-    hidden_dim = size // 3
-    cell = GRUCell(int(input.shape[-1]), hidden_dim)
-    out, new_h = cell(input, hidden)
-    return out, out, new_h  # (hidden, reset_hidden_prev, gate) parity-ish
+    return GRUUnit(size, param_attr, bias_attr, activation,
+                   gate_activation, origin_mode)(input, hidden)
 
 
 def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
@@ -1119,8 +1118,9 @@ from ..vision.ops import (  # noqa: F401,E402
     retinanet_target_assign, rpn_target_assign,
 )
 from ..vision.ops import retinanet_detection_output  # noqa: F401,E402
-locality_aware_nms = _det_refusal("locality_aware_nms", "nms/matrix_nms")
-polygon_box_transform = _det_refusal("polygon_box_transform", "box_coder")
+from ..vision.ops import (  # noqa: F401,E402
+    locality_aware_nms, polygon_box_transform,
+)
 box_decoder_and_assign = _det_refusal("box_decoder_and_assign",
                                       "box_coder + argmax gather")
 roi_perspective_transform = _det_refusal("roi_perspective_transform",

@@ -34,7 +34,8 @@ __all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "nms",
            "read_file", "decode_jpeg", "ssd_loss", "target_assign",
            "density_prior_box", "rpn_target_assign",
            "generate_proposal_labels", "retinanet_target_assign",
-           "retinanet_detection_output"]
+           "retinanet_detection_output", "polygon_box_transform",
+           "locality_aware_nms"]
 
 
 def _arr(x):
@@ -1381,6 +1382,17 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noq
 _DET_RNG = np.random.default_rng(17)
 
 
+def _np_iou_off(a, b, off):
+    """Pairwise IoU with the unnormalized +off pixel convention."""
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt + off, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    ar_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    ar_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    return inter / np.maximum(ar_a[:, None] + ar_b[None, :] - inter, 1e-10)
+
+
 def _np_iou(a, b):
     """Pairwise IoU of [n,4] x [m,4] normalized/absolute corner boxes."""
     lt = np.maximum(a[:, None, :2], b[None, :, :2])
@@ -1686,7 +1698,9 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
             a = np.asarray(_arr(an), np.float32).reshape(-1, 4)
             best = s.max(axis=1)
             ok = best > score_threshold
-            order = np.argsort(-best[ok])[:nms_top_k]
+            order = np.argsort(-best[ok])
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
             idx = np.where(ok)[0][order]
             if len(idx) == 0:
                 continue
@@ -1729,4 +1743,89 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
             nms_eta=nms_eta, background_label=-1)
         all_det.append(np.asarray(_arr(det_t), np.float32).reshape(-1, 6))
     out = np.concatenate(all_det) if all_det else np.zeros((0, 6), np.float32)
-    return Tensor(jnp.asarray(out))
+    nums = np.asarray([len(d) for d in all_det], np.int32)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(nums))
+
+
+def polygon_box_transform(input, name=None):  # noqa: A002
+    """detection/polygon_box_transform_op.cc: offsets → absolute quad
+    coords per 4x-downsampled cell: even channels 4*j - in, odd 4*i - in."""
+    from ..framework.core import apply_op
+
+    def _impl(x):
+        n, c, h, w = x.shape
+        jj = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+        ii = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+        even = jnp.arange(c)[None, :, None, None] % 2 == 0
+        return jnp.where(even, 4.0 * jj - x, 4.0 * ii - x)
+
+    return apply_op(_impl, input, op_name="polygon_box_transform")
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """EAST-style NMS (reference detection/locality_aware_nms_op.cc):
+    first a sequential pass score-weight-merges CONSECUTIVE boxes whose
+    IoU with the running box exceeds nms_threshold (scores add), then
+    standard class-wise NMS runs on the merged set. Rectangle boxes
+    (box_size 4); the quad PolyIoU variants raise."""
+    from ..framework.core import Tensor
+
+    bx = np.asarray(_arr(bboxes), np.float32)
+    sc = np.asarray(_arr(scores), np.float32)
+    if bx.shape[-1] != 4:
+        raise NotImplementedError(
+            "locality_aware_nms: quad boxes (PolyIoU) not supported; "
+            "rectangles only")
+    off = 0.0 if normalized else 1.0
+    N = bx.shape[0]
+    outs, nums = [], []
+    for n in range(N):
+        b = bx[n]
+        s = sc[n]                              # [C, M]
+        dets = []
+        for c in range(s.shape[0]):
+            if c == background_label:
+                continue
+            box_c = b.copy()
+            s_c = s[c].copy()
+            skip = np.ones(len(box_c), bool)
+            idx = -1
+            for i in range(len(box_c)):
+                if idx > -1:
+                    ov = _np_iou_off(box_c[i][None], box_c[idx][None],
+                                     off)[0, 0]
+                    if ov > nms_threshold:
+                        tot = s_c[i] + s_c[idx]
+                        box_c[idx] = (box_c[i] * s_c[i]
+                                      + box_c[idx] * s_c[idx]) / tot
+                        s_c[idx] = tot
+                    else:
+                        skip[idx] = False
+                        idx = i
+                else:
+                    idx = i
+            if idx > -1:
+                skip[idx] = False
+            keep = (~skip) & (s_c > score_threshold)
+            if not keep.any():
+                continue
+            # second pass: delegate the class suppression to multiclass_nms
+            # (same sort/top-k/adaptive-eta path, offset handled there)
+            det_c, _cn = multiclass_nms(
+                Tensor(jnp.asarray(box_c[keep][None])),
+                Tensor(jnp.asarray(s_c[keep][None, None, :])),
+                score_threshold=0.0, nms_top_k=nms_top_k,
+                keep_top_k=-1, nms_threshold=nms_threshold,
+                normalized=normalized, nms_eta=nms_eta,
+                background_label=-1)
+            for row in np.asarray(_arr(det_c)).reshape(-1, 6):
+                dets.append([float(c), *row[1:]])
+        dets.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.append(np.asarray(dets, np.float32).reshape(-1, 6))
+        nums.append(len(dets))
+    return (Tensor(jnp.asarray(np.concatenate(outs))),
+            Tensor(jnp.asarray(np.asarray(nums, np.int32))))

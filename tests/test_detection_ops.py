@@ -844,10 +844,11 @@ class TestRetinaNet:
         deltas = _t(np.zeros((1, M, 4), np.float32))
         s = np.full((1, M, 2), 0.01, np.float32)
         s[0, 0, 1] = 0.9            # one confident class-1 box at anchor 0
-        det = ops.retinanet_detection_output(
+        det, nums = ops.retinanet_detection_output(
             [deltas], [_t(s)], [_t(anchors)],
             _t(np.array([[32., 40., 1.]], np.float32)),
             score_threshold=0.5)
+        assert nums.numpy().tolist() == [1]
         d = det.numpy()
         assert d.shape == (1, 6)
         assert d[0, 0] == 1 and d[0, 1] > 0.89
@@ -862,7 +863,7 @@ class TestRetinaNet:
         deltas = _t(np.zeros((1, M, 4), np.float32))
         s = np.full((1, M, 2), 0.01, np.float32)
         s[0, 0, 1] = 0.9
-        det = ops.retinanet_detection_output(
+        det, _nums = ops.retinanet_detection_output(
             [deltas], [_t(s)], [_t(anchors)],
             _t(np.array([[64., 80., 2.]], np.float32)), score_threshold=0.5)
         np.testing.assert_allclose(det.numpy()[0, 2:], [0, 0, 8, 8],
@@ -874,3 +875,27 @@ class TestRetinaNet:
             _t(np.array([[64., 64., 2.]], np.float32)), class_nums=2,
             batch_size_per_im=8, fg_thresh=0.5, use_random=False)
         assert 1 in lab.numpy().ravel()
+
+
+class TestEastOps:
+    def test_polygon_box_transform(self):
+        x = _t(np.zeros((1, 8, 2, 2), np.float32))
+        pt = ops.polygon_box_transform(x).numpy()
+        assert pt[0, 0, 1, 1] == 4.0   # even channel: 4*j
+        assert pt[0, 1, 1, 1] == 4.0   # odd channel: 4*i
+        assert pt[0, 1, 0, 1] == 0.0   # row 0 odd channel
+
+    def test_locality_aware_nms_merges_consecutive(self):
+        bx = _t(np.array([[[0., 0., 10., 10.], [2., 0., 12., 10.],
+                           [50., 50., 60., 60.]]], np.float32))
+        sc = _t(np.array([[[0.8, 0.4, 0.9]]], np.float32))
+        out, num = ops.locality_aware_nms(bx, sc, 0.1, -1, 10,
+                                          nms_threshold=0.3)
+        o = out.numpy()
+        assert int(num.numpy()[0]) == 2
+        merged = o[o[:, 1] > 1.0][0]
+        np.testing.assert_allclose(merged[1], 1.2, rtol=1e-5)  # scores add
+        np.testing.assert_allclose(merged[2], 2 * 0.4 / 1.2, atol=1e-5)
+        with pytest.raises(NotImplementedError, match="quad"):
+            ops.locality_aware_nms(_t(np.zeros((1, 1, 8), np.float32)),
+                                   sc, 0.1, -1, 10)

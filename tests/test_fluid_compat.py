@@ -367,8 +367,9 @@ class TestLayersBatch2:
             fluid.layers.dynamic_lstm(None, 4)
         with pytest.raises(NotImplementedError, match="BeamSearchDecoder"):
             fluid.layers.beam_search(None, None, None, None, None, 4)
-        with pytest.raises(NotImplementedError, match="nms"):
-            fluid.layers.locality_aware_nms(None, None, 0.5, 0.5, 100)
+        with pytest.raises(NotImplementedError, match="roi_align"):
+            fluid.layers.generate_mask_labels(None, None, None, None, None,
+                                              None, None, None)
         with pytest.raises(NotImplementedError, match="DataLoader"):
             fluid.layers.py_reader(64, [[2]], ["float32"])
 
@@ -481,3 +482,23 @@ class TestDygraphSurface:
         assert fluid.dygraph.enabled()
         with pytest.raises(NotImplementedError, match="LoD"):
             fluid.dygraph.TreeConv()
+
+
+class TestGruNceContracts:
+    def test_gru_unit_three_outputs(self):
+        g = fluid.dygraph.GRUUnit(18)
+        assert len(list(g.parameters())) == 2
+        h, rh, gate = g(_t(RNG.random((2, 18)).astype("float32")),
+                        paddle.zeros([2, 6]))
+        assert h.shape == [2, 6] and rh.shape == [2, 6]
+        assert gate.shape == [2, 18]    # [u, r, c~], width = size
+        h2, rh2, gate2 = fluid.layers.gru_unit(
+            _t(RNG.random((2, 18)).astype("float32")),
+            paddle.zeros([2, 6]), 18)
+        assert gate2.shape == [2, 18]
+
+    def test_nce_seeded_negatives_advance(self):
+        n = fluid.dygraph.NCE(50, 4, seed=7, num_neg_samples=5)
+        x = _t(RNG.random((3, 4)).astype("float32"))
+        lab = _t(np.array([[1], [2], [0]]))
+        assert not np.allclose(n(x, lab).numpy(), n(x, lab).numpy())
