@@ -4,6 +4,10 @@ from .base.fleet_base import Fleet, fleet  # noqa: F401
 from .base.topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .utils.recompute import recompute  # noqa: F401
+from .base.fleet_base import Role, UtilBase  # noqa: F401
+from .data_generator import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
 
 # module-level facade functions (reference fleet/__init__.py re-exports)
 init = fleet.init
@@ -18,6 +22,33 @@ barrier_worker = fleet.barrier_worker
 is_server = fleet.is_server
 is_worker = fleet.is_worker
 stop_worker = fleet.stop_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+state_dict = fleet.state_dict
+set_state_dict = fleet.set_state_dict
+shrink = fleet.shrink
+
+
+def worker_endpoints(to_string=False):
+    eps = fleet.worker_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+def server_num():
+    return fleet.server_num
+
+
+def server_index():
+    return fleet.server_index
+
+
+def server_endpoints(to_string=False):
+    eps = fleet.server_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+util = fleet.util  # instance attribute, reference spelling fleet.util.xxx
 
 
 def worker_num():

@@ -192,5 +192,131 @@ class Fleet:
     def stop_worker(self):
         pass
 
+    @property
+    def worker_endpoints(self):
+        """Per-PROCESS endpoints from the launcher env. Note the unit
+        difference from worker_num, which counts mesh devices: one process
+        drives worker_num/len(endpoints) devices."""
+        import os
+
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:0"]
+
+    @property
+    def server_num(self):
+        return 0  # no parameter servers: README PS decision
+
+    @property
+    def server_index(self):
+        return -1
+
+    @property
+    def server_endpoints(self):
+        return []
+
+    @property
+    def util(self):
+        if getattr(self, "_util", None) is None:
+            self._util = UtilBase(self)
+        return self._util
+
+    def init_worker(self):
+        raise NotImplementedError(
+            "init_worker belongs to parameter-server mode; see the README "
+            "parameter-server decision (collective mode needs no worker "
+            "bring-up beyond fleet.init)")
+
+    def init_server(self, *args, **kwargs):
+        raise NotImplementedError(
+            "init_server: no parameter servers (README PS decision)")
+
+    def run_server(self):
+        raise NotImplementedError(
+            "run_server: no parameter servers (README PS decision)")
+
+    def state_dict(self):
+        """PS-mode table snapshot in the reference; collective mode's
+        training state lives in the model/optimizer state_dicts."""
+        return {}
+
+    def set_state_dict(self, state):
+        return None
+
+    def shrink(self, threshold=None):
+        raise NotImplementedError(
+            "shrink compacts PS sparse tables (README PS decision)")
+
 
 fleet = Fleet()
+
+
+class Role:
+    """Reference role_maker.Role enum (WORKER/SERVER/HETER_WORKER/ALL)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+def _proc_world():
+    """(process_rank, process_count) of the LAUNCHER world.
+
+    The util surface operates on host PYTHON values across trainer
+    PROCESSES (the reference's gloo world), not mesh devices: on this
+    runtime one process drives many devices (Fleet.worker_num counts
+    devices for topology math), so file sharding and host reductions must
+    use the process world or a single-host multi-device run would
+    silently drop data.
+    """
+    import os
+
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    n = len(eps.split(",")) if eps else 1
+    return env.get_process_rank() if hasattr(env, "get_process_rank")         else int(os.environ.get("PADDLE_TRAINER_ID", 0)), max(n, 1)
+
+
+class UtilBase:
+    """Cross-worker utility surface (reference fleet/base/util_factory.py)
+    over the PROCESS world (see _proc_world): host-side helpers, not the
+    compiled-step device collectives.
+    """
+
+    def __init__(self, fleet_obj=None):
+        self._fleet = fleet_obj
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        arr = np.asarray(input)
+        _rank, n = _proc_world()
+        if n == 1:
+            return arr  # one process: every mode reduces to identity
+        raise NotImplementedError(
+            "UtilBase.all_reduce across launcher processes needs a host "
+            "store; reduce inside the compiled step "
+            "(paddle.distributed.all_reduce) instead")
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        _rank, n = _proc_world()
+        if n == 1:
+            return [input]
+        raise NotImplementedError(
+            "UtilBase.all_gather across launcher processes needs a host "
+            "store; gather inside the compiled step instead")
+
+    def barrier(self, comm_world="worker"):
+        from ... import collective
+
+        collective.barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over trainer PROCESSES (reference
+        util_factory.get_file_shard): each process feeds all its local
+        devices from its stripe."""
+        i, n = _proc_world()
+        per, rem = divmod(len(files), n)
+        start = i * per + min(i, rem)
+        return files[start: start + per + (1 if i < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if _proc_world()[0] == rank_id:
+            print(message)

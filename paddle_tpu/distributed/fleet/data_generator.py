@@ -1,0 +1,80 @@
+"""MultiSlot data generators (reference
+python/paddle/distributed/fleet/data_generator/data_generator.py):
+user subclasses implement generate_sample; the generator formats samples
+into the slot text protocol that the Dataset pipe consumes."""
+from __future__ import annotations
+
+import sys
+
+
+class DataGenerator:
+    def __init__(self):
+        self._line_processor = None
+        self.batch_size_ = 1
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclasses implement generate_sample(line) returning an "
+            "iterator of [(slot_name, [values...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def _flush(self, buf):
+        # samples flow through the generate_batch hook per batch_size_
+        # (reference data_generator.py: subclasses override it for
+        # in-batch shuffling/padding)
+        for sample in self.generate_batch(buf)():
+            if sample is not None:
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_stdin(self):
+        buf = []
+        for line in sys.stdin:
+            for user_parsed_line in self.generate_sample(line)():
+                if user_parsed_line is None:
+                    continue
+                buf.append(user_parsed_line)
+                if len(buf) == self.batch_size_:
+                    self._flush(buf)
+                    buf = []
+        if buf:
+            self._flush(buf)
+
+    def run_from_memory(self):
+        buf = []
+        for line in self.generate_sample(None)():
+            if line is None:
+                continue
+            buf.append(line)
+            if len(buf) == self.batch_size_:
+                self._flush(buf)
+                buf = []
+        if buf:
+            self._flush(buf)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Slot protocol: `<n> <v1> ... <vn>` per slot, space-joined
+    (reference _gen_str; the slot ORDER carries the schema)."""
+
+    def _gen_str(self, line):
+        parts = []
+        for _name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """Same slot protocol; the reference variant only skips type checks."""

@@ -193,3 +193,70 @@ class TestFleetPipeline:
             np.testing.assert_allclose(float(loss._data) * 1.0,
                                        float(ref_loss._data),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestFleetSurfaceExtras:
+    def test_namespace_names(self):
+        for n in ["DistributedStrategy", "UtilBase", "UserDefinedRoleMaker",
+                  "PaddleCloudRoleMaker", "Fleet", "MultiSlotDataGenerator",
+                  "MultiSlotStringDataGenerator", "Role"]:
+            assert hasattr(fleet, n), n
+        for n in ["worker_endpoints", "server_num", "server_index",
+                  "server_endpoints", "util", "init_worker", "init_server",
+                  "run_server", "state_dict", "set_state_dict", "shrink"]:
+            assert hasattr(fleet, n), n
+
+    def test_data_generator_slot_protocol(self):
+        g = fleet.MultiSlotDataGenerator()
+        assert g._gen_str([("label", [1]), ("feat", [3, 4, 5])]) \
+            == "1 1 3 3 4 5\n"
+
+    def test_util_file_shard_process_world(self):
+        """File sharding uses the PROCESS world: a single-process
+        multi-device run keeps ALL files (device-count sharding would
+        silently drop most of the data)."""
+        files = [f"part-{i}" for i in range(7)]
+        assert fleet.fleet.util.get_file_shard(files) == files
+        assert fleet.util.get_file_shard(files) == files  # attr spelling
+
+    def test_util_host_collectives_single_process(self):
+        u = fleet.fleet.util
+        np.testing.assert_allclose(u.all_reduce(np.asarray([3.0])), [3.0])
+        assert len(u.all_gather(1)) == 1
+
+    def test_data_generator_batch_hook(self):
+        import io
+        import sys
+
+        class G(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    yield [("v", [int(line)])]
+
+                return gen
+
+            def generate_batch(self, samples):
+                def gen():
+                    for s in samples:   # hook doubles every value
+                        yield [(n, [v * 2 for v in vals]) for n, vals in s]
+
+                return gen
+
+        g = G()
+        g.set_batch(2)
+        old_in, old_out = sys.stdin, sys.stdout
+        sys.stdin = io.StringIO("1\n2\n3\n")
+        sys.stdout = io.StringIO()
+        try:
+            g.run_from_stdin()
+            out = sys.stdout.getvalue()
+        finally:
+            sys.stdin, sys.stdout = old_in, old_out
+        assert out == "1 2\n1 4\n1 6\n"
+
+    def test_ps_methods_raise_with_decision(self):
+        for fn in (fleet.init_worker, fleet.run_server, fleet.shrink):
+            with pytest.raises(NotImplementedError):
+                fn()
+        assert fleet.server_num() == 0
+        assert fleet.state_dict() == {}
