@@ -4,6 +4,7 @@ from .base.fleet_base import Fleet, fleet  # noqa: F401
 from .base.topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .utils.recompute import recompute  # noqa: F401
+from .utils.fs import HDFSClient, LocalFS  # noqa: F401
 from .base.fleet_base import Role, UtilBase  # noqa: F401
 from .data_generator import (  # noqa: F401
     DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,

@@ -260,3 +260,38 @@ class TestFleetSurfaceExtras:
                 fn()
         assert fleet.server_num() == 0
         assert fleet.state_dict() == {}
+
+
+class TestFS:
+    def test_local_fs_roundtrip(self, tmp_path):
+        fs = fleet.LocalFS()
+        d = str(tmp_path / "ckpt")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "ckpt" / "model.pdparams")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "ckpt"))
+        assert files == ["model.pdparams"] and dirs == []
+        fs.mv(f, f + ".bak")
+        assert fs.is_exist(f + ".bak") and not fs.is_exist(f)
+        from paddle_tpu.distributed.fleet.utils import fs as fsmod
+
+        with pytest.raises(fsmod.FSFileNotExistsError):
+            fs.mv(f, f + ".x")
+        assert not fs.need_upload_download()
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_client_gated(self):
+        c = fleet.HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(FileNotFoundError, match="hadoop"):
+            c.mkdirs("/tmp/x")
+        assert c.need_upload_download()
+
+    def test_hdfs_predicates_do_not_swallow_missing_binary(self):
+        c = fleet.HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(FileNotFoundError, match="hadoop"):
+            c.is_exist("/ckpt/latest")
+        with pytest.raises(FileNotFoundError):
+            c.is_dir("/ckpt")
