@@ -271,9 +271,13 @@ def _proc_world():
     """
     import os
 
+    # jax-native multi-process launches (jax.distributed) first, then the
+    # launcher's PADDLE_* env, else single process
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
     eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
-    n = len(eps.split(",")) if eps else 1
-    return env.get_process_rank() if hasattr(env, "get_process_rank")         else int(os.environ.get("PADDLE_TRAINER_ID", 0)), max(n, 1)
+    n = max(len(eps.split(",")) if eps else 1, 1)
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0)), n
 
 
 class UtilBase:

@@ -17,8 +17,9 @@ class DataGenerator:
 
     def generate_sample(self, line):
         raise NotImplementedError(
-            "subclasses implement generate_sample(line) returning an "
-            "iterator of [(slot_name, [values...]), ...]")
+            "subclasses implement generate_sample(line) returning a "
+            "CALLABLE (a generator function) whose iteration yields "
+            "samples of [(slot_name, [values...]), ...]")
 
     def generate_batch(self, samples):
         def local_iter():

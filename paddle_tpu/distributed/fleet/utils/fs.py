@@ -151,7 +151,9 @@ class HDFSClient(FS):
 
     def _run(self, *args):
         if not self._hadoop or not os.path.exists(self._hadoop):
-            raise RuntimeError(
+            # distinct type: predicate methods must NOT swallow this into
+            # a False answer (a checkpoint manager would silently restart)
+            raise FileNotFoundError(
                 "HDFSClient needs a hadoop binary (hadoop_home=...); none "
                 "is available in this environment — use LocalFS, or mount "
                 "the checkpoint directory")
@@ -209,6 +211,12 @@ class HDFSClient(FS):
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
            test_exists=False):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        elif not overwrite and self.is_exist(fs_dst_path):
+            raise FSFileExistsError(fs_dst_path)
         self._run("-mv", fs_src_path, fs_dst_path)
 
     def list_dirs(self, fs_path):
