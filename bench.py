@@ -57,8 +57,13 @@ V5E_PEAK_BF16_FLOPS = 197e12  # Cloud TPU v5e: 197 TFLOPs bf16 per chip
 # -- pure-device timing for jittable train steps ---------------------------
 
 def _device_step_seconds(cfg, batch, K=10, reps=2, loss_chunk=None,
-                         optimizer="adamw"):
-    """K optimizer steps inside one jit; returns (sec/step, n_params)."""
+                         optimizer="adamw", mv_dtype=None):
+    """K optimizer steps inside one jit; returns (sec/step, n_params).
+
+    mv_dtype: AdamW moment storage dtype (bf16 halves optimizer-state HBM
+    footprint/traffic; update math stays fp32 — train_step.py)."""
+    import functools as _ft
+
     import jax
     import jax.numpy as jnp
 
@@ -68,9 +73,13 @@ def _device_step_seconds(cfg, batch, K=10, reps=2, loss_chunk=None,
                                                 pure_sgd_init,
                                                 pure_sgd_update)
 
-    init_fn, upd_fn = ((pure_adamw_init, pure_adamw_update)
-                       if optimizer == "adamw"
-                       else (pure_sgd_init, pure_sgd_update))
+    if optimizer == "adamw":
+        init_fn = (pure_adamw_init if mv_dtype is None else
+                   _ft.partial(pure_adamw_init, mv_dtype=mv_dtype))
+        upd_fn = (pure_adamw_update if mv_dtype is None else
+                  _ft.partial(pure_adamw_update, mv_dtype=mv_dtype))
+    else:
+        init_fn, upd_fn = pure_sgd_init, pure_sgd_update
     rng = np.random.default_rng(0)
     params = jax.device_put(gpt_init(cfg, seed=0))
     opt = init_fn(params)
@@ -129,11 +138,23 @@ def bench_bert(on_accel):
     # r4 sweep (tools/exp_bert.py): batch 32 + remat OFF + chunked CE is
     # the single-chip sweet spot; under it flash beats XLA at 512 too
     # (278 vs 260 sps) — the r3 flash-512 loss was remat-induced.
+    # r5 (tools/exp_flash.py noremat2048): the flash regime at 2048 is
+    # batch 8 + remat OFF + chunked CE — BERT-base activations fit
+    # because the flash kernel never materializes the S^2 score matrices;
+    # 0.2605 -> 0.358 MFU. The XLA leg CANNOT run that regime (12 layers
+    # of saved fp32 [8,12,2048,2048] scores = 19GB, OOM), so it keeps
+    # remat+b4 — the memory headroom that unlocks the faster regime IS
+    # part of flash's win and is reported as such. Block-shape tuning
+    # itself was noise (512/1024 blocked == whole-seq within 0.3%).
+    # full unroll matters at 2048 too: rolled-scan flash_2048 measured
+    # 40.0 sps vs 52.1 unrolled (the scan boundary blocks cross-layer
+    # fusion); 12-layer BERT unroll compiles in tens of seconds (the
+    # minutes-long unroll warning applies to 24-layer GPT configs)
     for name, use_flash, seq, b, k, unroll, remat, chunk in (
             ("xla_512", False, 512, 32, 10, None, False, 256),
             ("flash_512", True, 512, 32, 10, None, False, 256),
-            ("xla_2048", False, 2048, 4, 6, 1, True, None),
-            ("flash_2048", True, 2048, 4, 6, 1, True, None)):
+            ("xla_2048", False, 2048, 4, 6, None, True, 256),
+            ("flash_2048", True, 2048, 8, 6, None, False, 256)):
         cfg = bert_base_config(remat=remat, use_flash=use_flash, seq_len=seq,
                                scan_unroll=unroll)
         dt, n = _device_step_seconds(cfg, b, K=k, loss_chunk=chunk)
@@ -161,6 +182,10 @@ def bench_ernie_large(on_accel):
     dt, n = _device_step_seconds(cfg, batch, K=8, loss_chunk=256)
     sps = batch / dt
     return {"sps": round(sps, 2), "mfu": round(_mfu(n, 512, sps), 4),
+            "vs_baseline": round(sps / 75.0, 4),
+            "baseline": "derived: ERNIE-large = BERT-large shapes; NVIDIA "
+                        "DeepLearningExamples BERT-large phase-2 (seq 512, "
+                        "fp16) ~75 seq/s per A100",
             "note": "bf16 compute + fp32 master, single chip; sharding+AMP "
                     "multi-chip path validated by dryrun_multichip"}
 
@@ -182,7 +207,15 @@ def bench_gpt_1p3b(on_accel):
     dt, n = _device_step_seconds(cfg, batch, K=4, loss_chunk=256,
                                  optimizer="sgd")
     sps = batch / dt
+    # GPT A100 baseline: published Megatron-LM-class A100 GPT training
+    # sustains ~150 TFLOP/s/GPU (0.48 of 312 peak); same-MFU transfer to
+    # v5e = 0.48*197e12/(6*N*T) samples/sec
+    base = 0.48 * 197e12 / (6.0 * n * cfg.seq_len)
     return {"sps": round(sps, 2), "mfu": round(_mfu(n, cfg.seq_len, sps), 4),
+            "vs_baseline": round(sps / base, 4),
+            "baseline": "derived: Megatron-LM-class A100 GPT training "
+                        "~150 TFLOP/s/GPU (0.48 MFU), same-MFU transfer "
+                        f"to v5e = {base:.2f} sps",
             "note": "bf16 params + flash + chunked CE, SGD: AdamW fp32 m/v "
                     "for 1.3B (10.6GB) exceeds one 16GB chip even with "
                     "donation; with ZeRO over 8 chips the per-chip state is "
@@ -202,25 +235,131 @@ def bench_gpt_760m_adamw(on_accel):
 
     if not on_accel:
         return None
-    cfg = GPTConfig(vocab_size=50304, hidden=1536, n_layers=24, n_heads=16,
+    # r5 (tools/exp_gpt760.py): 0.302 -> 0.502 MFU. What moved it:
+    # (1) head_dim support in the flash kernel — the r4 config (16 heads,
+    #     head_dim 96) silently fell back to XLA reference attention
+    #     (96 % 128 != 0); zero-padding to 128 inside the kernel wrapper
+    #     re-enabled flash and alone took b2 6.37 -> 8.33 sps;
+    # (2) n_heads=12 => head_dim 128 = MXU lane width (same params, same
+    #     6NT FLOPs, no pad waste): b4 9.46 -> 10.58 sps;
+    # (3) bf16 AdamW moments (fp32 update math) halve optimizer-state HBM
+    #     traffic and footprint, unlocking batch 4 without spills.
+    cfg = GPTConfig(vocab_size=50304, hidden=1536, n_layers=24, n_heads=12,
                     seq_len=2048, remat=True, use_flash=True,
                     param_dtype=jnp.bfloat16, scan_unroll=1)
-    # r4 sweep: b2 avoids the b4 memory-pressure spills (6.59 vs 5.91 sps)
-    batch = 2
+    batch = 4
     dt, n = _device_step_seconds(cfg, batch, K=4, loss_chunk=256,
-                                 optimizer="adamw")
+                                 optimizer="adamw", mv_dtype=jnp.bfloat16)
     sps = batch / dt
+    base = 0.48 * 197e12 / (6.0 * n * cfg.seq_len)
     return {"sps": round(sps, 2), "mfu": round(_mfu(n, cfg.seq_len, sps), 4),
-            "note": "GPT-3 760M, AdamW (fp32 m/v) + bf16 params + flash + "
-                    "chunked CE on one chip"}
+            "vs_baseline": round(sps / base, 4),
+            "baseline": "derived: Megatron-LM-class A100 GPT training "
+                        "~150 TFLOP/s/GPU (0.48 MFU), same-MFU transfer "
+                        f"to v5e = {base:.2f} sps",
+            "note": "GPT-3 760M (head_dim 128), AdamW (bf16 m/v, fp32 "
+                    "math) + bf16 params + flash + chunked CE on one chip; "
+                    "r5: flash head-dim fix + MXU-width heads + bf16 "
+                    "moments moved 0.302 -> ~0.50 MFU"}
+
+
+def bench_ring_attention(on_accel):
+    """Long-context flagship: ring attention (context parallelism) at seq
+    2048 on BERT-base shapes. Single chip: the ring axis has size 1, so
+    this measures the blockwise online-softmax compute path the ring
+    schedule runs per hop (throughput comparable against flash_2048); the
+    multi-device ppermute ring is validated structurally on the 8-device
+    virtual mesh (tests/test_ring_moe.py asserts the collective-permute
+    count in lowered HLO) and by dryrun_multichip."""
+    from paddle_tpu.models import bert_base_config
+    from paddle_tpu.parallel.mesh import create_mesh, set_mesh
+
+    if not on_accel:
+        return None
+    try:
+        create_mesh(dp=1, sharding=1, pp=1, mp=1)
+        # remat: the blockwise path materializes the local (S_loc x S_loc)
+        # block in f32 — at ring size 1 that is the full S^2, so saving it
+        # per layer would OOM (the multi-chip ring's S_loc = S/n shrinks
+        # it quadratically)
+        cfg = bert_base_config(remat=True, seq_len=2048, scan_unroll=1,
+                               ring_attention=True)
+        batch = 4
+        dt, n = _device_step_seconds(cfg, batch, K=6, loss_chunk=256)
+        sps = batch / dt
+        return {"sps": round(sps, 2),
+                "mfu": round(_mfu(n, 2048, sps), 4),
+                "note": "blockwise ring-attention path, ring size 1 on one "
+                        "chip; multi-chip ppermute ring validated on the "
+                        "virtual mesh (HLO collective-permute count) and "
+                        "in dryrun_multichip"}
+    finally:
+        set_mesh(None)
 
 
 # -- eager-TrainStep configs (dispatch included: the eager user's view) ----
 
-def bench_lenet(on_accel):
-    """BASELINE config 1: MNIST LeNet train step (synthetic data)."""
-    import paddle_tpu as paddle
+def _eager_and_device_sps(model, loss_fn, opt, batch_tensors, batch,
+                          on_accel, K=10, eager_iters=15):
+    """Measure BOTH views of a TrainStep config: per-call eager dispatch
+    (what an eager user pays, including axon-tunnel RTT here) and K steps
+    inside one jit (pure device time — the steady-state number the A100
+    DeepLearningExamples baselines report)."""
+    import functools as _ft
+
+    import jax
+
     from paddle_tpu.jit import TrainStep
+
+    step = TrainStep(model, loss_fn, opt)
+    loss = None
+    for _ in range(3):
+        loss = step(*batch_tensors)
+    float(loss._data)
+    n = eager_iters if on_accel else 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = step(*batch_tensors)
+    float(loss._data)
+    eager_sps = batch / ((time.perf_counter() - t0) / n)
+
+    impl = step._step_impl
+    lr = float(opt.get_lr())
+    arr_batch = tuple(t._data for t in batch_tensors)
+    params = {k: p._data for k, p in model.named_parameters()}
+    slots = dict(step._slot_values)
+    buffers = {k: b._data for k, b in model.named_buffers()
+               if b is not None}
+
+    @_ft.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def k_steps(params, slots, buffers):
+        def body(_, c):
+            p, s, b = c
+            np_, ns, nb, _ = impl(p, s, b, lr, arr_batch)
+            return (np_, ns, nb)
+
+        return jax.lax.fori_loop(0, K if on_accel else 2, body,
+                                 (params, slots, buffers))
+
+    out = k_steps(params, slots, buffers)
+    jax.block_until_ready(out[0])
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = k_steps(*out)
+        jax.block_until_ready(out[0])
+        best = min(best, (time.perf_counter() - t0) / (K if on_accel else 2))
+    return eager_sps, batch / best
+
+
+def bench_lenet(on_accel):
+    """BASELINE config 1: MNIST LeNet train step (synthetic data).
+
+    Returns (eager_sps, device_sps): the eager figure includes per-step
+    dispatch across the axon tunnel (~2x run-to-run variance); the device
+    figure is the dispatch-corrected throughput (VERDICT r4: report a
+    corrected figure, not just the noisy one)."""
+    import paddle_tpu as paddle
     from paddle_tpu.vision.models import LeNet
 
     paddle.seed(0)
@@ -232,61 +371,48 @@ def bench_lenet(on_accel):
         out = run_model(images)
         return paddle.nn.functional.cross_entropy(out, labels)
 
-    step = TrainStep(model, loss_fn, opt)
     batch = 256 if on_accel else 32
     rng = np.random.default_rng(0)
     images = paddle.to_tensor(
         rng.normal(size=(batch, 1, 28, 28)).astype("float32"))
     labels = paddle.to_tensor(rng.integers(0, 10, (batch,)).astype("int64"))
-
-    loss = None
-    for _ in range(3):
-        loss = step(images, labels)
-    float(loss._data)
-    n = 30 if on_accel else 5
-    t0 = time.perf_counter()
-    for _ in range(n):
-        loss = step(images, labels)
-    float(loss._data)
-    dt = (time.perf_counter() - t0) / n
-    return batch / dt
+    return _eager_and_device_sps(model, loss_fn, opt, (images, labels),
+                                 batch, on_accel, K=50, eager_iters=30)
 
 
 def bench_resnet50(on_accel):
-    """BASELINE config 2: ResNet-50, AMP bf16 (synthetic ImageNet shapes)."""
+    """BASELINE config 2: ResNet-50, AMP bf16 (synthetic ImageNet shapes).
+
+    Returns (eager_sps, device_sps); device = K steps in one jit, the
+    apples-to-apples number against the A100 DeepLearningExamples
+    steady-state throughput."""
     import paddle_tpu as paddle
-    from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
     model = resnet50(num_classes=1000)
+    # r5 sweep (tools/exp_resnet.py): b256 + O2 (bf16 params, fp32 norms)
+    # is the best of {b128,b256,b384} x {O1,O2,full-bf16}: 2203 vs 2141
+    # img/s; full-bf16 BN bought nothing (XLA already fuses the BN
+    # elementwise into conv epilogues)
+    if on_accel:
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
 
     def loss_fn(run_model, images, labels):
-        with paddle.amp.auto_cast(enable=True, level="O1"):
+        with paddle.amp.auto_cast(enable=True, level="O2"):
             out = run_model(images)
         return paddle.nn.functional.cross_entropy(out, labels)
 
-    step = TrainStep(model, loss_fn, opt)
-    batch = 128 if on_accel else 4
+    batch = 256 if on_accel else 4
     size = 224 if on_accel else 64
     rng = np.random.default_rng(0)
     images = paddle.to_tensor(
         rng.normal(size=(batch, 3, size, size)).astype("float32"))
     labels = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype("int64"))
-
-    loss = None
-    for _ in range(3):
-        loss = step(images, labels)
-    float(loss._data)
-    n = 15 if on_accel else 3
-    t0 = time.perf_counter()
-    for _ in range(n):
-        loss = step(images, labels)
-    float(loss._data)
-    dt = (time.perf_counter() - t0) / n
-    return batch / dt
+    return _eager_and_device_sps(model, loss_fn, opt, (images, labels),
+                                 batch, on_accel, K=10, eager_iters=15)
 
 
 def main():
@@ -307,31 +433,87 @@ def main():
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
-    bert_sps, mfu, flash_ab = bench_bert(on_accel)
+    def _release():
+        # Drop compiled executables + free device buffers between configs:
+        # measured cross-config interference (gpt_760m_adamw 10.5 -> 4.4
+        # sps when run after the b8 full-unroll flash A/B in the same
+        # process — HBM fragmentation); the on-disk compile cache makes
+        # re-lowering cheap.
+        import gc
+
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:  # noqa: BLE001
+            pass
 
     configs = {}
-    for name, fn in (("mnist_lenet", bench_lenet),
-                     ("resnet50_amp", bench_resnet50)):
-        try:
-            configs[name] = round(fn(on_accel), 2)
-        except Exception as e:  # noqa: BLE001 — auxiliary config must not kill the bench
-            configs[name] = f"error: {type(e).__name__}: {e}"
-    # lenet's per-step eager dispatch crosses the axon tunnel each step
-    # (~ms RTT on a ~2.9ms compute step), so this config tracks tunnel
-    # latency as much as framework dispatch: 38k-88k sps across identical
-    # code. On a locally attached TPU host the dispatch overhead is µs.
-    configs["mnist_lenet_note"] = (
-        "eager per-step dispatch includes axon-tunnel RTT; "
-        "throughput varies ~2x run-to-run with tunnel conditions")
+    # Derived per-config baselines (VERDICT r4 item 3 — every config
+    # carries vs_baseline + provenance; method = BASELINE.md's BERT
+    # derivation applied to each config's own public record):
+    # - ResNet-50: NVIDIA DeepLearningExamples ResNet-50 v1.5 PyTorch AMP,
+    #   DGX A100 8xA100 ~18.85k img/s => 2,356 per GPU — the SAME 8-GPU
+    #   table convention the BERT derivation uses (75 = 600/8).
+    #   Single-GPU-tuned runs reach ~2.5k (larger per-GPU batch); against
+    #   that figure our number reads ~0.88x — both stated for honesty.
+    # - LeNet: NO public A100 LeNet number exists (nobody benchmarks it);
+    #   eager LeNet is DISPATCH-bound, so the baseline is derived from
+    #   the public per-op overhead record instead: ~50us CUDA-launch +
+    #   framework dispatch per op x ~60 ops per fwd+bwd+opt step ~= 3ms
+    #   per eager step on any 2021-era framework => batch 256 ~= 85k
+    #   img/s. The device-loop figure (dispatch excluded) is reported
+    #   alongside, since the tunnel RTT makes the eager figure vary ~2x.
+    RESNET_A100_BASELINE = 2356.0
+    LENET_A100_BASELINE = 85000.0
+    try:
+        lenet_eager, lenet_dev = bench_lenet(on_accel)
+        configs["mnist_lenet"] = {
+            "sps": round(lenet_eager, 2),
+            "device_sps": round(lenet_dev, 2),
+            "vs_baseline": round(lenet_eager / LENET_A100_BASELINE, 4),
+            # the derived baseline models LOCAL ~50us/op dispatch; the
+            # axon tunnel adds ~ms RTT per eager step that a local-host
+            # deployment would not pay — the device figure is the
+            # dispatch-free bound
+            "vs_baseline_device": round(lenet_dev / LENET_A100_BASELINE, 4),
+            "baseline": "derived: eager dispatch model ~50us/op x ~60 "
+                        "ops => ~3ms/step, batch 256 => ~85k img/s on "
+                        "A100-class eager frameworks (no published LeNet "
+                        "benchmark exists)",
+            "note": "eager sps includes per-step axon-tunnel RTT (~2x "
+                    "run-to-run variance); device_sps is the "
+                    "dispatch-corrected figure (50 steps in one jit)"}
+    except Exception as e:  # noqa: BLE001 — auxiliary config must not kill the bench
+        configs["mnist_lenet"] = f"error: {type(e).__name__}: {e}"
+    try:
+        rn_eager, rn_dev = bench_resnet50(on_accel)
+        configs["resnet50_amp"] = {
+            "sps": round(rn_dev, 2),
+            "eager_sps": round(rn_eager, 2),
+            "vs_baseline": round(rn_dev / RESNET_A100_BASELINE, 4),
+            "baseline": "derived: DeepLearningExamples ResNet-50 v1.5 "
+                        "PyTorch AMP, DGX-A100 8-GPU ~18.85k img/s => "
+                        "2,356/GPU (same 8-GPU-table convention as the "
+                        "BERT derivation); single-GPU-tuned runs ~2.5k "
+                        "=> ~0.88x against that figure"}
+    except Exception as e:  # noqa: BLE001
+        configs["resnet50_amp"] = f"error: {type(e).__name__}: {e}"
+    _release()
     for name, fn in (("ernie_large_bf16", bench_ernie_large),
                      ("gpt_1p3b", bench_gpt_1p3b),
-                     ("gpt_760m_adamw", bench_gpt_760m_adamw)):
+                     ("gpt_760m_adamw", bench_gpt_760m_adamw),
+                     ("ring_attention", bench_ring_attention)):
         try:
             r = fn(on_accel)
             if r is not None:
                 configs[name] = r
         except Exception as e:  # noqa: BLE001
             configs[name] = f"error: {type(e).__name__}: {e}"
+        _release()
+
+    # the BERT headline + flash A/B runs LAST: its b8 full-unroll seq-2048
+    # legs leave the largest HBM footprint in the process
+    bert_sps, mfu, flash_ab = bench_bert(on_accel)
 
     out = {
         "metric": "bert_base_train_samples_per_sec_per_chip"

@@ -224,6 +224,43 @@ def pmean(x, axis_name):
     return jax.lax.pmean(x, axis_name)
 
 
+# Cross-process eager path (reference imperative/nccl_context.cc: eager
+# collectives work per-process over NCCL rings). TPU-native analog: the
+# multi-controller runtime's process_allgather (host-driven, rides the
+# same ICI/DCN transport jax.distributed set up). Covers the utility uses
+# the reference's eager path serves — metric all-reduce, eval-loop
+# broadcast, checkpoint-decision gathers; send/recv/alltoall stay
+# compiled-only (README 'eager collectives decision').
+
+def _multihost_eager(kind, arr, group, extra=None):
+    from jax.experimental import multihost_utils
+
+    g = group or _get_default_group()
+    if g.nranks != env.get_world_size():
+        raise NotImplementedError(
+            "cross-process eager collectives support only the full-world "
+            "group (subgroup rings need the compiled path)")
+    gathered = multihost_utils.process_allgather(np.asarray(arr))
+    if kind == "all_gather":
+        return gathered
+    if kind == "broadcast":
+        return jnp.asarray(gathered[int(extra)])
+    op = extra
+    if op == ReduceOp.SUM:
+        return jnp.asarray(gathered.sum(axis=0))
+    if op == ReduceOp.MAX:
+        return jnp.asarray(gathered.max(axis=0))
+    if op == ReduceOp.MIN:
+        return jnp.asarray(gathered.min(axis=0))
+    if op == ReduceOp.AVG:
+        return jnp.asarray(gathered.mean(axis=0))
+    raise ValueError(f"unsupported ReduceOp {op}")
+
+
+def _multi_process() -> bool:
+    return env.get_world_size() > 1
+
+
 # Tensor-level API ---------------------------------------------------------
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
@@ -233,6 +270,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
         return _rewrap(tensor, fn(arr, _axis_name(group)))
+    if _multi_process():
+        return _rewrap(tensor, _multihost_eager("all_reduce", arr, group, op))
     return _rewrap(tensor, _run_eager("all_reduce", arr, group,
                                       "all_reduce", op))
 
@@ -246,6 +285,10 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         total = fn(arr, axis)
         idx = jax.lax.axis_index(axis)
         return _rewrap(tensor, jnp.where(idx == dst, total, arr))
+    if _multi_process():
+        # every process computes the reduction; non-dst ranks keeping the
+        # value is harmless (reference leaves their buffers undefined)
+        return _rewrap(tensor, _multihost_eager("reduce", arr, group, op))
     return _rewrap(tensor, _run_eager("reduce", arr, group, "reduce",
                                       (int(dst), op)))
 
@@ -258,6 +301,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         out = jax.lax.psum(jnp.where(idx == src, arr, jnp.zeros_like(arr)),
                            axis)
         return _rewrap(tensor, out)
+    if _multi_process():
+        return _rewrap(tensor, _multihost_eager("broadcast", arr, group,
+                                                int(src)))
     return _rewrap(tensor, _run_eager("broadcast", arr, group, "broadcast",
                                       int(src)))
 
@@ -272,6 +318,13 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.extend(Tensor(out[i]) for i in range(n))
             return tensor_list
         return out
+    if _multi_process():
+        gathered = _multihost_eager("all_gather", arr, group)
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(jnp.asarray(g))
+                               for g in gathered)
+            return tensor_list
+        return jnp.asarray(gathered)
     mesh, ax, n = _eager_setup(arr, group, "all_gather")
     # rank-major input already holds every rank's tensor; still run the
     # real collective so the mesh path is exercised, then unstack. Each
@@ -298,6 +351,13 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         idx = jax.lax.axis_index(ax)
         picked = jnp.take(stacked, idx, axis=0)
         return _rewrap(tensor, picked)
+    if _multi_process():
+        # README 'eager collectives decision': scatter across processes is
+        # compiled-path only — fail loudly, never return local-only data
+        raise NotImplementedError(
+            "distributed.scatter: eager cross-process scatter is not "
+            "supported; use the compiled path (shard_map) — see README "
+            "'Eager-mode collective semantics'")
     # eager rank-major: rank i receives tensor_list[i]
     out = jnp.stack(arrs)
     return _rewrap(tensor, out)
@@ -375,6 +435,11 @@ def recv(tensor, src=0, group=None, sync_op=True, dst=None):
 
 
 def barrier(group=None):
+    if _multi_process():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
+        return
     jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
     (jnp.zeros(()) + 0).block_until_ready()
 

@@ -78,6 +78,13 @@ class Fleet:
             mp = int(hc.get("mp_degree", 1))
             pp = int(hc.get("pp_degree", 1))
             sh = int(hc.get("sharding_degree", 1))
+            # strategy.tensor_parallel (reference tensor_parallel
+            # meta-optimizer, static-graph mp): sets the "model" mesh axis
+            # when hybrid_configs hasn't already
+            if getattr(self._strategy, "tensor_parallel", False) and mp <= 1:
+                tp_cfg = getattr(self._strategy, "tensor_parallel_configs",
+                                 {}) or {}
+                mp = int(tp_cfg.get("tensor_parallel_degree", 1))
             n_needed = dp * mp * pp * sh
             devs = np.array(jax.devices())
             if n_needed <= 1:

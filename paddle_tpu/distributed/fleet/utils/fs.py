@@ -223,6 +223,13 @@ class HDFSClient(FS):
         return self.ls_dir(fs_path)[0]
 
     def touch(self, fs_path, exist_ok=True):
+        # mirror LocalFS.touch: -touchz would truncate an existing
+        # zero-length file (and error on a non-empty one), so an existing
+        # path returns or raises per exist_ok instead
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
         self._run("-touchz", fs_path)
 
     def cat(self, fs_path=None):

@@ -47,6 +47,14 @@ def _data_mesh():
 
 
 class DataParallel(Layer):
+    """``find_unused_parameters`` is accepted for API parity and is a
+    DOCUMENTED NO-OP: the reference Reducer needs unused-variable
+    detection (imperative/reducer.cc:972) because its per-grad allreduce
+    hooks would wait forever on grads that never arrive; here the grads
+    are produced by whole-graph autodiff and reduced in one pass over
+    whatever grads exist, so unused parameters simply contribute nothing
+    — there is no hook to unblock (README 'find_unused_parameters')."""
+
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):

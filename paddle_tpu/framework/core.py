@@ -355,17 +355,25 @@ class GradNode:
 
     ``vjp_fn`` closes over the op's residuals — the analog of a reference
     ``GradOpNode`` + its saved inputs
-    (/root/reference/paddle/fluid/imperative/op_base.h).
+    (/root/reference/paddle/fluid/imperative/op_base.h). ``fn`` and
+    ``arg_arrays`` keep the op's pure function + primal args so the
+    double-grad path (create_graph=True, reference
+    imperative/partial_grad_engine.cc) can re-run the vjp THROUGH
+    apply_op — recording the grad computation itself on the tape.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_out", "name")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_out", "name",
+                 "fn", "arg_arrays")
 
-    def __init__(self, vjp_fn, inputs, out_avals, multi_out, name):
+    def __init__(self, vjp_fn, inputs, out_avals, multi_out, name,
+                 fn=None, arg_arrays=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # tuple[Tensor | None] (None for non-diff args)
         self.out_avals = out_avals  # [(shape, dtype)]
         self.multi_out = multi_out
         self.name = name
+        self.fn = fn                  # pure fn with attrs bound
+        self.arg_arrays = arg_arrays  # primal args (raw arrays)
 
 
 _jit_cache: dict = {}
@@ -450,6 +458,8 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
             [(o.shape, o.dtype) for o in outs],
             multi,
             op_name or getattr(fn, "__name__", "op"),
+            fn=f,
+            arg_arrays=arrays,
         )
         result = []
         for i, o in enumerate(outs):
@@ -560,6 +570,96 @@ def _accum_leaf(t: Tensor, ct):
         hook(t)
 
 
+# -- double grad (create_graph=True) ---------------------------------------
+#
+# The normal walk calls each node's saved vjp closure on raw arrays — fast,
+# but the closure hides how the grad depends on the PRIMAL inputs (its
+# residuals are baked in), so the result is a dead end for a second
+# differentiation. The create_graph walk instead re-derives each node's vjp
+# THROUGH apply_op with both the cotangents and the node's live primal
+# inputs as explicit tensor arguments: the grad computation lands on the
+# tape as ordinary ops, and paddle.grad composes to any order — the eager
+# analog of the reference's partial_grad_engine double-grad
+# (/root/reference/paddle/fluid/imperative/partial_grad_engine.cc:1).
+
+def _node_vjp_recorded(node: GradNode, full_cts):
+    """Run node's vjp through apply_op. full_cts: Tensor per output.
+    Returns (live_positions, cotangent Tensors for those positions)."""
+    if node.fn is None:
+        raise RuntimeError(
+            f"create_graph=True: op '{node.name}' was recorded without its "
+            "primal function (old-format tape); re-run the forward")
+    live = [i for i, t in enumerate(node.inputs)
+            if t is not None and jnp.issubdtype(
+                jnp.asarray(t._data).dtype, jnp.inexact)]
+    n_out = len(node.out_avals)
+    arg_arrays = node.arg_arrays
+    fn = node.fn
+    multi = node.multi_out
+
+    def gradop(*ins):
+        cts, primals = ins[:n_out], ins[n_out:]
+        args = list(arg_arrays)
+        for j, i in enumerate(live):
+            args[i] = primals[j]
+        _, vjp = jax.vjp(fn, *args)
+        in_cts = vjp(tuple(cts) if multi else cts[0])
+        return tuple(in_cts[i] for i in live)
+
+    out = apply_op(gradop, *full_cts,
+                   *[node.inputs[i] for i in live],
+                   op_name=f"{node.name}_grad")
+    return live, (out if isinstance(out, tuple) else (out,))
+
+
+def _backward_create_graph(tensor: Tensor, grad_tensor=None):
+    """Tape-recording backward: like :func:`backward` but cotangents are
+    Tensors and every vjp is an apply_op — leaf ``.grad``s come back
+    graph-connected for higher-order differentiation."""
+    if tensor._grad_node is None:
+        if not tensor.stop_gradient:
+            g = (grad_tensor if isinstance(grad_tensor, Tensor)
+                 else Tensor(_unwrap(grad_tensor))
+                 if grad_tensor is not None
+                 else Tensor(jnp.ones_like(tensor._data)))
+            t0 = tensor
+            t0.grad = g if t0.grad is None else t0.grad + g
+        return
+    if grad_tensor is None:
+        seed = Tensor(jnp.ones_like(tensor._data))
+    elif isinstance(grad_tensor, Tensor):
+        seed = grad_tensor
+    else:
+        seed = Tensor(jnp.asarray(_unwrap(grad_tensor),
+                                  dtype=tensor._data.dtype))
+
+    node_cts: dict = {}
+    root = tensor._grad_node
+    node_cts[id(root)] = [None] * len(root.out_avals)
+    node_cts[id(root)][tensor._out_index] = seed
+
+    order = _topo_order(root)
+    for node in reversed(order):
+        cts = node_cts.get(id(node))
+        if cts is None:
+            continue
+        full = [c if c is not None else Tensor(jnp.zeros(sh, dt))
+                for c, (sh, dt) in zip(cts, node.out_avals)]
+        live, in_cts = _node_vjp_recorded(node, full)
+        for i, ct in zip(live, in_cts):
+            t = node.inputs[i]
+            if t._grad_node is not None:
+                slot = node_cts.setdefault(
+                    id(t._grad_node), [None] * len(t._grad_node.out_avals))
+                j = t._out_index
+                slot[j] = ct if slot[j] is None else slot[j] + ct
+            elif not t.stop_gradient:
+                t.grad = ct if t.grad is None else t.grad + ct
+                for hook in _leaf_hooks.get(id(t), ()):
+                    hook(t)
+        node_cts.pop(id(node), None)
+
+
 def inplace_apply(x: "Tensor", fn, *args, **kwargs) -> "Tensor":
     """Inplace-API helper for the reference's trailing-underscore ops
     (tanh_/reshape_/scatter_ ...). XLA arrays are immutable, so "inplace"
@@ -600,16 +700,17 @@ def grad(
     create_graph=False,
     only_inputs=True,
     allow_unused=False,
+    no_grad_vars=None,
 ):
     """paddle.grad parity (partial_grad_engine analog).
 
     Computes grads of outputs wrt inputs without writing ``.grad``.
+    ``create_graph=True`` records the grad computation itself on the tape
+    (see :func:`_backward_create_graph`) so the returned grads are
+    differentiable — reference double-grad
+    (imperative/partial_grad_engine.cc, dygraph/base.py grad()).
+    ``no_grad_vars``: tensors treated as constants during this walk.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported in eager mode; "
-            "use the functional API (paddle_tpu.jit) with jax.grad composition."
-        )
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -617,7 +718,25 @@ def grad(
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
     if retain_graph is None:
-        retain_graph = False
+        # reference semantics: retain defaults to create_graph (the graph
+        # must survive for the second-order backward to walk it)
+        retain_graph = bool(create_graph)
+
+    # no_grad_vars become temporary constant leaves: their node link is
+    # unhooked so the walk neither descends past them nor accumulates.
+    # Dedup by identity — a duplicated entry would snapshot the already-
+    # frozen (None) state and the restore would leave the tensor severed.
+    frozen = []
+    if no_grad_vars:
+        seen_ng = set()
+        for t in (no_grad_vars if isinstance(no_grad_vars, (list, tuple))
+                  else [no_grad_vars]):
+            if id(t) in seen_ng:
+                continue
+            seen_ng.add(id(t))
+            frozen.append((t, t._grad_node, t._out_index, t.stop_gradient))
+            t._grad_node = None
+            t.stop_gradient = True
 
     # Save/restore .grad of leaves so paddle.grad stays side-effect free.
     saved = {}
@@ -644,7 +763,10 @@ def grad(
 
     try:
         for o, go in zip(outs, grad_outputs):
-            backward(o, go, retain_graph=True if retain_graph else True)
+            if create_graph:
+                _backward_create_graph(o, go)
+            else:
+                backward(o, go, retain_graph=True if retain_graph else True)
         results = []
         for t in ins:
             g = t.grad
@@ -662,4 +784,8 @@ def grad(
                         node.vjp_fn = None
         for t, old in saved.values():
             t.grad = old
+        for t, node, idx, sg in frozen:
+            t._grad_node = node
+            t._out_index = idx
+            t.stop_gradient = sg
     return results

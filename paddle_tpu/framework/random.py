@@ -30,11 +30,16 @@ class _GlobalRNG:
         self._lock = threading.Lock()
         self._seed = int(seed)
         self._key = None
+        # bumped on every seed() call — consumers caching derived
+        # generators (e.g. the detection samplers) key on (seed, epoch)
+        # so reseeding with the SAME value still restarts their streams
+        self.seed_epoch = 0
 
     def seed(self, s: int):
         with self._lock:
             self._seed = int(s)
             self._key = jax.random.key(int(s))
+            self.seed_epoch += 1
 
     def _ensure(self):
         if self._key is None:

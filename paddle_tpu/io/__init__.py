@@ -353,12 +353,19 @@ def default_collate_fn(batch):
 
 
 class DataLoader:
-    """Single-process loader with optional background prefetch thread.
+    """Loader with real multiprocess workers (reference
+    fluid/dataloader/dataloader_iter.py + worker.py) behind ``num_workers``.
 
-    The reference's multiprocess worker pool (fluid/dataloader/dataloader_iter.py)
-    exists to escape the GIL for Python-side decode; here host-side work is
-    numpy-light so a prefetch thread + async device transfer covers it. A
-    num_workers>0 request uses the thread prefetcher.
+    num_workers>0 forks/spawns a worker pool: children index the dataset
+    and collate IN NUMPY (never touching XLA), pickle batches over mp
+    queues, and a reader thread pushes them through the NATIVE blocking
+    queue (core/csrc/ptpu_core.cc, the LoDTensorBlockingQueue analog) for
+    bounded prefetch — so a PIL/augmentation-heavy pipeline escapes the
+    GIL and scales with workers (tests/test_native_core.py pins >=2x at 4
+    workers). Falls back to a prefetch THREAD when multiprocessing can't
+    preserve semantics: custom collate_fn (sees in-process Tensors),
+    IterableDataset sharding, device arrays reachable from the dataset
+    (fork-after-XLA hazard), or an unpicklable dataset under spawn.
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,

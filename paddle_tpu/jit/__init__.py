@@ -266,6 +266,9 @@ class TrainStep:
                 new_slots[k] = list(out[1:])
             return new_params, new_slots, new_buffers, loss
 
+        # pure step exposed for K-steps-in-one-jit timing (bench.py) and
+        # custom outer loops; _compiled is the per-call dispatch path
+        self._step_impl = step_impl
         self._compiled = jax.jit(step_impl, donate_argnums=(0, 1))
 
     def __call__(self, *batch):

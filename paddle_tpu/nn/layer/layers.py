@@ -27,6 +27,19 @@ class HookRemoveHelper:
         self._hooks.pop(self._idx, None)
 
 
+# Global unique-name generator (reference python/paddle/fluid/
+# unique_name.py): every Layer instance gets "<scope>_<k>" and its
+# parameters "<scope>_<k>.w_<i>" / ".b_<i>" — the names user-facing
+# apply_decay_param_fun / exclude_from_weight_decay callbacks match on.
+_NAME_COUNTS: dict = {}
+
+
+def _unique_full_name(scope: str) -> str:
+    i = _NAME_COUNTS.get(scope, 0)
+    _NAME_COUNTS[scope] = i + 1
+    return f"{scope}_{i}"
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
@@ -39,6 +52,11 @@ class Layer:
         self._forward_post_hooks = collections.OrderedDict()
         self._hook_id = 0
         self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._full_name = _unique_full_name(self._name_scope)
+        self._param_name_counts = {"w": 0, "b": 0}
+
+    def full_name(self) -> str:
+        return self._full_name
 
     # -- parameter/buffer creation -----------------------------------------
     def create_parameter(
@@ -72,7 +90,13 @@ class Layer:
         shape = tuple(int(s) for s in shape)
         data = init(shape, dtype)
         trainable = attr.trainable if attr is not None else True
-        p = Parameter(data, name=attr.name if attr is not None else None, trainable=trainable)
+        name = attr.name if attr is not None else None
+        if name is None:
+            kind = "b" if is_bias else "w"
+            idx = self._param_name_counts.get(kind, 0)
+            self._param_name_counts[kind] = idx + 1
+            name = f"{self._full_name}.{kind}_{idx}"
+        p = Parameter(data, name=name, trainable=trainable)
         if attr is not None:
             p.optimize_attr["learning_rate"] = attr.learning_rate
             p.regularizer = attr.regularizer

@@ -313,16 +313,6 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, block_b, interpret,
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _supported(q, k, block_q, block_k):
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    if d % 128 != 0 and d not in (64,):
-        return False
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
-    return sq % bq == 0 and sk % bk == 0 and sq >= 128 and sk >= 128
-
-
 def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=None,
                            block_k=None, block_b=None, interpret=None):
     """Array-level entry (used inside jit traces / functional code).
@@ -330,6 +320,12 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=None,
     Differentiable end to end in Pallas: KV-blocked online-softmax forward,
     delta-trick fused backward. block_q/block_k default to the measured
     v5e auto policy (_auto_block); pass explicitly to override.
+
+    head_dim handling: the MXU wants the minor dim in {64, k·128}. Other
+    widths (e.g. 96 = 1536/16 in GPT-760M shapes) are zero-padded to the
+    next multiple of 128 — zero columns change neither the q·k scores nor
+    add output mass, the padded output columns are sliced off, and their
+    cotangents are zero, so gradients match the unpadded math exactly.
     """
     d = q.shape[-1]
     if scale is None:
@@ -342,8 +338,18 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=None,
         interpret = False
         if not _on_tpu():
             return _attention_reference(q, k, v, causal, scale)
-    if not _supported(q, k, block_q, block_k):
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if not (sq % bq == 0 and sk % bk == 0 and sq >= 128 and sk >= 128):
         return _attention_reference(q, k, v, causal, scale)
+    if d % 128 != 0 and d != 64:
+        dp = -(-d // 128) * 128
+        pad = ((0, 0), (0, 0), (0, 0), (0, dp - d))
+        out = flash_attention_arrays(
+            jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad), causal=causal,
+            scale=scale, block_q=block_q, block_k=block_k, block_b=block_b,
+            interpret=interpret)
+        return out[..., :d]
     return _flash(q, k, v, bool(causal), float(scale), int(block_q),
                   int(block_k), block_b and int(block_b), bool(interpret))
 

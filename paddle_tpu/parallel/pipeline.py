@@ -106,7 +106,10 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
     T = n_micro + n_stages - 1
     act_shape = (n_stages,) + x_micro.shape[1:]
 
-    vstage = jax.vmap(stage_fn)
+    # axis_name lets a stage_fn recover ITS stage index with
+    # lax.axis_index("pipe_stage") — the padded non-uniform engine path
+    # uses it to mask dead (padding) units per stage
+    vstage = jax.vmap(stage_fn, axis_name="pipe_stage")
 
     # Microbatches ride the scan's xs, zero-padded to T for the drain
     # ticks. Concatenate is used (not a clamped gather): its transpose is

@@ -41,7 +41,12 @@ _NEG_INF = -1e30
 
 def _block_attn(q, k, v, mask, scale):
     """One (S_q × S_k) block: scores + masked logits, returns
-    (unnormalised out, rowmax, rowsum) for the online-softmax merge."""
+    (unnormalised out, rowmax, rowsum) for the online-softmax merge.
+
+    Matmuls run at the INPUT dtype's MXU rate (bf16 in training) with f32
+    accumulation (preferred_element_type); softmax statistics and the
+    running accumulator stay f32 — same numerics contract as the Pallas
+    flash kernel."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     s = jnp.where(mask, s, _NEG_INF)
@@ -50,7 +55,8 @@ def _block_attn(q, k, v, mask, scale):
     m_safe = jnp.maximum(m, _NEG_INF / 2)
     p = jnp.exp(s - m_safe)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o, m_safe, l
 
 
@@ -64,7 +70,6 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
-    qf = q.astype(jnp.float32)
     q_pos = idx * s_loc + jnp.arange(s_loc)          # global q positions
 
     def tick(carry, step):
@@ -76,9 +81,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = jnp.ones((s_loc, s_loc), bool)
-        ob, mb, lb = _block_attn(qf, kc.astype(jnp.float32),
-                                 vc.astype(jnp.float32),
-                                 mask[None, None], scale)
+        ob, mb, lb = _block_attn(q, kc, vc, mask[None, None], scale)
         # online-softmax merge of (o,m,l) with the new block
         m_new = jnp.maximum(m, mb)
         alpha = jnp.exp(m - m_new)
@@ -97,7 +100,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     # (shard_map vma typing; older jax has neither typeof().vma nor pcast
     # and needs no cast at all)
     try:
-        vma = (set(jax.typeof(qf).vma) | set(jax.typeof(k).vma)
+        vma = (set(jax.typeof(q).vma) | set(jax.typeof(k).vma)
                | set(jax.typeof(v).vma))
         pcast = jax.lax.pcast
         pv = lambda x: pcast(x, tuple(vma), to="varying")

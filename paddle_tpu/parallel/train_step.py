@@ -26,51 +26,147 @@ from .sharding import zero_shard_specs
 
 __all__ = ["DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
            "pure_sgd_init", "pure_sgd_update", "pure_momentum_init",
-           "pure_momentum_update", "global_norm_clip"]
+           "pure_momentum_update", "pure_lamb_init", "pure_lamb_update",
+           "pure_lars_init", "pure_lars_update", "global_norm_clip"]
 
 
 # -- pure optimizers (tree-level) ------------------------------------------
 
-def pure_adamw_init(params):
-    # m/v live in fp32 regardless of the param dtype (the update math is
-    # fp32; allocating them as e.g. bf16 would silently change type at the
-    # first update and break scan carries)
-    zeros32 = lambda t: jax.tree_util.tree_map(
-        lambda x: jnp.zeros(jnp.shape(x), jnp.float32), t)
-    return {"m": zeros32(params), "v": zeros32(params),
+def pure_adamw_init(params, mv_dtype=jnp.float32):
+    # m/v default to fp32 regardless of the param dtype (the update math is
+    # always fp32). mv_dtype=bf16 halves optimizer-state HBM footprint AND
+    # per-step optimizer traffic — bf16 keeps fp32's exponent range, so
+    # m/v never over/underflow, only lose mantissa; at LLM scale the freed
+    # memory buys a larger batch, which dominates the precision cost (the
+    # update still computes in fp32 and stores back rounded). Pass the same
+    # mv_dtype to pure_adamw_update so the scan carry dtype is stable.
+    zeros = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), mv_dtype), t)
+    return {"m": zeros(params), "v": zeros(params),
             "count": jnp.zeros((), jnp.int32)}
 
 
 def pure_adamw_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
-                      eps=1e-8, weight_decay=0.01, l2_coeff=0.0):
+                      eps=1e-8, weight_decay=0.01, l2_coeff=0.0,
+                      mv_dtype=None, decay_mask=None):
     """weight_decay is AdamW's decoupled decay; l2_coeff is classic Adam's
     grad-side L2 (added before the moments, reference Optimizer
-    _regularized_grad path)."""
+    _regularized_grad path). mv_dtype: storage dtype for the moments (None
+    = keep whatever pure_adamw_init allocated); math is fp32 either way.
+    decay_mask: optional pytree of bools matching params — False leaves
+    skip the decoupled decay (reference AdamW apply_decay_param_fun,
+    python/paddle/optimizer/adamw.py _append_decoupled_weight_decay)."""
     count = state["count"] + 1
     c = count.astype(jnp.float32)
     bc1 = 1.0 - beta1 ** c
     bc2 = 1.0 - beta2 ** c
 
-    def upd(p, g, m, v):
+    def upd(p, g, m, v, wd):
         g32 = g.astype(jnp.float32)
+        store = m.dtype if mv_dtype is None else mv_dtype
+        m, v = m.astype(jnp.float32), v.astype(jnp.float32)
         if l2_coeff:
             g32 = g32 + l2_coeff * p.astype(jnp.float32)
         m = beta1 * m + (1 - beta1) * g32
         v = beta2 * v + (1 - beta2) * (g32 * g32)
         step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        # decay BEFORE the adam step, matching the reference op order
+        # (adamw.py _append_decoupled_weight_decay scales the param first)
+        p32 = p.astype(jnp.float32) * (1.0 - lr * wd)
+        p32 = p32 - lr * step
+        return p32.astype(p.dtype), m.astype(store), v.astype(store)
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(state["m"])
+    flat_v = treedef.flatten_up_to(state["v"])
+    flat_wd = ([weight_decay] * len(flat_p) if decay_mask is None else
+               [weight_decay if dm else 0.0
+                for dm in treedef.flatten_up_to(decay_mask)])
+    out = [upd(p, g, m, v, wd) for p, g, m, v, wd
+           in zip(flat_p, flat_g, flat_m, flat_v, flat_wd)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    new_v = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+    return new_p, {"m": new_m, "v": new_v, "count": count}
+
+
+def pure_lamb_init(params):
+    return pure_adamw_init(params)
+
+
+def pure_lamb_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
+                     eps=1e-6, weight_decay=0.01, decay_mask=None, **_):
+    """LAMB (reference operators/optimizers/lamb_op.h
+    LambMomentREGUpdateFunctor + LambParamUpateFunctor): Adam moments →
+    trust_ratio_div r = m̂/(√v̂+ε) + λp, then a PER-PARAMETER trust ratio
+    ‖p‖/‖r‖ (1 when either norm is 0) rescales lr. decay_mask=False
+    leaves λ=0 for that leaf (exclude_from_weight_decay_fn)."""
+    count = state["count"] + 1
+    c = count.astype(jnp.float32)
+    bc1 = 1.0 - beta1 ** c
+    bc2 = 1.0 - beta2 ** c
+
+    def upd(p, g, m, v, wd):
+        g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
-        p32 = p32 - lr * (step + weight_decay * p32)
+        m = beta1 * m + (1 - beta1) * g32
+        v = beta2 * v + (1 - beta2) * (g32 * g32)
+        r = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p32
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        p32 = p32 - lr * trust * r
         return p32.astype(p.dtype), m, v
 
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)
     flat_m = treedef.flatten_up_to(state["m"])
     flat_v = treedef.flatten_up_to(state["v"])
-    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    flat_wd = ([weight_decay] * len(flat_p) if decay_mask is None else
+               [weight_decay if dm else 0.0
+                for dm in treedef.flatten_up_to(decay_mask)])
+    out = [upd(p, g, m, v, wd) for p, g, m, v, wd
+           in zip(flat_p, flat_g, flat_m, flat_v, flat_wd)]
     new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
     new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
     new_v = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
     return new_p, {"m": new_m, "v": new_v, "count": count}
+
+
+def pure_lars_init(params):
+    return pure_momentum_init(params)
+
+
+def pure_lars_update(params, grads, state, lr, momentum=0.9,
+                     lars_coeff=0.001, lars_weight_decay=0.0005,
+                     epsilon=0.0, **_):
+    """LARS momentum (reference operators/optimizers/lars_momentum_op.h):
+    per-parameter local_lr = lr·coeff·‖p‖ / (‖g‖ + λ‖p‖ + ε) when
+    λ>0 and both norms >0, else the global lr; velocity over the
+    L2-regularized gradient."""
+
+    def upd(p, g, v):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        local_lr = jnp.where(
+            (lars_weight_decay > 0) & (p_norm > 0) & (g_norm > 0),
+            lr * lars_coeff * p_norm
+            / (g_norm + lars_weight_decay * p_norm + epsilon),
+            lr)
+        nv = momentum * v + local_lr * (g32 + lars_weight_decay * p32)
+        p32 = p32 - nv
+        return p32.astype(p.dtype), nv
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_v = treedef.flatten_up_to(state["velocity"])
+    out = [upd(p, g, v) for p, g, v in zip(flat_p, flat_g, flat_v)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_v = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return new_p, {"velocity": new_v, "count": state["count"] + 1}
 
 
 def pure_sgd_init(params):
@@ -136,6 +232,8 @@ _OPTS = {
     "adamw": (pure_adamw_init, pure_adamw_update),
     "sgd": (pure_sgd_init, pure_sgd_update),
     "momentum": (pure_momentum_init, pure_momentum_update),
+    "lamb": (pure_lamb_init, pure_lamb_update),
+    "lars": (pure_lars_init, pure_lars_update),
 }
 
 

@@ -898,8 +898,10 @@ def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
 
 
 def save(program, model_path, protocol=4, **configs):
-    """Persist a program's parameters + buffers (reference static/io.py
-    save: <path>.pdparams + .pdopt)."""
+    """Persist a program's parameters + buffers to <path>.pdparams AND the
+    optimizer state of any minimize()'d optimizers to <path>.pdopt
+    (reference static/io.py save writes the same pair; the .pdopt file is
+    an empty dict when the program has no train_specs)."""
     from ..framework.io import save as _save
 
     params = program.all_parameters()
@@ -911,11 +913,24 @@ def save(program, model_path, protocol=4, **configs):
             "unique name= arguments" % sorted(dup))
     state = {n: np.asarray(t._data) for n, t in zip(names, params)}
     _save(state, model_path + ".pdparams")
+    def _np(v):
+        return np.asarray(v._data) if isinstance(v, Tensor) else v
+
+    opt_state = {}
+    for i, (optimizer, _loss) in enumerate(getattr(program, "train_specs",
+                                                   [])):
+        sd = optimizer.state_dict()
+        opt_state.update({f"opt{i}.{k}" if len(program.train_specs) > 1
+                          else k: _np(v) for k, v in sd.items()})
+    _save(opt_state, model_path + ".pdopt")
 
 
 def load(program, model_path, executor=None, var_list=None):
     """Restore parameters saved by static.save into the program's
-    captured tensors, matched by name."""
+    captured tensors, matched by name; optimizer state is restored from
+    the .pdopt companion when present."""
+    import os
+
     from ..framework.io import load as _load
 
     state = _load(model_path + ".pdparams")
@@ -927,6 +942,15 @@ def load(program, model_path, executor=None, var_list=None):
             continue
         if name in by_name:
             by_name[name].set_value(np.asarray(arr))
+    if var_list is None and os.path.exists(model_path + ".pdopt"):
+        opt_state = _load(model_path + ".pdopt")
+        specs = getattr(program, "train_specs", [])
+        for i, (optimizer, _loss) in enumerate(specs):
+            prefix = f"opt{i}." if len(specs) > 1 else ""
+            sd = {k[len(prefix):]: v for k, v in opt_state.items()
+                  if k.startswith(prefix)} if prefix else dict(opt_state)
+            if sd:
+                optimizer.set_state_dict(sd)
 
 
 def load_program_state(model_path, var_list=None):

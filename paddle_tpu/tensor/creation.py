@@ -139,8 +139,13 @@ def diagflat(x, offset=0, name=None):
 def meshgrid(*args, **kwargs):
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = args[0]
-    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+    from ..framework.core import apply_op
+
+    # through apply_op so the broadcasts stay on the tape (reference
+    # meshgrid_op has a grad kernel; wrapping raw outputs severed it)
+    out = apply_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                   *args, op_name="meshgrid")
+    return list(out) if isinstance(out, tuple) else [out]
 
 
 def _identity(x):

@@ -1378,8 +1378,29 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noq
 # -- RPN / RCNN training target assignment ----------------------------------
 
 # advancing sampler shared by the assign ops: the reference draws a NEW
-# random subset each training step; a per-call fixed seed would freeze it
-_DET_RNG = np.random.default_rng(17)
+# random subset each training step; a per-call fixed seed would freeze it.
+# Seeded from the framework global seed and folded with the distributed
+# rank so data-parallel workers and reseeded runs decorrelate while
+# staying reproducible under paddle.seed (ADVICE r4).
+_DET_RNG_STATE = {"key": None, "rng": None}
+
+
+def _det_rng():
+    from ..framework.random import _global_rng
+    try:
+        from ..distributed.env import get_rank
+        rank = get_rank()
+    except Exception:  # noqa: BLE001 — env without launch wiring
+        rank = 0
+    # seed_epoch distinguishes two paddle.seed(k) calls with the SAME k:
+    # each reseed must restart the sampling stream (reproducibility means
+    # seed(7)-run-A == seed(7)-run-B, not run-B continuing run-A's draws)
+    key = (_global_rng._seed, getattr(_global_rng, "seed_epoch", 0), rank)
+    if _DET_RNG_STATE["key"] != key:
+        _DET_RNG_STATE["key"] = key
+        _DET_RNG_STATE["rng"] = np.random.default_rng(
+            np.random.SeedSequence(spawn_key=(rank,), entropy=key[0]))
+    return _DET_RNG_STATE["rng"]
 
 
 def _np_iou_off(a, b, off):
@@ -1444,7 +1465,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
              if is_crowd is not None else np.zeros(gtb.shape[:2], np.int64))
     info = np.asarray(_arr(im_info), np.float32)
     N = bp.shape[0]
-    rng = _DET_RNG
+    rng = _det_rng()
 
     sp, lp, st, lt, iw = [], [], [], [], []
     for n in range(N):
@@ -1459,16 +1480,23 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         idx_in = np.where(inside)[0]
         valid = ((gtb[n, :, 2] - gtb[n, :, 0]) > 0) & (crowd[n] == 0)
         g = gtb[n][valid]
-        if len(g) == 0 or len(idx_in) == 0:
+        if len(idx_in) == 0:
             continue
-        iou = _np_iou(anchors[idx_in], g)              # [A, G]
-        max_iou = iou.max(axis=1)
-        argmax_g = iou.argmax(axis=1)
-        labels = -np.ones(len(idx_in), np.int64)
-        labels[max_iou < rpn_negative_overlap] = 0
-        # force-match: each gt's best anchor is positive
-        labels[iou.argmax(axis=0)] = 1
-        labels[max_iou >= rpn_positive_overlap] = 1
+        if len(g):
+            iou = _np_iou(anchors[idx_in], g)          # [A, G]
+            max_iou = iou.max(axis=1)
+            argmax_g = iou.argmax(axis=1)
+            labels = -np.ones(len(idx_in), np.int64)
+            labels[max_iou < rpn_negative_overlap] = 0
+            # force-match: each gt's best anchor is positive
+            labels[iou.argmax(axis=0)] = 1
+            labels[max_iou >= rpn_positive_overlap] = 1
+        else:
+            # negative-only image: all inside anchors are background and
+            # still contribute sampled negatives (the reference assigns
+            # background everywhere rather than skipping the image)
+            labels = np.zeros(len(idx_in), np.int64)
+            argmax_g = np.zeros(len(idx_in), np.int64)
 
         fg_idx = np.where(labels == 1)[0]
         bg_idx = np.where(labels == 0)[0]
@@ -1535,7 +1563,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
              if is_crowd is not None else np.zeros(gtb.shape[:2], np.int64))
     C = int(class_nums) if class_nums else int(gtc.max()) + 1
     wts = np.asarray(bbox_reg_weights, np.float32)
-    rng = _DET_RNG
+    rng = _det_rng()
 
     info = np.asarray(_arr(im_info), np.float32)
     out_rois, out_lab, out_tgt, out_in, out_num = [], [], [], [], []
@@ -1635,15 +1663,19 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         valid = ((gtb[n, :, 2] - gtb[n, :, 0]) > 0) & (crowd[n] == 0)
         g = gtb[n][valid]
         gl = gtl[n][valid]
-        if len(g) == 0:
-            continue
-        iou = _np_iou(anchors, g)
-        max_iou = iou.max(axis=1)
-        argmax_g = iou.argmax(axis=1)
-        labels = -np.ones(len(anchors), np.int64)     # ignore band
-        labels[max_iou < negative_overlap] = 0
-        labels[iou.argmax(axis=0)] = 1
-        labels[max_iou >= positive_overlap] = 1
+        if len(g):
+            iou = _np_iou(anchors, g)
+            max_iou = iou.max(axis=1)
+            argmax_g = iou.argmax(axis=1)
+            labels = -np.ones(len(anchors), np.int64)  # ignore band
+            labels[max_iou < negative_overlap] = 0
+            labels[iou.argmax(axis=0)] = 1
+            labels[max_iou >= positive_overlap] = 1
+        else:
+            # negative-only image: every anchor is a background sample
+            # (reference behavior — the image is not skipped)
+            labels = np.zeros(len(anchors), np.int64)
+            argmax_g = np.zeros(len(anchors), np.int64)
         keep = labels >= 0                            # all non-ignored
         fg = labels == 1
         fg_total += int(fg.sum())
@@ -1676,72 +1708,94 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             Tensor(jnp.asarray(np.asarray([[max(fg_total, 1)]], np.int32))))
 
 
+def _nms_fast_off(dets, nms_threshold, eta):
+    """Greedy NMS over [K, 5] (box4 + score) rows with the reference's
+    non-normalized (+1 pixel) IoU and adaptive eta threshold
+    (retinanet_detection_output_op.cc NMSFast). Returns kept row indices
+    in selection order. The full pairwise IoU matrix is precomputed once
+    (like multiclass_nms's area_iou); only the greedy keep-loop is
+    sequential."""
+    order = np.argsort(-dets[:, 4], kind="stable")
+    iou = _np_iou_off(dets[:, :4], dets[:, :4], 1.0)
+    kept: list = []
+    adaptive = nms_threshold
+    for i in order:
+        i = int(i)
+        if kept and (iou[i, kept] > adaptive).any():
+            continue
+        kept.append(i)
+        if eta < 1 and adaptive > 0.5:
+            adaptive *= eta
+    return kept
+
+
 def retinanet_detection_output(bboxes, scores, anchors, im_info,
                                score_threshold=0.05, nms_top_k=1000,
                                keep_top_k=100, nms_threshold=0.3,
                                nms_eta=1.0):
-    """RetinaNet inference (reference detection/retinanet_detection_output):
-    per FPN level decode bbox deltas against that level's anchors, keep
-    the nms_top_k best above score_threshold, then class-wise NMS merged
-    across levels. Lists are per level; batch N=1 per the reference's
-    per-image kernel looping."""
+    """RetinaNet inference (reference detection/retinanet_detection_output_op.cc):
+    per FPN level, the nms_top_k best per-(anchor, class) scores above
+    score_threshold (threshold 0.0 for the HIGHEST level, :409) are decoded
+    against that level's anchors with the +1 pixel convention
+    (DeltaScoreToPrediction :267), then class-wise NMS with non-normalized
+    IoU merges across levels and keep_top_k caps per image; output labels
+    are class+1 (MultiClassOutput :430). Lists are per level."""
     from ..framework.core import Tensor
 
     info = np.asarray(_arr(im_info), np.float32)
     N = info.shape[0]
+    L = len(list(scores))
     all_det = []
     for n in range(N):
-        boxes_l, scores_l = [], []
-        for bb, sc, an in zip(bboxes, scores, anchors):
+        preds = {}                      # class -> [xmin,ymin,xmax,ymax,score]
+        for lvl, (bb, sc, an) in enumerate(zip(bboxes, scores, anchors)):
             b = np.asarray(_arr(bb), np.float32)[n]        # [M, 4] deltas
             s = np.asarray(_arr(sc), np.float32)[n]        # [M, C] sigmoid
             a = np.asarray(_arr(an), np.float32).reshape(-1, 4)
-            best = s.max(axis=1)
-            ok = best > score_threshold
-            order = np.argsort(-best[ok])
-            if nms_top_k > 0:
+            C = s.shape[1]
+            # flattened per-(anchor, class) selection; the highest FPN
+            # level uses threshold 0.0 (reference :409)
+            thresh = score_threshold if lvl < L - 1 else 0.0
+            flat = s.reshape(-1)
+            ok = np.where(flat > thresh)[0]
+            order = ok[np.argsort(-flat[ok], kind="stable")]
+            if nms_top_k > -1:
                 order = order[:nms_top_k]
-            idx = np.where(ok)[0][order]
-            if len(idx) == 0:
+            if len(order) == 0:
                 continue
-            # decode against anchors (variance-free, like the reference's
-            # retinanet decode: deltas are already variance-scaled)
-            aw = a[idx, 2] - a[idx, 0]
-            ah = a[idx, 3] - a[idx, 1]
-            acx = (a[idx, 0] + a[idx, 2]) / 2
-            acy = (a[idx, 1] + a[idx, 3]) / 2
-            d = b[idx]
+            aidx = order // C
+            cidx = order % C
+            # decode with the +1 pixel convention (variance-free deltas)
+            aw = a[aidx, 2] - a[aidx, 0] + 1
+            ah = a[aidx, 3] - a[aidx, 1] + 1
+            acx = a[aidx, 0] + aw / 2
+            acy = a[aidx, 1] + ah / 2
+            d = b[aidx]
             cx = d[:, 0] * aw + acx
             cy = d[:, 1] * ah + acy
-            w = np.exp(np.minimum(d[:, 2], _BBOX_CLIP)) * aw
-            h = np.exp(np.minimum(d[:, 3], _BBOX_CLIP)) * ah
-            # back to the ORIGINAL image frame: divide by im_scale and
-            # clip to the original extent (reference op semantics)
+            w = np.exp(d[:, 2]) * aw
+            h = np.exp(d[:, 3]) * ah
             scale = float(info[n, 2]) if info.shape[1] > 2 else 1.0
-            im_h = info[n, 0] / scale
-            im_w = info[n, 1] / scale
-            dec = np.stack([np.clip((cx - w / 2) / scale, 0, im_w - 1),
-                            np.clip((cy - h / 2) / scale, 0, im_h - 1),
-                            np.clip((cx + w / 2) / scale, 0, im_w - 1),
-                            np.clip((cy + h / 2) / scale, 0, im_h - 1)],
-                           axis=1)
-            boxes_l.append(dec)
-            scores_l.append(s[idx])
-        if not boxes_l:
-            all_det.append(np.zeros((0, 6), np.float32))
-            continue
-        bx = np.concatenate(boxes_l)
-        scn = np.concatenate(scores_l)
-        # class-wise suppression delegates to multiclass_nms (same
-        # adaptive nms_eta semantics, no duplicated loop);
-        # background_label=-1: every retinanet class is a real class
-        det_t, _n = multiclass_nms(
-            Tensor(jnp.asarray(bx[None])),
-            Tensor(jnp.asarray(scn.T[None])),
-            score_threshold=score_threshold, nms_top_k=-1,
-            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
-            nms_eta=nms_eta, background_label=-1)
-        all_det.append(np.asarray(_arr(det_t), np.float32).reshape(-1, 6))
+            im_h = np.round(info[n, 0] / scale)
+            im_w = np.round(info[n, 1] / scale)
+            x1 = np.clip((cx - w / 2) / scale, 0, im_w - 1)
+            y1 = np.clip((cy - h / 2) / scale, 0, im_h - 1)
+            x2 = np.clip((cx + w / 2 - 1) / scale, 0, im_w - 1)
+            y2 = np.clip((cy + h / 2 - 1) / scale, 0, im_h - 1)
+            rows = np.stack([x1, y1, x2, y2, flat[order]], axis=1)
+            for c in np.unique(cidx):
+                preds.setdefault(int(c), []).append(rows[cidx == c])
+        dets = []                       # (score, label, box4)
+        for c, chunks in preds.items():
+            cls = np.concatenate(chunks)
+            for i in _nms_fast_off(cls, nms_threshold, nms_eta):
+                dets.append((cls[i, 4], c, cls[i, :4]))
+        dets.sort(key=lambda t: -t[0])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        out = np.asarray(
+            [[c + 1, sc_, *box] for sc_, c, box in dets], np.float32)
+        all_det.append(out.reshape(-1, 6))
     out = np.concatenate(all_det) if all_det else np.zeros((0, 6), np.float32)
     nums = np.asarray([len(d) for d in all_det], np.int32)
     return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(nums))

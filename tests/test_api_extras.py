@@ -264,6 +264,25 @@ class TestDatasetShims:
         ds.set_filelist([str(f)])
         assert list(ds) == [["A", "B"], ["C"]]
 
+    def test_dataset_thread_num_parallel_files(self, tmp_path):
+        """thread_num > 1: per-file pipe_command subprocesses run
+        concurrently (reference MultiSlotDataFeed reader channels), and
+        the stream stays in filelist order."""
+        files = []
+        for i in range(4):
+            f = tmp_path / f"part-{i}"
+            f.write_text("\n".join(f"{i}:{j}" for j in range(5)))
+            files.append(str(f))
+        ds = paddle.distributed.InMemoryDataset()
+        ds.init(batch_size=5, thread_num=4, pipe_command="tr a-z a-z")
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 20
+        batches = list(ds)
+        # filelist order preserved despite concurrent parsing
+        assert batches[0] == [f"0:{j}" for j in range(5)]
+        assert batches[3] == [f"3:{j}" for j in range(5)]
+
     def test_entries(self):
         assert paddle.distributed.ProbabilityEntry(0.5)._to_attr() \
             .startswith("probability_entry")

@@ -184,3 +184,111 @@ class TestInplaceTape:
             paddle.tanh_(x)
         with paddle.no_grad():
             paddle.tanh_(x)  # allowed under no_grad, like reference init code
+
+
+class TestDoubleGrad:
+    """create_graph=True parity with the reference's double-grad suite
+    (test_imperative_double_grad.py; engine:
+    paddle/fluid/imperative/partial_grad_engine.cc)."""
+
+    def test_simple_second_order(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (dx,) = paddle.grad(y, x, create_graph=True)
+        assert not dx.stop_gradient
+        np.testing.assert_allclose(dx.numpy(), 3 * np.array([1., 4., 9.]),
+                                   rtol=1e-6)
+        (d2,) = paddle.grad(dx, x)
+        np.testing.assert_allclose(d2.numpy(), 6 * np.array([1., 2., 3.]),
+                                   rtol=1e-6)
+
+    def test_not_create_graph_detaches(self):
+        # reference test_example_with_gradient_accumulation_and_not_create_graph:
+        # without create_graph the returned grad is constant wrt x
+        rng = np.random.default_rng(0)
+        x_np = rng.uniform(-1, 1, (5, 5)).astype(np.float32)
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        w = (paddle.nn.functional.relu(x) + 1) ** 2
+        w_mean = w.mean()
+        (dx,) = paddle.grad(w_mean, x, create_graph=False)
+        assert dx.stop_gradient
+        numel = x_np.size
+        dx_expected = (1.0 / numel * (np.maximum(x_np, 0) + 1)
+                       * (x_np > 0) * 2)
+        np.testing.assert_allclose(dx.numpy(), dx_expected, rtol=1e-5)
+        loss = (dx * dx + x * x).mean()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0 * x_np / numel,
+                                   rtol=1e-5)
+
+    def test_gradient_accumulation_and_create_graph(self):
+        # reference test_example_with_gradient_accumulation_and_create_graph
+        rng = np.random.default_rng(1)
+        x_np = rng.uniform(-1, 1, (5, 5)).astype(np.float32)
+        numel = x_np.size
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        y = paddle.nn.functional.relu(x)
+        z = y + 1
+        w = z * z
+        w_mean = w.mean()
+        (dx,) = paddle.grad(w_mean, x, create_graph=True)
+        assert not dx.stop_gradient
+        dx_expected = (1.0 / numel * (np.maximum(x_np, 0) + 1)
+                       * (x_np > 0) * 2)
+        np.testing.assert_allclose(dx.numpy(), dx_expected, rtol=1e-5)
+        loss = (dx * dx + x * x).mean()
+        loss.backward()
+        x_grad_expected = (2.0 / numel
+                           * (x_np + dx_expected * (x_np > 0) * 2 / numel))
+        np.testing.assert_allclose(x.grad.numpy(), x_grad_expected,
+                                   rtol=1e-5)
+
+    def test_no_grad_vars(self):
+        # reference test_example_with_gradient_accumulation_and_no_grad_vars
+        rng = np.random.default_rng(2)
+        x_np = rng.uniform(-1, 1, (5, 5)).astype(np.float32)
+        numel = x_np.size
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        y1 = paddle.nn.functional.relu(x)
+        y2 = paddle.nn.functional.relu(x)
+        z = y1 + y2
+        w = z * z
+        w_mean = w.mean()
+        (dx,) = paddle.grad(w_mean, x, create_graph=True, no_grad_vars=[y2])
+        assert not y2.stop_gradient          # restored after the call
+        dx_expected = (1.0 / numel * (np.maximum(x_np, 0) + y2.numpy())
+                       * (x_np > 0) * 2)
+        np.testing.assert_allclose(dx.numpy(), dx_expected, rtol=1e-5)
+        loss = (dx * dx + x * x).mean()
+        loss.backward()
+        x_grad_expected = (2.0 / numel
+                           * (x_np + dx_expected * (x_np > 0) * 4 / numel))
+        np.testing.assert_allclose(x.grad.numpy(), x_grad_expected,
+                                   rtol=1e-5)
+
+    def test_gradient_penalty_training(self):
+        """WGAN-GP pattern: the grad-penalty loss trains the weights."""
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.normal(size=(4, 3)).astype(np.float32))
+        lin = paddle.nn.Linear(3, 1)
+        out = lin(x)
+        xi = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        (gx,) = paddle.grad(lin(xi).sum(), xi, create_graph=True)
+        gp = ((gx.pow(2).sum(axis=1).sqrt() - 1.0) ** 2).mean()
+        loss = out.mean() + 10.0 * gp
+        loss.backward()
+        g = lin.weight.grad
+        assert g is not None
+        assert np.all(np.isfinite(g.numpy()))
+        # analytic: d gp / d W is nonzero unless ||W|| == 1 exactly
+        assert float(np.abs(g.numpy()).sum()) > 0
+
+    def test_triple_order(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x ** 4
+        (d1,) = paddle.grad(y, x, create_graph=True)
+        (d2,) = paddle.grad(d1, x, create_graph=True)
+        (d3,) = paddle.grad(d2, x)
+        np.testing.assert_allclose(d3.numpy(), [48.0], rtol=1e-6)

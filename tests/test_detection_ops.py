@@ -838,21 +838,49 @@ class TestRetinaNet:
         np.testing.assert_allclose(iw.numpy()[fg_rows[0]], 1.0)
 
     def test_detection_output_thresholds_and_classes(self):
+        """Reference semantics (retinanet_detection_output_op.cc): the
+        score_threshold filters every level EXCEPT the highest (which uses
+        threshold 0.0, :409 — but still a strict >, so exact-0 scores
+        drop), selection is per-(anchor, class), and the emitted label
+        column is class+1 (MultiClassOutput :430)."""
         M = 12
         anchors = np.array([[x * 8, y * 8, x * 8 + 16, y * 8 + 16]
                             for x in range(4) for y in range(3)], np.float32)
         deltas = _t(np.zeros((1, M, 4), np.float32))
         s = np.full((1, M, 2), 0.01, np.float32)
         s[0, 0, 1] = 0.9            # one confident class-1 box at anchor 0
+        # highest level: all-zero scores — dropped even at threshold 0.0
+        hi_anchors = np.array([[0., 0., 32., 32.]], np.float32)
+        hi_deltas = _t(np.zeros((1, 1, 4), np.float32))
+        hi_s = _t(np.zeros((1, 1, 2), np.float32))
         det, nums = ops.retinanet_detection_output(
-            [deltas], [_t(s)], [_t(anchors)],
+            [deltas, hi_deltas], [_t(s), hi_s], [_t(anchors), hi_anchors],
             _t(np.array([[32., 40., 1.]], np.float32)),
             score_threshold=0.5)
         assert nums.numpy().tolist() == [1]
         d = det.numpy()
         assert d.shape == (1, 6)
-        assert d[0, 0] == 1 and d[0, 1] > 0.89
-        np.testing.assert_allclose(d[0, 2:], [0, 0, 15, 15], atol=1.1)
+        assert d[0, 0] == 2 and d[0, 1] > 0.89   # label = class 1 + 1
+        np.testing.assert_allclose(d[0, 2:], [0, 0, 16, 16], atol=1.1)
+
+    def test_detection_output_last_level_threshold_zero(self):
+        """A sub-threshold box on the HIGHEST level still surfaces (the
+        reference admits the last level at threshold 0.0)."""
+        anchors = np.array([[0., 0., 16., 16.]], np.float32)
+        deltas = _t(np.zeros((1, 1, 4), np.float32))
+        low = np.zeros((1, 1, 2), np.float32)
+        low[0, 0, 0] = 0.2          # below score_threshold=0.5
+        hi_anchors = np.array([[0., 0., 32., 32.]], np.float32)
+        hi_s = np.zeros((1, 1, 2), np.float32)
+        hi_s[0, 0, 1] = 0.1         # also below — but last level
+        det, nums = ops.retinanet_detection_output(
+            [deltas, _t(np.zeros((1, 1, 4), np.float32))],
+            [_t(low), _t(hi_s)], [_t(anchors), _t(hi_anchors)],
+            _t(np.array([[64., 64., 1.]], np.float32)),
+            score_threshold=0.5)
+        assert nums.numpy().tolist() == [1]
+        d = det.numpy()
+        assert d[0, 0] == 2 and abs(d[0, 1] - 0.1) < 1e-6
 
     def test_scale_aware_frames(self):
         """im_info scale=2: rois/detections map back to the original

@@ -594,3 +594,86 @@ class TestCompiledLossScaling:
         np.testing.assert_allclose(np.asarray(net_s.weight._data),
                                    np.asarray(net_p.weight._data),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestNonUniformPipelinePadded:
+    """VERDICT r4 item 8: non-uniform (but homogeneous) stage stacks must
+    still ride the true SPMD pipeline — padded dead units per stage, not
+    the zero-overlap microbatch-scan fallback."""
+
+    def _build(self, seed):
+        paddle.seed(seed)
+        return PipelineLayer(
+            layers=[LayerDesc(paddle.nn.Linear, 8, 8) for _ in range(5)],
+            num_stages=2, loss_fn=_mse)  # segments 3+2: unequal
+
+    def test_padded_nonuniform_true_pipeline_matches_eager(self):
+        import warnings as W
+
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, dp=4, accumulate_steps=2))
+        pipe_c = self._build(31)
+        model_c = fleet.distributed_model(pipe_c)
+        opt_c = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model_c.parameters()))
+        pipe_e = self._build(31)
+        model_e = fleet.distributed_model(pipe_e)
+        opt_e = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model_e.parameters()))
+
+        with W.catch_warnings(record=True) as rec:
+            W.simplefilter("always")
+            for x, y in _data(3, batch=8):
+                lc = model_c.train_batch(
+                    (paddle.to_tensor(x), paddle.to_tensor(y)), opt_c)
+                le = model_e.train_batch(
+                    (paddle.to_tensor(x), paddle.to_tensor(y)), opt_e,
+                    use_eager=True)
+                np.testing.assert_allclose(float(lc._data), float(le._data),
+                                           rtol=1e-4, atol=1e-5)
+        fallback = [w for w in rec
+                    if "not structurally uniform" in str(w.message)]
+        assert not fallback, "padded path must not hit the scan fallback"
+
+        eng = model_c._engine
+        # params are genuinely stage-stacked over "pipe"
+        assert any(s and "pipe" in str(s)
+                   for s in eng.train_step.param_specs.values())
+        # and the schedule really crosses stages: CollectivePermute in HLO
+        hlo = eng.train_step.lower(
+            (jnp.zeros((8, 8), jnp.float32),
+             jnp.zeros((8, 8), jnp.float32))).compile().as_text()
+        assert "collective-permute" in hlo
+
+        # trained weights agree layer by layer
+        for (n1, p1), (n2, p2) in zip(pipe_c.named_parameters(),
+                                      pipe_e.named_parameters()):
+            assert n1 == n2
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_heterogeneous_types_still_fall_back(self):
+        # different unit TYPES (Linear vs ReLU) cannot be padded into one
+        # template — the documented scan fallback remains for those
+        fleet.init(is_collective=True,
+                   strategy=_strategy(pp=2, dp=4, accumulate_steps=2))
+        paddle.seed(13)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(paddle.nn.Linear, 16, 32),
+                    LayerDesc(paddle.nn.ReLU),
+                    LayerDesc(paddle.nn.Linear, 32, 8)],
+            num_stages=2, loss_fn=_mse)
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 16)).astype("float32")
+        y = rng.normal(size=(8, 8)).astype("float32")
+        with pytest.warns(UserWarning, match="not structurally uniform"):
+            loss = model.train_batch((paddle.to_tensor(x),
+                                      paddle.to_tensor(y)), opt)
+        assert np.isfinite(float(loss._data))

@@ -151,3 +151,24 @@ class TestGPTRingAttention:
             l_ring = float(jax.jit(lambda p: gpt_loss(cfg_r, p, (tok, tok)))(params))
         l_dense = float(jax.jit(lambda p: gpt_loss(cfg_d, p, (tok, tok)))(params))
         np.testing.assert_allclose(l_ring, l_dense, rtol=2e-4)
+
+
+class TestRingAttentionHLO:
+    def test_ring_emits_one_ppermute_pair_per_hop(self):
+        """VERDICT r4 item 5 (structural half): the ring really lowers to
+        CollectivePermute over the seq axis — the K and V hops live inside
+        the lax.scan body, so the unrolled count is 2 (one kernel per
+        operand), executed n_ring times by the loop."""
+        mesh = create_mesh(dp=2, sharding=4)
+        q, k, v = _qkv(b=1, h=2, s=256, d=32)
+
+        fn = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, causal=True, mesh=mesh, batch_axis=None,
+            head_axis=None))
+        hlo = fn.lower(q, k, v).compile().as_text()
+        n_cp = hlo.count("collective-permute-start")
+        if n_cp == 0:
+            n_cp = hlo.count("collective-permute(")
+        assert n_cp >= 1, "ring attention must lower to CollectivePermute"
+        # and the schedule is a loop, not an unrolled all-gather
+        assert "while" in hlo

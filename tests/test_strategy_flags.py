@@ -1,0 +1,458 @@
+"""Every DistributedStrategy switch is wired or a documented no-op
+(VERDICT r4 item 2: no silently-ignored strategy flags).
+
+Reference: each flag drives a meta-optimizer
+(python/paddle/distributed/fleet/base/fleet_base.py:1432-1470,
+meta_optimizer_factory.py:26-35); here each drives engine construction
+(fleet/engine.py) or mesh construction (fleet_base.py), and the inert
+ones are pinned to their README sections.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.parallel.mesh import set_mesh
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    set_mesh(None)
+    from paddle_tpu.distributed import env
+
+    env.set_state(initialized=False, hcg=None, topology=None, mesh=None)
+
+
+def _strategy(dp=1, mp=1, pp=1, sharding=1, **flags):
+    s = DistributedStrategy()
+    s.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+        "sharding_degree": sharding,
+    }
+    for k, v in flags.items():
+        setattr(s, k, v)
+    return s
+
+
+def _mse(out, label):
+    return paddle.mean((out - label) ** 2)
+
+
+def _data(steps, batch, dim=8, seed=3):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        yield (rng.normal(size=(batch, dim)).astype("float32"),
+               rng.normal(size=(batch, dim)).astype("float32"))
+
+
+def _train_compiled_vs_eager(opt_factory, strategy=None, steps=3, seed=21):
+    """Run the compiled engine and the eager loop with identical nets and
+    data; returns (compiled_net, eager_net)."""
+    fleet.init(is_collective=True,
+               strategy=strategy or _strategy(sharding=2, dp=4))
+    paddle.seed(seed)
+    net_c = paddle.nn.Linear(8, 8)
+    paddle.seed(seed)
+    net_e = paddle.nn.Linear(8, 8)
+    model = fleet.distributed_model(net_c)
+    opt_c = fleet.distributed_optimizer(opt_factory(model.parameters()))
+    opt_e = opt_factory(net_e.parameters())
+    for x, y in _data(steps, batch=8):
+        model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                          opt_c, loss_fn=_mse)
+        loss = _mse(net_e(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+    return net_c, net_e
+
+
+class TestLambLars:
+    """VERDICT r4 item 7: Lamb/LARS compile first-class."""
+
+    def test_lamb_compiled_matches_eager(self):
+        net_c, net_e = _train_compiled_vs_eager(
+            lambda ps: paddle.optimizer.Lamb(learning_rate=0.05,
+                                             lamb_weight_decay=0.1,
+                                             parameters=ps))
+        np.testing.assert_allclose(np.asarray(net_c.weight._data),
+                                   np.asarray(net_e.weight._data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lars_compiled_matches_eager_no_warning(self):
+        import warnings as W
+
+        with W.catch_warnings():
+            W.simplefilter("error")  # the old degradation warning = failure
+            net_c, net_e = _train_compiled_vs_eager(
+                lambda ps: paddle.optimizer.LarsMomentum(
+                    learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+                    lars_weight_decay=0.0005, parameters=ps))
+        np.testing.assert_allclose(np.asarray(net_c.weight._data),
+                                   np.asarray(net_e.weight._data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_adamw_apply_decay_param_fun_honored(self):
+        """Params excluded by apply_decay_param_fun get NO decoupled decay
+        in the compiled step (reference adamw.py)."""
+        def mk(ps):
+            # reference-style name matching: auto names are
+            # "<scope>_<k>.w_0" / ".b_0" (unique_name generator parity)
+            return paddle.optimizer.AdamW(
+                learning_rate=0.05, weight_decay=0.5, parameters=ps,
+                apply_decay_param_fun=lambda n: ".b_" not in n)
+
+        net_c, net_e = _train_compiled_vs_eager(mk)
+        # the decay-EXCLUDED bias must match tightly (this is the masked
+        # path under test)
+        np.testing.assert_allclose(np.asarray(net_c.bias._data),
+                                   np.asarray(net_e.bias._data),
+                                   rtol=1e-4, atol=1e-5)
+        # weight tolerance is looser: early-step adam is sign-like
+        # (step ≈ m̂/√v̂ ≈ ±1) for near-zero grads, so compiled-vs-eager
+        # reduction-order noise can flip isolated elements by ~lr·Δ
+        np.testing.assert_allclose(np.asarray(net_c.weight._data),
+                                   np.asarray(net_e.weight._data),
+                                   rtol=1e-2, atol=5e-4)
+        # and the exclusion is observable: decayed weights differ from a
+        # run where decay hits everything
+        fleet.init(is_collective=True, strategy=_strategy(sharding=2, dp=4))
+        paddle.seed(21)
+        net_all = paddle.nn.Linear(8, 8)
+        model = fleet.distributed_model(net_all)
+        opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+            learning_rate=0.05, weight_decay=0.5,
+            parameters=model.parameters()))
+        for x, y in _data(3, batch=8):
+            model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                              opt, loss_fn=_mse)
+        assert not np.allclose(np.asarray(net_all.bias._data),
+                               np.asarray(net_c.bias._data))
+
+
+class TestStrategyLambLars:
+    def test_strategy_lamb_overrides_update_rule(self):
+        """strategy.lamb=True trains with LAMB even when the user passed
+        SGD (reference LambOptimizer meta-optimizer)."""
+        st = _strategy(sharding=2, dp=4, lamb=True)
+        st.lamb_configs = {"lamb_weight_decay": 0.1,
+                          "exclude_from_weight_decay": []}
+        net_c, _ = _train_compiled_vs_eager(
+            lambda ps: paddle.optimizer.SGD(learning_rate=0.05,
+                                            parameters=ps),
+            strategy=st)
+        # eager LAMB with the strategy's hyperparameters
+        paddle.seed(21)
+        net_l = paddle.nn.Linear(8, 8)
+        opt_l = paddle.optimizer.Lamb(learning_rate=0.05,
+                                      lamb_weight_decay=0.1,
+                                      parameters=net_l.parameters())
+        for x, y in _data(3, batch=8):
+            loss = _mse(net_l(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            opt_l.step()
+            opt_l.clear_grad()
+        np.testing.assert_allclose(np.asarray(net_c.weight._data),
+                                   np.asarray(net_l.weight._data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_strategy_lars_overrides_update_rule(self):
+        st = _strategy(sharding=2, dp=4, lars=True)
+        st.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                           "epsilon": 0.0, "exclude_from_weight_decay": []}
+        net_c, _ = _train_compiled_vs_eager(
+            lambda ps: paddle.optimizer.Momentum(learning_rate=0.1,
+                                                 momentum=0.9,
+                                                 parameters=ps),
+            strategy=st)
+        paddle.seed(21)
+        net_l = paddle.nn.Linear(8, 8)
+        opt_l = paddle.optimizer.LarsMomentum(
+            learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+            lars_weight_decay=0.0005, parameters=net_l.parameters())
+        for x, y in _data(3, batch=8):
+            loss = _mse(net_l(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            opt_l.step()
+            opt_l.clear_grad()
+        np.testing.assert_allclose(np.asarray(net_c.weight._data),
+                                   np.asarray(net_l.weight._data),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestAmpFlag:
+    def test_amp_bf16_autocasts_compiled_forward(self):
+        """strategy.amp=True: the compiled step computes in bf16 —
+        observable as bf16 ops in the lowered HLO."""
+        def build(amp):
+            fleet.init(is_collective=True,
+                       strategy=_strategy(sharding=2, dp=4, amp=amp))
+            paddle.seed(5)
+            net = paddle.nn.Linear(8, 8)
+            model = fleet.distributed_model(net)
+            opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=model.parameters()))
+            x = paddle.to_tensor(np.zeros((8, 8), np.float32))
+            model.train_batch((x, x), opt, loss_fn=_mse)
+            eng = model._engine
+            lowered = eng.train_step.lower(
+                (x._data, x._data)).as_text()
+            set_mesh(None)
+            from paddle_tpu.distributed import env as E
+
+            E.set_state(initialized=False, hcg=None, topology=None,
+                        mesh=None)
+            return lowered
+
+        assert "bf16" in build(True)
+        assert "bf16" not in build(False)
+
+    def test_amp_fp16_compiles_dynamic_loss_scaling(self):
+        st = _strategy(sharding=2, dp=4, amp=True)
+        st.amp_configs = dict(st.amp_configs, dtype="float16",
+                              init_loss_scaling=1024.0)
+        fleet.init(is_collective=True, strategy=st)
+        paddle.seed(6)
+        net = paddle.nn.Linear(8, 8)
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(np.zeros((8, 8), np.float32))
+        model.train_batch((x, x), opt, loss_fn=_mse)
+        st8 = model._engine.train_step.scaler_state
+        assert st8 is not None and float(st8["scale"]) == 1024.0
+
+
+class TestRecomputeFlag:
+    def test_recompute_wraps_step_in_checkpoint(self):
+        """strategy.recompute=True: the step loss jaxpr contains the remat
+        primitive, and the training math is unchanged."""
+        def run(recompute):
+            fleet.init(is_collective=True,
+                       strategy=_strategy(sharding=2, dp=4,
+                                          recompute=recompute))
+            paddle.seed(7)
+            net = paddle.nn.Linear(8, 8)
+            model = fleet.distributed_model(net)
+            opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=model.parameters()))
+            losses = []
+            for x, y in _data(2, batch=8):
+                losses.append(float(model.train_batch(
+                    (paddle.to_tensor(x), paddle.to_tensor(y)), opt,
+                    loss_fn=_mse)))
+            eng = model._engine
+            import jax.numpy as jnp
+
+            params = {k: np.asarray(v)
+                      for k, v in eng.train_step.params.items()}
+            jaxpr = jax.make_jaxpr(
+                lambda p, b: eng._step_loss(
+                    p, eng.train_step.aux, b))(
+                        params, (jnp.zeros((8, 8), jnp.float32),
+                                 jnp.zeros((8, 8), jnp.float32)))
+            set_mesh(None)
+            from paddle_tpu.distributed import env as E
+
+            E.set_state(initialized=False, hcg=None, topology=None,
+                        mesh=None)
+            return losses, "remat" in str(jaxpr)
+
+        l_on, has_remat = run(True)
+        l_off, no_remat = run(False)
+        assert has_remat and not no_remat
+        np.testing.assert_allclose(l_on, l_off, rtol=1e-6)
+
+
+class TestAspFlag:
+    def test_asp_masks_survive_training(self):
+        from paddle_tpu.incubate import asp
+
+        fleet.init(is_collective=True,
+                   strategy=_strategy(sharding=2, dp=4, asp=True))
+        paddle.seed(8)
+        net = paddle.nn.Linear(8, 8)
+        asp.prune_model(net)
+        assert asp.check_sparsity(net.weight)
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        for x, y in _data(3, batch=8):
+            model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                              opt, loss_fn=_mse)
+        # 2:4 sparsity held through 3 compiled optimizer steps
+        assert asp.check_sparsity(net.weight)
+        # and the kept positions actually trained
+        assert float(np.abs(np.asarray(net.weight._data)).sum()) > 0
+
+    def test_asp_pipelined_stacks_per_stage_masks(self):
+        """Stage-stacked build: each stage's OWN 2:4 mask is applied (a
+        donor-only mask would corrupt the other stages' patterns)."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            LayerDesc, PipelineLayer)
+        from paddle_tpu.incubate import asp
+
+        st = _strategy(pp=2, dp=4, asp=True)
+        st.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 1}
+        fleet.init(is_collective=True, strategy=st)
+        paddle.seed(17)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(paddle.nn.Linear, 8, 8) for _ in range(4)],
+            num_stages=2, loss_fn=_mse)
+        asp.prune_model(pipe)
+        masks_before = {n: (np.asarray(p._data) != 0)
+                        for n, p in pipe.named_parameters()
+                        if p._data.ndim == 2}
+        model = fleet.distributed_model(pipe)
+        opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        for x, y in _data(3, batch=8):
+            model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                              opt)
+        for n, p in pipe.named_parameters():
+            if p._data.ndim != 2:
+                continue
+            assert asp.check_sparsity(p), n
+            # the surviving positions are THIS layer's original mask, not
+            # some other stage's
+            alive = np.asarray(p._data) != 0
+            assert not np.any(alive & ~masks_before[n]), n
+
+    def test_asp_without_prune_warns_and_trains_dense(self):
+        fleet.init(is_collective=True,
+                   strategy=_strategy(sharding=2, dp=4, asp=True))
+        paddle.seed(9)
+        net = paddle.nn.Linear(8, 8)
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        with pytest.warns(UserWarning, match="asp"):
+            for x, y in _data(1, batch=8):
+                model.train_batch((paddle.to_tensor(x),
+                                   paddle.to_tensor(y)), opt, loss_fn=_mse)
+
+
+class TestTensorParallelFlag:
+    def test_tensor_parallel_sets_model_axis(self):
+        st = _strategy()  # hybrid_configs all 1
+        st.tensor_parallel = True
+        st.tensor_parallel_configs = {"tensor_parallel_degree": 2}
+        st.hybrid_configs = {"dp_degree": 4, "mp_degree": 1,
+                             "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=st)
+        assert fleet.get_mesh().shape["model"] == 2
+
+
+class TestDocumentedNoOps:
+    def test_find_unused_parameters_is_documented_noop(self):
+        """The flag is accepted; unused params neither break the step nor
+        receive grads (no Reducer hook to hang, unlike reference
+        imperative/reducer.cc:972)."""
+        from paddle_tpu.distributed.parallel import DataParallel
+
+        assert "find_unused_parameters" in (DataParallel.__doc__ or "")
+        assert "NO-OP" in DataParallel.__doc__
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.used = paddle.nn.Linear(8, 8)
+                self.unused = paddle.nn.Linear(8, 8)
+
+            def forward(self, x):
+                return self.used(x)
+
+        paddle.seed(11)
+        net = Net()
+        dp = DataParallel(net, find_unused_parameters=True)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        loss = paddle.mean(dp(x))
+        loss.backward()
+        assert net.used.weight.grad is not None
+        assert net.unused.weight.grad is None  # nothing hangs, no grad
+
+    def test_inert_flags_have_readme_sections(self):
+        readme = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "README.md")).read()
+        for flag in ("dgc", "localsgd", "fp16_allreduce",
+                     "find_unused_parameters"):
+            assert flag in readme, f"README must document inert flag {flag}"
+        assert "Strategy flag wiring" in readme
+
+    def test_no_strategy_bool_is_silently_ignored(self):
+        """Meta-test: every bool switch on DistributedStrategy is either
+        consumed by code (grep) or named in the README."""
+        import subprocess
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        readme = open(os.path.join(root, "README.md")).read()
+        s = DistributedStrategy()
+        flags = [k for k, v in s.__dict__.items() if isinstance(v, bool)]
+        for flag in flags:
+            hits = subprocess.run(
+                ["grep", "-rl", f"strategy, \"{flag}\"", "--include=*.py",
+                 os.path.join(root, "paddle_tpu")],
+                capture_output=True, text=True).stdout
+            hits2 = subprocess.run(
+                ["grep", "-rl", f'"{flag}"', "--include=*.py",
+                 os.path.join(root, "paddle_tpu")],
+                capture_output=True, text=True).stdout
+            consumed = bool(hits.strip() or hits2.strip())
+            documented = flag in readme
+            assert consumed or documented, (
+                f"strategy.{flag} is neither consumed nor documented")
+
+
+class TestMultihostEagerCollectives:
+    """Cross-process eager collectives route through process_allgather
+    (single-process here: the plumbing is exercised with a stubbed
+    gather; the real multi-process path shares every line but the
+    gather itself)."""
+
+    def test_all_reduce_routes_through_multihost(self, monkeypatch):
+        from paddle_tpu.distributed import collective as C
+        from paddle_tpu.distributed import env as E
+
+        calls = {}
+
+        def fake_allgather(arr):
+            calls["arr"] = np.asarray(arr)
+            return np.stack([np.asarray(arr), 2 * np.asarray(arr)])
+
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        monkeypatch.setattr(E, "get_world_size", lambda: 2)
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = C.all_reduce(t)
+        np.testing.assert_allclose(np.asarray(out._data), [3.0, 6.0])
+        assert calls["arr"].tolist() == [1.0, 2.0]
+
+    def test_broadcast_picks_src_row(self, monkeypatch):
+        from paddle_tpu.distributed import collective as C
+        from paddle_tpu.distributed import env as E
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda arr: np.stack([np.asarray(arr) * 0 + 7,
+                                  np.asarray(arr)]))
+        monkeypatch.setattr(E, "get_world_size", lambda: 2)
+        t = paddle.to_tensor(np.array([1.0], np.float32))
+        out = C.broadcast(t, src=0)
+        np.testing.assert_allclose(np.asarray(out._data), [7.0])
+
+    def test_sendrecv_still_raises_with_decision(self, monkeypatch):
+        from paddle_tpu.distributed import collective as C
+        from paddle_tpu.distributed import env as E
+
+        monkeypatch.setattr(E, "get_world_size", lambda: 2)
+        t = paddle.to_tensor(np.array([1.0], np.float32))
+        with pytest.raises((NotImplementedError, RuntimeError)):
+            C.send(t, dst=1)

@@ -1,10 +1,14 @@
 """Sweep flash block sizes on the BERT-base bench config (seq 512 + 2048)."""
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def run(seq, batch, bq, bk, bb, K=8):
+
+def run(seq, batch, bq, bk, bb, K=8, remat=True, chunk=None):
     import jax
     import jax.numpy as jnp
 
@@ -13,7 +17,7 @@ def run(seq, batch, bq, bk, bb, K=8):
     from paddle_tpu.models import bert_base_config, gpt_init, gpt_loss
     from paddle_tpu.parallel.train_step import pure_adamw_init, pure_adamw_update
 
-    cfg = bert_base_config(remat=True, use_flash=True, seq_len=seq)
+    cfg = bert_base_config(remat=remat, use_flash=True, seq_len=seq)
 
     # override attention blocks for this run
     import sys
@@ -42,7 +46,8 @@ def run(seq, batch, bq, bk, bb, K=8):
             def body(_, carry):
                 p, o = carry
                 _, grads = jax.value_and_grad(
-                    lambda pp: gpt_loss(cfg, pp, (tokens, labels)))(p)
+                    lambda pp: gpt_loss(cfg, pp, (tokens, labels),
+                                        loss_chunk=chunk))(p)
                 return pure_adamw_update(p, grads, o, 1e-4)
             return jax.lax.fori_loop(0, K, body, (params, opt))
 
@@ -56,9 +61,13 @@ def run(seq, batch, bq, bk, bb, K=8):
             best = min(best, (time.perf_counter() - t0) / K)
         n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
         sps = batch / best
-        print(f"seq{seq} b{batch} bq{bq} bk{bk} bb{bb}: {sps:.2f} sps mfu={_mfu(n, seq, sps):.4f}", flush=True)
+        print(f"seq{seq} b{batch} bq{bq} bk{bk} bb{bb} remat={remat} "
+              f"chunk={chunk}: {sps:.2f} sps mfu={_mfu(n, seq, sps):.4f}",
+              flush=True)
     except Exception as e:
-        print(f"seq{seq} b{batch} bq{bq} bk{bk} bb{bb}: FAIL {type(e).__name__}: {str(e)[:100]}", flush=True)
+        print(f"seq{seq} b{batch} bq{bq} bk{bk} bb{bb} remat={remat} "
+              f"chunk={chunk}: FAIL {type(e).__name__}: {str(e)[:100]}",
+              flush=True)
     finally:
         G._attention = orig
 
@@ -70,7 +79,27 @@ if __name__ == "__main__":
         for bq, bk, bb in [(512, 512, 2), (512, 512, 8), (512, 512, 16),
                            (512, 512, 12), (256, 512, 8)]:
             run(512, 16, bq, bk, bb)
-    else:
+    elif which == "2048":
         for bq, bk, bb in [(2048, 2048, 2), (2048, 1024, None), (1024, 2048, None),
                            (1024, 2048, 2), (2048, 2048, None)]:
             run(2048, 4, bq, bk, bb)
+    elif which == "blocked2048":
+        # r5: causal block skipping only pays with a real kv grid; sweep
+        # blocked shapes at 2048 (whole-seq blocks can't skip the upper
+        # triangle — half the attention FLOPs are masked waste)
+        for bq, bk, bb in [(512, 512, 8), (512, 512, 4), (512, 1024, 4),
+                           (256, 512, 8), (1024, 1024, 2), (512, 2048, 2),
+                           (1024, 512, 4)]:
+            run(2048, 4, bq, bk, bb)
+    else:
+        # r5: the 2048 configs ran remat=True out of habit — BERT-base
+        # activations at b4-b8/2048 fit fine without remat; chunked CE
+        # frees the 1GB fp32 logits buffer
+        for b, bq, bk, bb, remat, chunk in [
+                (4, 512, 1024, 4, False, 256),
+                (4, 2048, 2048, None, False, 256),
+                (8, 512, 1024, 4, False, 256),
+                (8, 2048, 2048, None, False, 256),
+                (8, 512, 1024, 4, False, None),
+                (16, 512, 1024, 4, False, 256)]:
+            run(2048, b, bq, bk, bb, remat=remat, chunk=chunk)

@@ -1,0 +1,74 @@
+"""Sweep GPT-760M AdamW variants (VERDICT r4 item 1: MFU 0.302 -> >=0.42).
+
+One variant per invocation (fresh process = clean HBM):
+    python tools/exp_gpt760.py <batch> <mv_dtype> <heads> [remat] [unroll]
+"""
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(batch, mv_dtype_name, n_heads, remat=True, unroll=1, K=4):
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _mfu
+    from paddle_tpu.models import GPTConfig, gpt_init, gpt_loss
+    from paddle_tpu.parallel.train_step import (pure_adamw_init,
+                                                pure_adamw_update)
+
+    mv_dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16}[mv_dtype_name]
+    cfg = GPTConfig(vocab_size=50304, hidden=1536, n_layers=24,
+                    n_heads=n_heads, seq_len=2048, remat=remat,
+                    use_flash=True, param_dtype=jnp.bfloat16,
+                    scan_unroll=unroll)
+    rng = np.random.default_rng(0)
+    params = jax.device_put(gpt_init(cfg, seed=0))
+    opt = pure_adamw_init(params, mv_dtype=mv_dtype)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, cfg.seq_len)), jnp.int32)
+    labels = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, cfg.seq_len)), jnp.int32)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def k_steps(params, opt):
+        def body(_, carry):
+            p, o = carry
+            _, grads = jax.value_and_grad(
+                lambda pp: gpt_loss(cfg, pp, (tokens, labels),
+                                    loss_chunk=256))(p)
+            return pure_adamw_update(p, grads, o, 1e-4, mv_dtype=mv_dtype)
+
+        return jax.lax.fori_loop(0, K, body, (params, opt))
+
+    p2, o2 = k_steps(params, opt)
+    jax.block_until_ready(p2)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        p2, o2 = k_steps(p2, o2)
+        jax.block_until_ready(p2)
+        best = min(best, (time.perf_counter() - t0) / K)
+    n = sum(int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(params))
+    sps = batch / best
+    print(f"b{batch} mv={mv_dtype_name} h{n_heads} remat={remat} "
+          f"unroll={unroll}: {sps:.2f} sps mfu={_mfu(n, 2048, sps):.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))), ".jax_cache"))
+    b = int(sys.argv[1])
+    mv = sys.argv[2]
+    h = int(sys.argv[3])
+    remat = (sys.argv[4] != "0") if len(sys.argv) > 4 else True
+    unroll = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+    run(b, mv, h, remat, unroll)

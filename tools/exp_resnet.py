@@ -1,0 +1,86 @@
+"""ResNet-50 throughput variants (VERDICT r5: raise 0.857x to >=0.90x).
+
+    python tools/exp_resnet.py <batch> <amp_level> [k]
+"""
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(batch, level, K=10):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    if level in ("O2", "O3"):
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    if level == "O3":
+        # ceiling probe: EVERYTHING bf16 incl. BN params/buffers — halves
+        # the elementwise HBM traffic fp32 BN keeps at 4B/el
+        import jax.numpy as jnp
+        for p in model.parameters():
+            p._data = p._data.astype(jnp.bfloat16)
+        for _, b in model.named_buffers():
+            if b is not None and b._data.dtype == jnp.float32:
+                b._data = b._data.astype(jnp.bfloat16)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def loss_fn(run_model, images, labels):
+        if level in ("O1", "O2", "O3"):
+            with paddle.amp.auto_cast(enable=True, level="O2" if level ==
+                                      "O3" else level):
+                out = run_model(images)
+        else:
+            out = run_model(images)
+        return paddle.nn.functional.cross_entropy(out, labels)
+
+    rng = np.random.default_rng(0)
+    images = paddle.to_tensor(
+        rng.normal(size=(batch, 3, 224, 224)).astype("float32"))
+    labels = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype("int64"))
+    step = TrainStep(model, loss_fn, opt)
+    step(images, labels)  # build
+
+    impl = step._step_impl
+    lr = float(opt.get_lr())
+    arr_batch = (images._data, labels._data)
+    params = {k: p._data for k, p in model.named_parameters()}
+    slots = dict(step._slot_values)
+    buffers = {k: b._data for k, b in model.named_buffers() if b is not None}
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def k_steps(params, slots, buffers):
+        def body(_, c):
+            p, s, b = c
+            np_, ns, nb, _ = impl(p, s, b, lr, arr_batch)
+            return (np_, ns, nb)
+
+        return jax.lax.fori_loop(0, K, body, (params, slots, buffers))
+
+    out = k_steps(params, slots, buffers)
+    jax.block_until_ready(out[0])
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = k_steps(*out)
+        jax.block_until_ready(out[0])
+        best = min(best, (time.perf_counter() - t0) / K)
+    print(f"b{batch} {level}: {batch / best:.2f} img/s", flush=True)
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))), ".jax_cache"))
+    run(int(sys.argv[1]), sys.argv[2],
+        int(sys.argv[3]) if len(sys.argv) > 3 else 10)
