@@ -138,9 +138,19 @@ class LocalFS(FS):
 
 
 class HDFSClient(FS):
-    """HDFS via the hadoop CLI (reference fs.py HDFSClient shells
-    `hadoop fs -<cmd>` the same way). Requires a hadoop binary; absent
-    one (this zero-egress image), every call raises with that reason.
+    """HDFS client with two transports:
+
+    1. hadoop CLI (reference fs.py HDFSClient shells `hadoop fs -<cmd>`
+       the same way) when a hadoop binary is available, and
+    2. the WebHDFS REST API (public Hadoop spec, /webhdfs/v1) when only
+       an endpoint is configured — `configs={"webhdfs_url":
+       "http://namenode:9870", "user": "..."}`. This is the TPU-native
+       path: pod workers usually have network reach to the namenode but
+       no hadoop JRE install, so state-of-the-cluster queries and
+       checkpoint upload/download ride plain HTTP.
+
+    With neither transport configured, every call raises with that
+    reason (zero-egress environments have no HDFS).
     """
 
     def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
@@ -148,17 +158,30 @@ class HDFSClient(FS):
         self._hadoop = os.path.join(hadoop_home, "bin/hadoop") \
             if hadoop_home else shutil.which("hadoop")
         self._configs = configs or {}
+        self._webhdfs = (self._configs.get("webhdfs_url") or "").rstrip("/")
+        self._user = self._configs.get("user")
+        self._timeout = max(1.0, float(time_out) / 1000.0)
+
+    # -- transport selection ------------------------------------------------
+    def _use_rest(self) -> bool:
+        if self._hadoop and os.path.exists(self._hadoop):
+            return False
+        if self._webhdfs:
+            return True
+        # distinct type: predicate methods must NOT swallow this into
+        # a False answer (a checkpoint manager would silently restart)
+        raise FileNotFoundError(
+            "HDFSClient needs a hadoop binary (hadoop_home=...) or a "
+            "WebHDFS endpoint (configs={'webhdfs_url': ...}); neither is "
+            "available in this environment — use LocalFS, or mount the "
+            "checkpoint directory")
 
     def _run(self, *args):
-        if not self._hadoop or not os.path.exists(self._hadoop):
-            # distinct type: predicate methods must NOT swallow this into
-            # a False answer (a checkpoint manager would silently restart)
-            raise FileNotFoundError(
-                "HDFSClient needs a hadoop binary (hadoop_home=...); none "
-                "is available in this environment — use LocalFS, or mount "
-                "the checkpoint directory")
+        self._use_rest()  # raises when no transport at all
         cfg = []
         for k, v in self._configs.items():
+            if k in ("webhdfs_url", "user"):
+                continue
             cfg += ["-D", f"{k}={v}"]
         out = subprocess.run([self._hadoop, "fs", *cfg, *args],
                              capture_output=True, text=True)
@@ -166,7 +189,83 @@ class HDFSClient(FS):
             raise RuntimeError(out.stderr.strip())
         return out.stdout
 
+    # -- WebHDFS REST -------------------------------------------------------
+    def _rest_url(self, fs_path, op, **params):
+        from urllib.parse import quote, urlencode
+
+        if not fs_path.startswith("/"):
+            fs_path = "/" + fs_path
+        q = {"op": op}
+        if self._user:
+            q["user.name"] = self._user
+        q.update(params)
+        return (f"{self._webhdfs}/webhdfs/v1{quote(fs_path)}"
+                f"?{urlencode(q)}")
+
+    def _rest(self, method, fs_path, op, data=None, ok404=False,
+              expect_true=False, data_len=None, **params):
+        """One WebHDFS call; returns the parsed JSON body (or raw bytes
+        for OPEN). 404 returns None when ok404 (existence probes).
+        expect_true: ops whose success signal is a {"boolean": true} BODY
+        (RENAME/MKDIRS/DELETE) raise on false — HTTP 200 alone does NOT
+        mean the operation happened (a silently-failed checkpoint rename
+        would otherwise report success)."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        url = self._rest_url(fs_path, op, **params)
+        # CREATE two-step per the spec: the FIRST namenode PUT carries no
+        # body (it only fetches the datanode redirect); the data goes
+        # once, to the redirect target
+        first_data = None if (method == "PUT" and op == "CREATE") else data
+        req = urllib.request.Request(url, data=first_data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                body = r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404 and ok404:
+                return None
+            if e.code == 307 and method == "PUT":
+                # urllib does not auto-redirect PUTs. data may be a
+                # file-like object (streamed upload) — then data_len sets
+                # an explicit Content-Length so the body is not buffered
+                loc = e.headers.get("Location")
+                req2 = urllib.request.Request(
+                    loc, data=b"" if data is None else data, method="PUT")
+                if data_len is not None:
+                    req2.add_header("Content-Length", str(data_len))
+                with urllib.request.urlopen(req2,
+                                            timeout=self._timeout) as r2:
+                    body = r2.read()
+                return _json.loads(body) if body else {}
+            raise RuntimeError(
+                f"WebHDFS {op} {fs_path}: HTTP {e.code} "
+                f"{e.read()[:200]!r}") from e
+        if op == "OPEN":
+            return body
+        out = _json.loads(body) if body else {}
+        if expect_true and out.get("boolean") is False:
+            raise RuntimeError(
+                f"WebHDFS {op} {fs_path}: server answered boolean=false "
+                f"(operation did not happen)")
+        return out
+
+    def _rest_status(self, fs_path):
+        out = self._rest("GET", fs_path, "GETFILESTATUS", ok404=True)
+        return None if out is None else out["FileStatus"]
+
     def ls_dir(self, fs_path):
+        if self._use_rest():
+            # NO ok404: the CLI transport raises for a missing path — the
+            # two transports must agree, or misconfigured checkpoint dirs
+            # read as "no checkpoints" and auto-resume silently restarts
+            out = self._rest("GET", fs_path, "LISTSTATUS")
+            dirs, files = [], []
+            for st in out["FileStatuses"]["FileStatus"]:
+                (dirs if st["type"] == "DIRECTORY"
+                 else files).append(st["pathSuffix"])
+            return dirs, files
         lines = self._run("-ls", fs_path).splitlines()
         dirs, files = [], []
         for ln in lines:
@@ -178,6 +277,8 @@ class HDFSClient(FS):
         return dirs, files
 
     def is_exist(self, fs_path):
+        if self._use_rest():
+            return self._rest_status(fs_path) is not None
         try:
             self._run("-test", "-e", fs_path)
             return True
@@ -185,6 +286,9 @@ class HDFSClient(FS):
             return False
 
     def is_dir(self, fs_path):
+        if self._use_rest():
+            st = self._rest_status(fs_path)
+            return st is not None and st["type"] == "DIRECTORY"
         try:
             self._run("-test", "-d", fs_path)
             return True
@@ -195,15 +299,41 @@ class HDFSClient(FS):
         return self.is_exist(fs_path) and not self.is_dir(fs_path)
 
     def mkdirs(self, fs_path):
+        if self._use_rest():
+            self._rest("PUT", fs_path, "MKDIRS", expect_true=True)
+            return
         self._run("-mkdir", "-p", fs_path)
 
     def delete(self, fs_path):
+        if self._use_rest():
+            self._rest("DELETE", fs_path, "DELETE", recursive="true")
+            return
         self._run("-rm", "-r", "-f", fs_path)
 
     def upload(self, local_path, fs_path):
+        if self._use_rest():
+            # streamed: the namenode PUT carries no body (spec step 1);
+            # the redirected datanode PUT takes the open FILE OBJECT with
+            # an explicit Content-Length, so a multi-GB checkpoint never
+            # sits in host memory (mirrors download()'s copyfileobj)
+            size = os.path.getsize(local_path)
+            with open(local_path, "rb") as f:
+                self._rest("PUT", fs_path, "CREATE", data=f,
+                           data_len=size, overwrite="true")
+            return
         self._run("-put", local_path, fs_path)
 
     def download(self, fs_path, local_path):
+        if self._use_rest():
+            import shutil as _sh
+            import urllib.request
+
+            req = urllib.request.Request(
+                self._rest_url(fs_path, "OPEN"), method="GET")
+            with urllib.request.urlopen(req, timeout=self._timeout) as r, \
+                    open(local_path, "wb") as f:
+                _sh.copyfileobj(r, f)          # streamed, not buffered
+            return
         self._run("-get", fs_path, local_path)
 
     def need_upload_download(self):
@@ -217,6 +347,12 @@ class HDFSClient(FS):
             self.delete(fs_dst_path)
         elif not overwrite and self.is_exist(fs_dst_path):
             raise FSFileExistsError(fs_dst_path)
+        if self._use_rest():
+            dst = fs_dst_path if fs_dst_path.startswith("/") \
+                else "/" + fs_dst_path
+            self._rest("PUT", fs_src_path, "RENAME", destination=dst,
+                       expect_true=True)
+            return
         self._run("-mv", fs_src_path, fs_dst_path)
 
     def list_dirs(self, fs_path):
@@ -230,7 +366,23 @@ class HDFSClient(FS):
             if exist_ok:
                 return
             raise FSFileExistsError(fs_path)
+        if self._use_rest():
+            try:
+                self._rest("PUT", fs_path, "CREATE", data=b"",
+                           overwrite="false")
+            except RuntimeError as e:
+                # check-then-create race: another worker created the file
+                # between our probe and the CREATE — with exist_ok that IS
+                # the requested end state
+                if exist_ok and ("exist" in str(e).lower()
+                                 or "403" in str(e)):
+                    return
+                raise
+            return
         self._run("-touchz", fs_path)
 
     def cat(self, fs_path=None):
+        if self._use_rest():
+            return self._rest("GET", fs_path, "OPEN").decode(
+                "utf-8", errors="replace")
         return self._run("-cat", fs_path)

@@ -587,8 +587,10 @@ def _node_vjp_recorded(node: GradNode, full_cts):
     Returns (live_positions, cotangent Tensors for those positions)."""
     if node.fn is None:
         raise RuntimeError(
-            f"create_graph=True: op '{node.name}' was recorded without its "
-            "primal function (old-format tape); re-run the forward")
+            f"create_graph=True: op '{node.name}' has no re-runnable primal "
+            "(PyLayer custom ops record only their backward closure, so "
+            "second-order grads cannot flow through them — reformulate the "
+            "PyLayer body with regular ops to use double grad)")
     live = [i for i, t in enumerate(node.inputs)
             if t is not None and jnp.issubdtype(
                 jnp.asarray(t._data).dtype, jnp.inexact)]
@@ -766,7 +768,10 @@ def grad(
             if create_graph:
                 _backward_create_graph(o, go)
             else:
-                backward(o, go, retain_graph=True if retain_graph else True)
+                # always retain here: freeing (when retain_graph=False)
+                # happens once in the finally block after ALL outputs
+                # walked — per-output freeing would break multi-output grad
+                backward(o, go, retain_graph=True)
         results = []
         for t in ins:
             g = t.grad

@@ -1,0 +1,286 @@
+"""HDFSClient over the WebHDFS REST transport, against an in-process mock
+namenode (stdlib http.server implementing the /webhdfs/v1 operations the
+client issues — LISTSTATUS, GETFILESTATUS, MKDIRS, DELETE, RENAME,
+CREATE with the spec's 307-redirect two-step, OPEN).
+
+Reference surface: python/paddle/distributed/fleet/utils/fs.py HDFSClient;
+the transport is the round-5 TPU-native addition (pod workers reach the
+namenode over HTTP, no hadoop JRE install).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils.fs import (
+    FSFileExistsError, HDFSClient)
+
+
+class _MockHDFS:
+    """Dict-backed namespace: path -> bytes (file) or None (dir)."""
+
+    def __init__(self):
+        self.tree = {"/": None}
+
+    def exists(self, p):
+        return p in self.tree
+
+    def is_dir(self, p):
+        return self.tree.get(p, b"") is None and p in self.tree
+
+    def children(self, p):
+        pre = p.rstrip("/") + "/"
+        out = []
+        for k in self.tree:
+            if k != p and k.startswith(pre) and "/" not in k[len(pre):]:
+                out.append(k)
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    fs: _MockHDFS = None  # set per-test
+    redirect_port: int = None
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _path_op(self):
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        assert u.path.startswith("/webhdfs/v1")
+        return unquote(u.path[len("/webhdfs/v1"):]) or "/", \
+            q["op"][0].upper(), q
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        p, op, _q = self._path_op()
+        fs = self.fs
+        if op == "GETFILESTATUS":
+            if not fs.exists(p):
+                self._json(404, {"RemoteException": {
+                    "exception": "FileNotFoundException"}})
+                return
+            self._json(200, {"FileStatus": {
+                "type": "DIRECTORY" if fs.is_dir(p) else "FILE",
+                "pathSuffix": ""}})
+        elif op == "LISTSTATUS":
+            if not fs.exists(p):
+                self._json(404, {"RemoteException": {
+                    "exception": "FileNotFoundException"}})
+                return
+            sts = [{"type": "DIRECTORY" if fs.is_dir(c) else "FILE",
+                    "pathSuffix": c.rsplit("/", 1)[-1]}
+                   for c in sorted(fs.children(p))]
+            self._json(200, {"FileStatuses": {"FileStatus": sts}})
+        elif op == "OPEN":
+            if not fs.exists(p) or fs.is_dir(p):
+                self._json(404, {})
+                return
+            body = fs.tree[p]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(400, {"RemoteException": {"exception": "BadOp"}})
+
+    def do_PUT(self):
+        p, op, q = self._path_op()
+        fs = self.fs
+        ln = int(self.headers.get("Content-Length") or 0)
+        data = self.rfile.read(ln) if ln else b""
+        if op == "MKDIRS":
+            parts = p.strip("/").split("/")
+            cur = ""
+            for seg in parts:
+                cur += "/" + seg
+                fs.tree.setdefault(cur, None)
+            self._json(200, {"boolean": True})
+        elif op == "RENAME":
+            dst = q["destination"][0]
+            fs.tree[dst] = fs.tree.pop(p)
+            self._json(200, {"boolean": True})
+        elif op == "CREATE":
+            if "redirected" not in q:
+                # spec two-step: redirect the data PUT to a "datanode"
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.1:{self.redirect_port}/webhdfs/v1"
+                    f"{p}?op=CREATE&redirected=1")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            fs.tree[p] = data
+            self._json(201, {})
+        else:
+            self._json(400, {})
+
+    def do_DELETE(self):
+        p, op, _q = self._path_op()
+        assert op == "DELETE"
+        doomed = [k for k in self.fs.tree
+                  if k == p or k.startswith(p.rstrip("/") + "/")]
+        for k in doomed:
+            del self.fs.tree[k]
+        self._json(200, {"boolean": bool(doomed)})
+
+
+@pytest.fixture()
+def webhdfs():
+    fs = _MockHDFS()
+    handler = type("H", (_Handler,), {"fs": fs})
+    srv = HTTPServer(("127.0.0.1", 0), handler)
+    handler.redirect_port = srv.server_port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    client = HDFSClient(configs={
+        "webhdfs_url": f"http://127.0.0.1:{srv.server_port}",
+        "user": "tester"})
+    yield client, fs
+    srv.shutdown()
+
+
+class TestWebHDFS:
+    def test_transport_selected_without_hadoop(self, webhdfs):
+        client, _fs = webhdfs
+        assert client._use_rest()
+
+    def test_mkdirs_exist_dir_file_predicates(self, webhdfs):
+        client, _fs = webhdfs
+        assert not client.is_exist("/ckpt")
+        client.mkdirs("/ckpt/epoch_0")
+        assert client.is_exist("/ckpt")
+        assert client.is_dir("/ckpt/epoch_0")
+        assert not client.is_file("/ckpt/epoch_0")
+
+    def test_upload_download_cat_roundtrip(self, webhdfs, tmp_path):
+        client, _fs = webhdfs
+        client.mkdirs("/data")
+        src = tmp_path / "a.txt"
+        src.write_bytes(b"hello hdfs")
+        client.upload(str(src), "/data/a.txt")
+        assert client.is_file("/data/a.txt")
+        dst = tmp_path / "back.txt"
+        client.download("/data/a.txt", str(dst))
+        assert dst.read_bytes() == b"hello hdfs"
+        assert client.cat("/data/a.txt") == "hello hdfs"
+
+    def test_ls_dir_splits_dirs_and_files(self, webhdfs, tmp_path):
+        client, _fs = webhdfs
+        client.mkdirs("/root/sub")
+        src = tmp_path / "f"
+        src.write_bytes(b"x")
+        client.upload(str(src), "/root/f1")
+        dirs, files = client.ls_dir("/root")
+        assert dirs == ["sub"] and files == ["f1"]
+        assert client.list_dirs("/root") == ["sub"]
+        with pytest.raises(RuntimeError, match="LISTSTATUS"):
+            client.ls_dir("/missing")  # CLI-transport parity: loud, not []
+
+    def test_mv_semantics(self, webhdfs, tmp_path):
+        client, _fs = webhdfs
+        client.mkdirs("/m")
+        src = tmp_path / "f"
+        src.write_bytes(b"v1")
+        client.upload(str(src), "/m/a")
+        client.mv("/m/a", "/m/b")
+        assert not client.is_exist("/m/a") and client.is_file("/m/b")
+        client.upload(str(src), "/m/a")
+        with pytest.raises(FSFileExistsError):
+            client.mv("/m/a", "/m/b", overwrite=False)
+        client.mv("/m/a", "/m/b", overwrite=True)
+        assert client.is_file("/m/b")
+
+    def test_touch_exist_ok(self, webhdfs):
+        client, _fs = webhdfs
+        client.mkdirs("/t")
+        client.touch("/t/flag")
+        assert client.is_file("/t/flag")
+        client.touch("/t/flag", exist_ok=True)   # no-op
+        with pytest.raises(FSFileExistsError):
+            client.touch("/t/flag", exist_ok=False)
+
+    def test_delete_recursive(self, webhdfs, tmp_path):
+        client, _fs = webhdfs
+        client.mkdirs("/d/sub")
+        src = tmp_path / "f"
+        src.write_bytes(b"x")
+        client.upload(str(src), "/d/sub/f")
+        client.delete("/d")
+        assert not client.is_exist("/d")
+
+    def test_failed_rename_raises(self, webhdfs):
+        client, _fs = webhdfs
+        # mock pops the src — renaming a MISSING src returns boolean false
+        # via a patched handler; simulate by pre-deleting and patching
+        import json as _j
+
+        class Boom(_Handler):
+            pass
+
+        # direct: server answering boolean=false must raise, not no-op
+        orig = client._rest
+
+        def fake_rest(method, p, op, **kw):
+            if op == "RENAME":
+                if kw.get("expect_true"):
+                    raise RuntimeError("WebHDFS RENAME boolean=false "
+                                       "(operation did not happen)")
+                return {"boolean": False}
+            return orig(method, p, op, **kw)
+
+        client._rest = fake_rest
+        client.mkdirs if False else None
+        with pytest.raises(RuntimeError, match="RENAME"):
+            client.mv("/nope/a", "/nope/b", test_exists=False)
+        client._rest = orig
+
+    def test_upload_first_put_has_no_body(self, webhdfs, tmp_path):
+        """Spec two-step: the namenode PUT must be body-free; the data
+        travels once, to the redirect target."""
+        client, _fs = webhdfs
+        seen = {}
+
+        class Recorder(_MockHDFS):
+            pass
+
+        # wrap the handler's do_PUT via the request log: assert by
+        # construction — the mock's first CREATE leg never reads a body,
+        # and the client sends Content-Length only on the redirect leg
+        import urllib.request
+
+        orig_urlopen = urllib.request.urlopen
+
+        def spy(req, *a, **kw):
+            if getattr(req, "get_method", lambda: "")() == "PUT" \
+                    and "op=CREATE" in req.full_url \
+                    and "redirected" not in req.full_url:
+                seen["first_body"] = req.data
+            return orig_urlopen(req, *a, **kw)
+
+        urllib.request.urlopen = spy
+        try:
+            client.mkdirs("/u")
+            src = tmp_path / "big"
+            src.write_bytes(b"payload")
+            client.upload(str(src), "/u/big")
+        finally:
+            urllib.request.urlopen = orig_urlopen
+        assert seen["first_body"] is None
+        assert client.cat("/u/big") == "payload"
+
+    def test_no_transport_raises_not_false(self):
+        client = HDFSClient()
+        client._hadoop = None
+        with pytest.raises(FileNotFoundError, match="WebHDFS"):
+            client.is_exist("/x")
