@@ -239,9 +239,19 @@ class HDFSClient(FS):
                                             timeout=self._timeout) as r2:
                     body = r2.read()
                 return _json.loads(body) if body else {}
-            raise RuntimeError(
-                f"WebHDFS {op} {fs_path}: HTTP {e.code} "
-                f"{e.read()[:200]!r}") from e
+            raise self._rest_error(op, fs_path, e) from e
+        if method == "PUT" and op == "CREATE" and data:
+            # the server consumed CREATE WITHOUT redirecting (HttpFS/Knox
+            # gateway front-ends do this) — our body-free first PUT means
+            # the bytes were never sent; resend WITH the body rather than
+            # silently leaving a 0-byte file
+            if hasattr(data, "seek"):
+                data.seek(0)
+            req3 = urllib.request.Request(url, data=data, method="PUT")
+            if data_len is not None:
+                req3.add_header("Content-Length", str(data_len))
+            with urllib.request.urlopen(req3, timeout=self._timeout) as r3:
+                body = r3.read()
         if op == "OPEN":
             return body
         out = _json.loads(body) if body else {}
@@ -250,6 +260,25 @@ class HDFSClient(FS):
                 f"WebHDFS {op} {fs_path}: server answered boolean=false "
                 f"(operation did not happen)")
         return out
+
+    @staticmethod
+    def _rest_error(op, fs_path, e):
+        """Structured WebHDFS error: carries the HTTP code and the parsed
+        RemoteException class so callers can classify exactly instead of
+        substring-matching the message."""
+        import json as _json
+
+        raw = e.read()[:500]
+        exc_name = ""
+        try:
+            exc_name = _json.loads(raw)["RemoteException"]["exception"]
+        except Exception:  # noqa: BLE001 — non-JSON error page
+            pass
+        err = RuntimeError(
+            f"WebHDFS {op} {fs_path}: HTTP {e.code} {exc_name or raw!r}")
+        err.http_code = e.code
+        err.remote_exception = exc_name
+        return err
 
     def _rest_status(self, fs_path):
         out = self._rest("GET", fs_path, "GETFILESTATUS", ok404=True)
@@ -373,9 +402,13 @@ class HDFSClient(FS):
             except RuntimeError as e:
                 # check-then-create race: another worker created the file
                 # between our probe and the CREATE — with exist_ok that IS
-                # the requested end state
-                if exist_ok and ("exist" in str(e).lower()
-                                 or "403" in str(e)):
+                # the requested end state. Classified STRUCTURALLY (the
+                # parsed RemoteException class / HTTP 403), never by
+                # message substring.
+                if exist_ok and (
+                        getattr(e, "remote_exception", "")
+                        == "FileAlreadyExistsException"
+                        or getattr(e, "http_code", None) == 403):
                     return
                 raise
             return

@@ -176,8 +176,10 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 def _attention(cfg: GPTConfig, q, k, v):
     scale = 1.0 / math.sqrt(cfg.head_dim)
     if cfg.ring_attention:
-        from ..parallel.ring_attention import ring_attention_sharded
-        return ring_attention_sharded(q, k, v, causal=True, scale=scale,
+        # ring+flash: per-hop block compute is the Pallas kernel
+        # (parallel/ring_flash.py); jnp blockwise reference off-TPU
+        from ..parallel.ring_flash import ring_flash_attention_sharded
+        return ring_flash_attention_sharded(q, k, v, causal=True, scale=scale,
                                       seq_axis=cfg.seq_axis,
                                       batch_axis="data", head_axis="model")
     # auto: measured on v5e — flash wins at seq >= 1024 always, and at 512

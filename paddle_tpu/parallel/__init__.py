@@ -39,6 +39,7 @@ from .sharding import (
 )
 from .pipeline import pipeline_forward, stack_stages
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ring_flash import ring_flash_attention, ring_flash_attention_sharded
 from .moe import moe_ffn, moe_init, moe_param_specs, top2_gating
 from .train_step import DistributedTrainStep, pure_adamw_init, pure_adamw_update
 
@@ -49,6 +50,7 @@ __all__ = [
     "constraint",
     "pipeline_forward", "stack_stages",
     "ring_attention", "ring_attention_sharded",
+    "ring_flash_attention", "ring_flash_attention_sharded",
     "moe_ffn", "moe_init", "moe_param_specs", "top2_gating",
     "DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
 ]

@@ -219,31 +219,108 @@ class TestWebHDFS:
         client.delete("/d")
         assert not client.is_exist("/d")
 
-    def test_failed_rename_raises(self, webhdfs):
-        client, _fs = webhdfs
-        # mock pops the src — renaming a MISSING src returns boolean false
-        # via a patched handler; simulate by pre-deleting and patching
-        import json as _j
+    def test_failed_rename_raises(self):
+        """A RENAME answered HTTP 200 + {"boolean": false} must raise —
+        driven through the REAL _rest against a mock that reports the
+        rename did not happen."""
 
-        class Boom(_Handler):
-            pass
+        class FalseRename(_Handler):
+            def do_PUT(self):
+                p, op, q = self._path_op()
+                if op == "RENAME":
+                    self._json(200, {"boolean": False})
+                    return
+                super().do_PUT()
 
-        # direct: server answering boolean=false must raise, not no-op
-        orig = client._rest
+        fs = _MockHDFS()
+        fs.tree["/m"] = None
+        fs.tree["/m/a"] = b"x"
+        handler = type("H", (FalseRename,), {"fs": fs})
+        srv = HTTPServer(("127.0.0.1", 0), handler)
+        handler.redirect_port = srv.server_port
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            client = HDFSClient(configs={
+                "webhdfs_url": f"http://127.0.0.1:{srv.server_port}"})
+            with pytest.raises(RuntimeError, match="boolean=false"):
+                client.mv("/m/a", "/m/b")
+        finally:
+            srv.shutdown()
 
-        def fake_rest(method, p, op, **kw):
-            if op == "RENAME":
-                if kw.get("expect_true"):
-                    raise RuntimeError("WebHDFS RENAME boolean=false "
-                                       "(operation did not happen)")
-                return {"boolean": False}
-            return orig(method, p, op, **kw)
+    def test_touch_race_classified_structurally(self):
+        """A CREATE losing the check-then-create race returns 403
+        FileAlreadyExistsException; exist_ok=True must treat THAT as
+        success while other errors still raise."""
 
-        client._rest = fake_rest
-        client.mkdirs if False else None
-        with pytest.raises(RuntimeError, match="RENAME"):
-            client.mv("/nope/a", "/nope/b", test_exists=False)
-        client._rest = orig
+        class RacyCreate(_Handler):
+            def do_GET(self):
+                p, op, _q = self._path_op()
+                if op == "GETFILESTATUS":
+                    self._json(404, {"RemoteException": {
+                        "exception": "FileNotFoundException"}})
+                    return
+                super().do_GET()
+
+            def do_PUT(self):
+                p, op, q = self._path_op()
+                if op == "CREATE":
+                    self._json(403, {"RemoteException": {
+                        "exception": "FileAlreadyExistsException"}})
+                    return
+                super().do_PUT()
+
+        fs = _MockHDFS()
+        handler = type("H", (RacyCreate,), {"fs": fs})
+        srv = HTTPServer(("127.0.0.1", 0), handler)
+        handler.redirect_port = srv.server_port
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            client = HDFSClient(configs={
+                "webhdfs_url": f"http://127.0.0.1:{srv.server_port}"})
+            client.touch("/race/flag", exist_ok=True)   # race -> success
+            with pytest.raises(RuntimeError):
+                client.touch("/race/flag", exist_ok=False)
+        finally:
+            srv.shutdown()
+
+    def test_gateway_direct_create_still_sends_body(self, tmp_path):
+        """HttpFS/Knox-style gateways consume CREATE without a 307: the
+        client must then resend WITH the body instead of leaving a 0-byte
+        file."""
+
+        class DirectCreate(_Handler):
+            def do_PUT(self):
+                p, op, q = self._path_op()
+                if op == "CREATE":
+                    ln = int(self.headers.get("Content-Length") or 0)
+                    data = self.rfile.read(ln) if ln else b""
+                    prev = self.fs.tree.get(p)
+                    # keep the LONGEST body seen (empty first leg, then
+                    # the resend with bytes)
+                    if prev is None or len(data) >= len(prev or b""):
+                        self.fs.tree[p] = data
+                    self._json(201, {})
+                    return
+                super().do_PUT()
+
+        fs = _MockHDFS()
+        fs.tree["/g"] = None
+        handler = type("H", (DirectCreate,), {"fs": fs})
+        srv = HTTPServer(("127.0.0.1", 0), handler)
+        handler.redirect_port = srv.server_port
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            client = HDFSClient(configs={
+                "webhdfs_url": f"http://127.0.0.1:{srv.server_port}"})
+            src = tmp_path / "ck"
+            src.write_bytes(b"checkpoint-bytes")
+            client.upload(str(src), "/g/ck")
+            assert fs.tree["/g/ck"] == b"checkpoint-bytes"
+        finally:
+            srv.shutdown()
 
     def test_upload_first_put_has_no_body(self, webhdfs, tmp_path):
         """Spec two-step: the namenode PUT must be body-free; the data
