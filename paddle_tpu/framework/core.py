@@ -446,7 +446,17 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
     )
 
     if needs_grad:
-        f = functools.partial(fn, **attrs) if attrs else fn
+        f_raw = functools.partial(fn, **attrs) if attrs else fn
+
+        # normalize multi-output structure to a PLAIN tuple before vjp:
+        # ops built on jnp.linalg (svd/qr/eigh) return NamedTuples, and a
+        # vjp built on that structure rejects the plain-tuple cotangents
+        # the backward walk supplies (found by the decomposition grad
+        # sweep)
+        def f(*a, _f=f_raw):
+            o = _f(*a)
+            return tuple(o) if isinstance(o, (tuple, list)) else o
+
         out, vjp_fn = jax.vjp(f, *arrays)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)

@@ -834,3 +834,73 @@ class TestSequenceGrads:
             x, paddle.to_tensor(np.array([0, 1], np.int64)),
             paddle.to_tensor(np.array([2, 1], np.int64)))[0],
             [self._x()], rtol=2e-2, atol=2e-3)
+
+
+class TestRecurrentGrads:
+    """RNN-family grads through the tape (reference test_rnn_op /
+    test_lstm_op grad checks)."""
+
+    def _x(self):
+        return _any(2, 3, 4) * 0.5
+
+    def test_lstm_input_grad(self):
+        paddle.seed(41)
+        lstm = paddle.nn.LSTM(4, 5)
+        check_grad(lambda t: lstm(t)[0], [self._x()], rtol=3e-2, atol=3e-3)
+
+    def test_gru_input_grad(self):
+        paddle.seed(42)
+        gru = paddle.nn.GRU(4, 5)
+        check_grad(lambda t: gru(t)[0], [self._x()], rtol=3e-2, atol=3e-3)
+
+    def test_simple_rnn_input_grad(self):
+        paddle.seed(43)
+        rnn = paddle.nn.SimpleRNN(4, 5)
+        check_grad(lambda t: rnn(t)[0], [self._x()], rtol=3e-2, atol=3e-3)
+
+    def test_bidirectional_lstm_input_grad(self):
+        paddle.seed(44)
+        lstm = paddle.nn.LSTM(4, 5, direction="bidirect")
+        check_grad(lambda t: lstm(t)[0], [self._x()], rtol=3e-2, atol=3e-3)
+
+    def test_lstm_cell_grads(self):
+        paddle.seed(45)
+        cell = paddle.nn.LSTMCell(4, 5)
+        x = _any(2, 4) * 0.5
+        check_grad(lambda t: cell(t)[0], [x], rtol=3e-2, atol=3e-3)
+
+    def test_gru_cell_grads(self):
+        paddle.seed(46)
+        cell = paddle.nn.GRUCell(4, 5)
+        x = _any(2, 4) * 0.5
+        check_grad(lambda t: cell(t)[0], [x], rtol=3e-2, atol=3e-3)
+
+
+class TestDecompositionGrads:
+    """Matrix-decomposition grads (reference test_svd_op/test_eigh_op/
+    test_qr_op check_grad; degenerate spectra avoided so the analytic
+    formulas are well-defined)."""
+
+    def test_svd_singular_values_grad(self):
+        x = (np.diag([3.0, 2.0, 1.0]) + 0.1 * _any(3, 3)).astype(np.float32)
+        check_grad(lambda t: paddle.linalg.svd(t)[1].sum(), [x],
+                   rtol=3e-2, atol=3e-3)
+
+    def test_eigh_eigenvalues_grad(self):
+        x = _any(3, 3)
+
+        def f(t):
+            a = t + t.t() + paddle.to_tensor(
+                np.diag([3.0, 6.0, 9.0]).astype(np.float32))
+            return paddle.linalg.eigh(a)[0].sum()
+
+        check_grad(f, [x], rtol=3e-2, atol=3e-3)
+
+    def test_qr_r_grad(self):
+        x = (np.eye(3) * 2 + 0.3 * _any(3, 3)).astype(np.float32)
+
+        def f(t):
+            _q, r = paddle.linalg.qr(t)
+            return (r * r).sum()
+
+        check_grad(f, [x], rtol=3e-2, atol=3e-3)
