@@ -4,6 +4,18 @@ Uniform/Normal/Categorical with sample/log_prob/probs/entropy/kl_divergence.
 Sampling draws keys from the global framework PRNG (framework/random.py) so
 ``paddle.seed`` governs reproducibility, mirroring the reference's use of
 the global generator.
+
+DIFFERENTIABLE: every density/statistic routes through ``apply_op`` with
+the constructor's parameter Tensors as live inputs, so log_prob/entropy/kl
+participate in the autograd tape (the reference builds these from regular
+ops for the same reason — policy-gradient and VAE losses must train
+through them). ``sample`` additionally keeps the reparameterization path
+live for Uniform/Normal: loc + z * scale with z a constant draw.
+
+The op bodies are MODULE-LEVEL functions taking the evaluation point as a
+positional argument (not per-call closures): apply_op's eager jit cache
+keys on function identity, so closures would recompile and leak one cache
+entry per call.
 """
 from __future__ import annotations
 
@@ -13,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .framework.core import Tensor
+from .framework.core import Tensor, apply_op
 from .framework.random import next_key
 
 __all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
@@ -23,6 +35,11 @@ def _arr(v):
     if isinstance(v, Tensor):
         return v._data
     return jnp.asarray(v, jnp.float32)
+
+
+def _keep(v):
+    """Keep a Tensor (tape-live) as is; wrap raw values."""
+    return v if isinstance(v, Tensor) else Tensor(_arr(v))
 
 
 class Distribution:
@@ -44,69 +61,147 @@ class Distribution:
         raise NotImplementedError
 
 
+# -- uniform op bodies ------------------------------------------------------
+
+def _uniform_sample_op(lo, hi, u):
+    return lo + u * (hi - lo)
+
+
+def _uniform_log_prob_op(lo, hi, v):
+    inside = (v >= lo) & (v < hi)
+    return jnp.log(jnp.where(inside, 1.0 / (hi - lo), 0.0))
+
+
+def _uniform_probs_op(lo, hi, v):
+    inside = (v >= lo) & (v < hi)
+    return jnp.where(inside, 1.0 / (hi - lo), 0.0)
+
+
+def _uniform_entropy_op(lo, hi):
+    return jnp.log(hi - lo)
+
+
 class Uniform(Distribution):
     """U[low, high) (reference distribution.py:169)."""
 
     def __init__(self, low, high, name=None):
-        self.low = _arr(low)
-        self.high = _arr(high)
+        self.low = _keep(low)
+        self.high = _keep(high)
 
     def sample(self, shape, seed=0):
         key = jax.random.PRNGKey(seed) if seed else next_key()
         shape = tuple(int(s) for s in shape) + jnp.broadcast_shapes(
-            self.low.shape, self.high.shape)
+            self.low._data.shape, self.high._data.shape)
         u = jax.random.uniform(key, shape, jnp.float32)
-        return Tensor(self.low + u * (self.high - self.low))
+        # reparameterized: low + u * (high - low) stays on the tape
+        return apply_op(_uniform_sample_op, self.low, self.high, u,
+                        op_name="uniform_sample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        inside = (v >= self.low) & (v < self.high)
-        dens = jnp.where(inside, 1.0 / (self.high - self.low), 0.0)
-        return Tensor(jnp.log(dens))
+        return apply_op(_uniform_log_prob_op, self.low, self.high,
+                        _arr(value), op_name="uniform_log_prob")
 
     def probs(self, value):
-        v = _arr(value)
-        inside = (v >= self.low) & (v < self.high)
-        return Tensor(jnp.where(inside, 1.0 / (self.high - self.low), 0.0))
+        return apply_op(_uniform_probs_op, self.low, self.high,
+                        _arr(value), op_name="uniform_probs")
 
     def entropy(self):
-        return Tensor(jnp.log(self.high - self.low))
+        return apply_op(_uniform_entropy_op, self.low, self.high,
+                        op_name="uniform_entropy")
+
+
+# -- normal op bodies -------------------------------------------------------
+
+def _normal_sample_op(lo, sc, z):
+    return lo + z * sc
+
+
+def _normal_log_prob_op(lo, sc, v):
+    return (-((v - lo) ** 2) / (2 * sc ** 2) - jnp.log(sc)
+            - 0.5 * math.log(2 * math.pi))
+
+
+def _normal_probs_op(lo, sc, v):
+    return jnp.exp(-((v - lo) ** 2) / (2 * sc ** 2)) \
+        / (sc * math.sqrt(2 * math.pi))
+
+
+def _normal_entropy_op(lo, sc):
+    return (0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(sc)
+            + jnp.zeros_like(lo))
+
+
+def _normal_kl_op(lo, sc, olo, osc):
+    var_ratio = (sc / osc) ** 2
+    t1 = ((lo - olo) / osc) ** 2
+    return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
 
 
 class Normal(Distribution):
     """N(loc, scale^2) (reference distribution.py:391)."""
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self.loc = _keep(loc)
+        self.scale = _keep(scale)
 
     def sample(self, shape, seed=0):
         key = jax.random.PRNGKey(seed) if seed else next_key()
         shape = tuple(int(s) for s in shape) + jnp.broadcast_shapes(
-            self.loc.shape, self.scale.shape)
+            self.loc._data.shape, self.scale._data.shape)
         z = jax.random.normal(key, shape, jnp.float32)
-        return Tensor(self.loc + z * self.scale)
+        # reparameterization trick: grads flow to loc/scale through z
+        return apply_op(_normal_sample_op, self.loc, self.scale, z,
+                        op_name="normal_sample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        var = self.scale ** 2
-        return Tensor(-((v - self.loc) ** 2) / (2 * var)
-                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return apply_op(_normal_log_prob_op, self.loc, self.scale,
+                        _arr(value), op_name="normal_log_prob")
 
     def probs(self, value):
-        return Tensor(jnp.exp(self.log_prob(value)._data))
+        return apply_op(_normal_probs_op, self.loc, self.scale,
+                        _arr(value), op_name="normal_probs")
 
     def entropy(self):
-        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
-                      + jnp.log(self.scale)
-                      + jnp.zeros_like(self.loc))
+        return apply_op(_normal_entropy_op, self.loc, self.scale,
+                        op_name="normal_entropy")
 
     def kl_divergence(self, other):
         if not isinstance(other, Normal):
             raise TypeError("kl_divergence expects another Normal")
-        var_ratio = (self.scale / other.scale) ** 2
-        t1 = ((self.loc - other.loc) / other.scale) ** 2
-        return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)))
+        return apply_op(_normal_kl_op, self.loc, self.scale, other.loc,
+                        other.scale, op_name="normal_kl")
+
+
+# -- categorical op bodies --------------------------------------------------
+
+def _gather_cat(p, v):
+    if p.ndim == 1:
+        return p[v]
+    if v.ndim == p.ndim - 1:
+        # per-row category index (batched logits): gather one per row
+        return jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0]
+    return jnp.take_along_axis(p, v, axis=-1)
+
+
+def _categorical_probs_op(lg, v):
+    return _gather_cat(jax.nn.softmax(lg, axis=-1), v)
+
+
+def _categorical_log_prob_op(lg, v):
+    # log-softmax gather (NOT log of the gathered prob): numerically
+    # stable and differentiable at small probabilities
+    return _gather_cat(jax.nn.log_softmax(lg, axis=-1), v)
+
+
+def _categorical_entropy_op(lg):
+    p = jax.nn.softmax(lg, axis=-1)
+    return -jnp.sum(p * jnp.log(p), axis=-1)
+
+
+def _categorical_kl_op(lg, olg):
+    p = jax.nn.softmax(lg, axis=-1)
+    return jnp.sum(p * (jax.nn.log_softmax(lg, axis=-1)
+                        - jax.nn.log_softmax(olg, axis=-1)), axis=-1)
 
 
 class Categorical(Distribution):
@@ -114,43 +209,35 @@ class Categorical(Distribution):
     which softmax-normalizes: prob = exp(logits - max) / sum)."""
 
     def __init__(self, logits, name=None):
-        self.logits = _arr(logits)
-
-    def _p(self):
-        z = self.logits - jnp.max(self.logits, axis=-1, keepdims=True)
-        e = jnp.exp(z)
-        return e / jnp.sum(e, axis=-1, keepdims=True)
+        self.logits = _keep(logits)
 
     def sample(self, shape):
         key = next_key()
-        p = self._p()
+        lg = self.logits._data
         shape = tuple(int(s) for s in shape)
-        idx = jax.random.categorical(key, jnp.log(p),
-                                     shape=shape + p.shape[:-1])
+        # jax.random.categorical takes unnormalized logits directly — no
+        # softmax/log round-trip (which underflows for extreme gaps)
+        idx = jax.random.categorical(key, lg, shape=shape + lg.shape[:-1])
         # leave the native integer dtype: an int64 astype under the default
         # x64-disabled config only emits a truncation warning
         return Tensor(idx)
 
     def probs(self, value):
-        p = self._p()
-        v = _arr(value).astype(jnp.int32)
-        if p.ndim == 1:
-            return Tensor(p[v])
-        if v.ndim == p.ndim - 1:
-            # per-row category index (batched logits): gather one per row
-            return Tensor(jnp.take_along_axis(p, v[..., None],
-                                              axis=-1)[..., 0])
-        return Tensor(jnp.take_along_axis(p, v, axis=-1))
+        return apply_op(_categorical_probs_op, self.logits,
+                        _arr(value).astype(jnp.int32),
+                        op_name="categorical_probs")
 
     def log_prob(self, value):
-        return Tensor(jnp.log(self.probs(value)._data))
+        return apply_op(_categorical_log_prob_op, self.logits,
+                        _arr(value).astype(jnp.int32),
+                        op_name="categorical_log_prob")
 
     def entropy(self):
-        p = self._p()
-        return Tensor(-jnp.sum(p * jnp.log(p), axis=-1))
+        return apply_op(_categorical_entropy_op, self.logits,
+                        op_name="categorical_entropy")
 
     def kl_divergence(self, other):
         if not isinstance(other, Categorical):
             raise TypeError("kl_divergence expects another Categorical")
-        p, q = self._p(), other._p()
-        return Tensor(jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1))
+        return apply_op(_categorical_kl_op, self.logits, other.logits,
+                        op_name="categorical_kl")

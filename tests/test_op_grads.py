@@ -904,3 +904,56 @@ class TestDecompositionGrads:
             return (r * r).sum()
 
         check_grad(f, [x], rtol=3e-2, atol=3e-3)
+
+
+class TestDistributionGrads:
+    """log_prob/entropy grads for the distribution family (reference
+    test_distribution.py exercises Normal/Uniform/Categorical)."""
+
+    def test_normal_log_prob_grads(self):
+        loc = _any(3)
+        scale = _pos(3)
+
+        def f(lo, sc):
+            import paddle_tpu.distribution as D
+
+            d = D.Normal(lo, sc)
+            return d.log_prob(paddle.to_tensor(
+                np.array([0.3, -0.2, 0.9], np.float32))).sum()
+
+        check_grad(f, [loc, scale], rtol=2e-2, atol=2e-3)
+
+    def test_normal_entropy_grad(self):
+        scale = _pos(3)
+
+        def f(sc):
+            import paddle_tpu.distribution as D
+
+            return D.Normal(paddle.to_tensor(
+                np.zeros(3, np.float32)), sc).entropy().sum()
+
+        check_grad(f, [scale], rtol=2e-2, atol=2e-3)
+
+    def test_categorical_log_prob_grad(self):
+        logits = _any(4)
+
+        def f(lg):
+            import paddle_tpu.distribution as D
+
+            d = D.Categorical(lg)
+            return d.log_prob(paddle.to_tensor(
+                np.array([0, 2, 3], np.int64))).sum()
+
+        check_grad(f, [logits], rtol=2e-2, atol=2e-3)
+
+    def test_uniform_log_prob_grad(self):
+        low = _any(3) - 3.0
+        high = _any(3) + 3.0
+
+        def f(lo, hi):
+            import paddle_tpu.distribution as D
+
+            return D.Uniform(lo, hi).log_prob(paddle.to_tensor(
+                np.zeros(3, np.float32))).sum()
+
+        check_grad(f, [low, high], rtol=2e-2, atol=2e-3)
