@@ -86,6 +86,7 @@ from . import fluid  # noqa: F401
 # absolute import always loads paddle_tpu/linalg.py and rebinds the attr.
 import paddle_tpu.linalg  # noqa: F401,E402
 from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 

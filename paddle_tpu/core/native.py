@@ -89,10 +89,21 @@ _py_flags_lock = threading.Lock()
 # operator.cc:1199); a list so importers share the mutable cell.
 check_nan_inf = [False]
 
+# Fast-path mirror of FLAGS_benchmark (reference imperative/flags.cc):
+# while on, apply_op accumulates per-op wall time into
+# paddle_tpu.monitor.benchmark.
+benchmark = [False]
+
+
+def _truthy(value) -> bool:
+    return str(value).lower() in ("1", "true", "yes", "on")
+
 
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
-        check_nan_inf[0] = str(value).lower() in ("1", "true", "yes", "on")
+        check_nan_inf[0] = _truthy(value)
+    elif name.endswith("benchmark"):
+        benchmark[0] = _truthy(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

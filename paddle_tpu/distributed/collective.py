@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..framework.core import Tensor
+from ..monitor import stats as _mstats
 from . import env
 
 __all__ = [
@@ -100,6 +101,13 @@ def new_group(ranks=None, backend=None, axis_name=None):
 def _axis_in_trace(x) -> bool:
     """True if x is a tracer inside shard_map (axis names bound)."""
     return isinstance(x, jax.core.Tracer)
+
+
+def _count(opname: str) -> None:
+    """Collective launch counters (monitor.h STAT_ADD analog): the
+    aggregate ``collective_calls`` plus a per-op ``collective_<name>``."""
+    _mstats.COLLECTIVE_CALLS.add()
+    _mstats.stat_add("collective_" + opname)
 
 
 def _axis_name(group: Optional[Group]):
@@ -265,6 +273,7 @@ def _multi_process() -> bool:
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=True):
+    _count("all_reduce")
     arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
@@ -277,6 +286,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    _count("reduce")
     arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         axis = _axis_name(group)
@@ -294,6 +304,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    _count("broadcast")
     arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         axis = _axis_name(group)
@@ -309,6 +320,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    _count("all_gather")
     arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         ax = _axis_name(group)
@@ -342,6 +354,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _count("scatter")
     if tensor_list is None or not len(tensor_list):
         raise ValueError("distributed.scatter needs tensor_list on src")
     arrs = [_unwrap(t) for t in tensor_list]
@@ -364,6 +377,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    _count("alltoall")
     arrs = [_unwrap(t) for t in in_tensor_list]
     if arrs and _axis_in_trace(arrs[0]):
         ax = _axis_name(group)
@@ -390,6 +404,7 @@ def sendrecv(tensor, perm, group=None):
     pairs — the mesh-native form of the reference's send_v2/recv_v2 pair
     (operators/collective/send_v2_op.cc). Works in-trace and eagerly
     (rank-major layout)."""
+    _count("sendrecv")
     arr = _unwrap(tensor)
     perm = tuple((int(s), int(d)) for s, d in perm)
     if _axis_in_trace(arr):
@@ -400,6 +415,7 @@ def sendrecv(tensor, perm, group=None):
 def send(tensor, dst=0, group=None, sync_op=True, src=None):
     """P2P send. In SPMD every device runs the same program, so the
     (src, dst) pair must be explicit: pass src= or use sendrecv()."""
+    _count("send")
     arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         if src is None:
@@ -419,6 +435,7 @@ def send(tensor, dst=0, group=None, sync_op=True, src=None):
 
 def recv(tensor, src=0, group=None, sync_op=True, dst=None):
     """P2P recv — the receiving half of sendrecv. See send()."""
+    _count("recv")
     arr = _unwrap(tensor)
     if _axis_in_trace(arr):
         if dst is None:
@@ -435,6 +452,7 @@ def recv(tensor, src=0, group=None, sync_op=True, dst=None):
 
 
 def barrier(group=None):
+    _count("barrier")
     if _multi_process():
         from jax.experimental import multihost_utils
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -389,8 +390,12 @@ def _jitted(fn, attrs):
         return None
     j = _jit_cache.get(key)
     if j is None:
+        _mstats.JIT_CACHE_MISS.add()
+        _mstats.JIT_COMPILE.add()
         j = jax.jit(functools.partial(fn, **attrs))
         _jit_cache[key] = j
+    else:
+        _mstats.JIT_CACHE_HIT.add()
     return j
 
 
@@ -408,12 +413,21 @@ def set_symbolic_dispatch(fn):
 # CheckOpHasNanOrInf after every kernel run). The shared cell lives in
 # core.native so `paddle.set_flags({"FLAGS_check_nan_inf": 1})` flips it.
 from ..core.native import check_nan_inf as _nan_check  # noqa: E402
+from ..core.native import benchmark as _benchmark  # noqa: E402
+# Observability hooks (paddle_tpu.monitor): stat handles are pre-created
+# module attributes so the idle dispatch path pays one counter add; span
+# timing and FLAGS_benchmark accumulation are gated on shared cells.
+from ..monitor import stats as _mstats  # noqa: E402
+from ..monitor.benchmark import record_op as _bench_record  # noqa: E402
+from ..monitor.trace import TRACING as _TRACING  # noqa: E402
+from ..monitor.trace import get_writer as _trace_writer  # noqa: E402
 
 
 def _check_finite(op_name, outs):
     for i, o in enumerate(outs):
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
             if not bool(jnp.isfinite(o).all()):
+                _mstats.NAN_INF_TRIPS.add()
                 raise FloatingPointError(
                     f"FLAGS_check_nan_inf: output {i} of op '{op_name}' "
                     "contains NaN/Inf")
@@ -427,12 +441,34 @@ def apply_op(fn: Callable, *args, op_name: Optional[str] = None, **attrs):
     Tensors mirroring fn's output structure). When static mode has
     installed a symbolic dispatcher and an arg is symbolic, the op is
     recorded into the active Program instead of executed.
+
+    Instrumentation (paddle_tpu.monitor): every eager dispatch bumps the
+    ``op_dispatch`` stat; while tracing is on each dispatch lands as a
+    chrome-trace span, and while FLAGS_benchmark is set its wall time is
+    accumulated per op. With both off the extra cost is the counter add —
+    no span objects, no clock reads.
     """
     hook = _symbolic_dispatch_hook[0]
     if hook is not None:
         res = hook(fn, args, attrs, op_name)
         if res is not NotImplemented:
             return res
+    _mstats.OP_DISPATCH.add()
+    if _benchmark[0] or _TRACING[0]:
+        name = op_name or getattr(fn, "__name__", "op")
+        t0 = time.perf_counter()
+        try:
+            return _apply_op_eager(fn, args, attrs, op_name)
+        finally:
+            dt = time.perf_counter() - t0
+            if _benchmark[0]:
+                _bench_record(name, dt)
+            if _TRACING[0]:
+                _trace_writer().add_complete(name, t0, dt)
+    return _apply_op_eager(fn, args, attrs, op_name)
+
+
+def _apply_op_eager(fn, args, attrs, op_name):
     arrays = tuple(_unwrap(a) for a in args)
     tracing = any(_is_tracer(a) for a in arrays)
     input_tensors = tuple(a if isinstance(a, Tensor) else None for a in args)

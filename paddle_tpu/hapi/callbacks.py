@@ -7,7 +7,8 @@ import os
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "VisualDL", "config_callbacks", "CallbackList"]
+           "LRScheduler", "VisualDL", "Monitor", "config_callbacks",
+           "CallbackList"]
 
 
 class Callback:
@@ -202,6 +203,39 @@ class LRScheduler(Callback):
                 s.step()
 
 
+class Monitor(Callback):
+    """Per-step training telemetry (paddle_tpu.monitor.TrainerMonitor
+    bridge): injects step_time_s / examples_per_sec / recompiles into the
+    step logs, so ProgBarLogger prints them and VisualDL persists them.
+    config_callbacks orders Monitor first so the telemetry lands in the
+    logs dict before the loggers read it.
+    """
+
+    def __init__(self):
+        super().__init__()
+        from ..monitor import TrainerMonitor
+
+        self.telemetry = TrainerMonitor()
+
+    def on_train_begin(self, logs=None):
+        self.telemetry.reset()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self.telemetry.step_begin()
+
+    def on_train_batch_end(self, step, logs=None):
+        tele = self.telemetry.step_end(
+            examples=self.params.get("batch_size"))
+        if logs is not None and tele:
+            logs["step_time_s"] = tele["step_time_s"]
+            logs["recompiles"] = tele["recompiles"]
+            if "examples_per_sec" in tele:
+                logs["examples_per_sec"] = tele["examples_per_sec"]
+
+    def summary(self):
+        return self.telemetry.summary()
+
+
 class VisualDL(Callback):
     """Scalar logging to a simple CSV (visualdl not in env)."""
 
@@ -231,6 +265,10 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks = list(cbks) + [LRScheduler()]
+    # telemetry must run before the loggers that read its log entries
+    mons = [c for c in cbks if isinstance(c, Monitor)]
+    if mons:
+        cbks = mons + [c for c in cbks if not isinstance(c, Monitor)]
     cbk_list = CallbackList(cbks)
     cbk_list.set_model(model)
     params = {

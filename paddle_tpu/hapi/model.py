@@ -19,6 +19,7 @@ import numpy as np
 from ..framework.core import Tensor, backward
 from ..io import DataLoader
 from ..metric import Metric
+from ..monitor.trace import span as _trace_span
 from ..nn.layer.layers import Layer
 from . import callbacks as cbks_mod
 
@@ -121,6 +122,10 @@ class Model:
         return TrainStep(self.network, loss_fn, self._optimizer)
 
     def train_batch(self, inputs, labels=None, update=True):
+        with _trace_span("Model.train_batch", cat="step"):
+            return self._train_batch_impl(inputs, labels, update)
+
+    def _train_batch_impl(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)

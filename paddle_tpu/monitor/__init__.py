@@ -1,0 +1,53 @@
+"""paddle_tpu.monitor — observability subsystem.
+
+Reference mapping:
+- stat gauges           → paddle/fluid/platform/monitor.h (StatRegistry,
+                          STAT_ADD/STAT_INT64 macros)
+- chrome-trace export   → paddle/fluid/platform/profiler.cc (DeviceTracer
+                          chrome://tracing JSON dump)
+- FLAGS_benchmark       → paddle/fluid/imperative/flags.cc per-op timing
+- TrainerMonitor        → per-step telemetry feeding hapi callbacks
+                          (callbacks.py Monitor) and tools/scaling_report
+
+Layering: this package depends only on the stdlib and core.native (the
+flag cells), so the hot paths (framework.core, distributed.collective,
+parallel.train_step) can import it without cycles. Everything is
+opt-out-by-default: with tracing off and FLAGS_benchmark=0 the only cost
+in the dispatch path is counter increments.
+"""
+from .stats import (
+    DEFAULT_STATS,
+    Stat,
+    StatRegistry,
+    reset_all_stats,
+    stat_add,
+    stat_get,
+    stat_names,
+    stat_reset,
+    stat_snapshot,
+    update_memory_stats,
+)
+from .trace import (
+    TraceWriter,
+    get_writer,
+    is_tracing,
+    span,
+    start_tracing,
+    stop_tracing,
+)
+from .benchmark import (
+    benchmark_reset,
+    benchmark_rows,
+    benchmark_summary,
+)
+from .trainer import TrainerMonitor
+
+__all__ = [
+    "Stat", "StatRegistry", "DEFAULT_STATS",
+    "stat_add", "stat_get", "stat_reset", "stat_names", "stat_snapshot",
+    "reset_all_stats", "update_memory_stats",
+    "TraceWriter", "get_writer", "is_tracing", "span",
+    "start_tracing", "stop_tracing",
+    "benchmark_reset", "benchmark_rows", "benchmark_summary",
+    "TrainerMonitor",
+]

@@ -1,0 +1,71 @@
+"""FLAGS_benchmark per-op wall-time accumulation (reference
+imperative/flags.cc FLAGS_benchmark + the per-op timing dump the tracer
+prints when it is set).
+
+`paddle.set_flags({"FLAGS_benchmark": 1})` flips the shared cell in
+core.native; while it is on, `apply_op` feeds every eager dispatch's wall
+time into :func:`record_op`. The table is host-side and cumulative until
+:func:`benchmark_reset`.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..core.native import benchmark as _benchmark_flag
+
+__all__ = ["enabled", "record_op", "benchmark_rows", "benchmark_summary",
+           "benchmark_reset"]
+
+_lock = threading.Lock()
+# name -> [calls, total_s, max_s, min_s]
+_records: dict[str, list] = {}
+
+
+def enabled() -> bool:
+    return _benchmark_flag[0]
+
+
+def record_op(name: str, seconds: float) -> None:
+    with _lock:
+        r = _records.get(name)
+        if r is None:
+            _records[name] = [1, seconds, seconds, seconds]
+        else:
+            r[0] += 1
+            r[1] += seconds
+            if seconds > r[2]:
+                r[2] = seconds
+            if seconds < r[3]:
+                r[3] = seconds
+
+
+def benchmark_rows() -> list:
+    """Per-op rows sorted by total time, descending."""
+    with _lock:
+        rows = [
+            {"op": n, "calls": r[0], "total": r[1], "avg": r[1] / r[0],
+             "max": r[2], "min": r[3]}
+            for n, r in _records.items()
+        ]
+    rows.sort(key=lambda r: -r["total"])
+    return rows
+
+
+def benchmark_summary(file=None) -> list:
+    """Print the per-op wall-time table (FLAGS_benchmark dump analog);
+    returns the rows."""
+    rows = benchmark_rows()
+    if rows:
+        hdr = (f"{'Op':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"
+               f"{'Max(s)':>12}{'Min(s)':>12}")
+        print(hdr, file=file)
+        for r in rows:
+            print(f"{r['op']:<40}{r['calls']:>8}{r['total']:>12.6f}"
+                  f"{r['avg']:>12.6f}{r['max']:>12.6f}{r['min']:>12.6f}",
+                  file=file)
+    return rows
+
+
+def benchmark_reset() -> None:
+    with _lock:
+        _records.clear()

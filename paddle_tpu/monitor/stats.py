@@ -1,0 +1,200 @@
+"""Stat gauges (reference paddle/fluid/platform/monitor.h StatRegistry,
+STAT_ADD/STAT_RESET macros).
+
+A `Stat` is a named int64 gauge; the `StatRegistry` is the process-wide
+thread-safe singleton holding them. Hot paths (framework.core.apply_op,
+distributed collectives) hold module-level references to their pre-created
+Stat objects so an increment is one lock + one add — no dict lookup, no
+allocation, matching the reference's `STAT_INT64(name); STAT_ADD(...)`
+static-registration idiom.
+
+Stats live host-side only (they count host-visible events: dispatches,
+compiles, cache hits, collective launches, NaN trips); device-side memory
+gauges are filled on demand by :func:`update_memory_stats`.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "Stat", "StatRegistry", "stat_add", "stat_get", "stat_reset",
+    "stat_names", "stat_snapshot", "reset_all_stats", "update_memory_stats",
+    "DEFAULT_STATS",
+]
+
+
+class Stat:
+    """One named int64 counter/gauge (reference monitor.h StatValue)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, delta: int = 1) -> None:
+        with self._lock:
+            self._value += delta
+
+    # reference StatValue::increase/decrease
+    increase = add
+
+    def decrease(self, delta: int = 1) -> None:
+        self.add(-delta)
+
+    def set(self, value: int) -> None:
+        with self._lock:
+            self._value = int(value)
+
+    def get(self) -> int:
+        return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def __repr__(self):
+        return f"Stat({self.name}={self._value})"
+
+
+class StatRegistry:
+    """Thread-safe singleton registry of Stats (monitor.h StatRegistry)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self._stats: dict[str, Stat] = {}
+        self._lock = threading.Lock()
+
+    def get_stat(self, name: str) -> Stat:
+        s = self._stats.get(name)
+        if s is None:
+            with self._lock:
+                s = self._stats.setdefault(name, Stat(name))
+        return s
+
+    def add(self, name: str, delta: int = 1) -> None:
+        self.get_stat(name).add(delta)
+
+    def get(self, name: str) -> int:
+        return self.get_stat(name).get()
+
+    def reset(self, name: str) -> None:
+        self.get_stat(name).reset()
+
+    def reset_all(self) -> None:
+        with self._lock:
+            for s in self._stats.values():
+                s.reset()
+
+    def names(self):
+        with self._lock:
+            return sorted(self._stats)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {n: s.get() for n, s in sorted(self._stats.items())}
+
+
+_registry = StatRegistry.instance()
+
+
+def stat_add(name: str, delta: int = 1) -> None:
+    _registry.add(name, delta)
+
+
+def stat_get(name: str) -> int:
+    return _registry.get(name)
+
+
+def stat_reset(name: str) -> None:
+    _registry.reset(name)
+
+
+def stat_names():
+    return _registry.names()
+
+
+def stat_snapshot() -> dict:
+    return _registry.snapshot()
+
+
+def reset_all_stats() -> None:
+    _registry.reset_all()
+
+
+# -- pre-registered stats (the subsystem's standing dashboard) --------------
+#
+# Hot paths import these module-level handles directly; everything else
+# reads them by name through stat_get.
+
+DEFAULT_STATS = (
+    "op_dispatch",        # apply_op eager dispatches
+    "jit_cache_hit",      # op-level jit cache hits (PreparedOp-cache analog)
+    "jit_cache_miss",     # op-level jit cache misses
+    "jit_compile",        # new jax.jit wrappers built (one per miss)
+    "collective_calls",   # distributed.* collective API launches
+    "train_steps",        # compiled/eager training steps completed
+    "nan_inf_trips",      # FLAGS_check_nan_inf violations raised
+    "host_memory_bytes",  # gauge: peak host RSS (update_memory_stats)
+    "device_memory_bytes",  # gauge: device bytes in use (update_memory_stats)
+)
+
+for _n in DEFAULT_STATS:
+    _registry.get_stat(_n)
+
+OP_DISPATCH = _registry.get_stat("op_dispatch")
+JIT_CACHE_HIT = _registry.get_stat("jit_cache_hit")
+JIT_CACHE_MISS = _registry.get_stat("jit_cache_miss")
+JIT_COMPILE = _registry.get_stat("jit_compile")
+COLLECTIVE_CALLS = _registry.get_stat("collective_calls")
+TRAIN_STEPS = _registry.get_stat("train_steps")
+NAN_INF_TRIPS = _registry.get_stat("nan_inf_trips")
+HOST_MEMORY_BYTES = _registry.get_stat("host_memory_bytes")
+DEVICE_MEMORY_BYTES = _registry.get_stat("device_memory_bytes")
+
+
+def update_memory_stats() -> dict:
+    """Refresh the host/device memory gauges and return {name: bytes}.
+
+    Host side reads the process peak RSS; device side sums
+    ``bytes_in_use`` over visible jax devices (not every backend reports
+    memory_stats — missing values leave the gauge unchanged).
+    """
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        HOST_MEMORY_BYTES.set(int(rss_kb) * 1024)
+    except Exception:
+        pass
+    try:
+        import jax
+
+        total = 0
+        seen = False
+        for d in jax.devices():
+            ms = getattr(d, "memory_stats", None)
+            if ms is None:
+                continue
+            try:
+                total += int((ms() or {}).get("bytes_in_use", 0))
+                seen = True
+            except Exception:
+                continue
+        if seen:
+            DEVICE_MEMORY_BYTES.set(total)
+    except Exception:
+        pass
+    return {"host_memory_bytes": HOST_MEMORY_BYTES.get(),
+            "device_memory_bytes": DEVICE_MEMORY_BYTES.get()}

@@ -1,0 +1,150 @@
+"""Chrome-trace-event JSON exporter (reference platform/profiler.cc
+GenEventKernelCudaElapsedTime / DeviceTracer dump → chrome://tracing).
+
+`TraceWriter` accumulates trace events host-side and serializes the
+chrome trace-event format (the `{"traceEvents": [...]}` envelope) that
+Perfetto / chrome://tracing / `tools/trace_report.py` load directly —
+independent of jax.profiler's TensorBoard plugin, so it works on any
+backend.
+
+The module-level writer plus the `TRACING` gate are the recording
+switch the hot paths check: `apply_op` and `RecordEvent` test
+``TRACING[0]`` (one list index) before paying for any span bookkeeping,
+so an idle process records nothing and allocates nothing.
+
+Timestamps are `time.perf_counter()` seconds converted to the format's
+microseconds — one monotonic clock for every producer keeps spans from
+different layers aligned on the same timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["TraceWriter", "TRACING", "is_tracing", "start_tracing",
+           "stop_tracing", "get_writer", "span"]
+
+# shared mutable gate — hot paths read TRACING[0] directly
+TRACING = [False]
+
+
+class TraceWriter:
+    """Thread-safe collector of chrome trace events."""
+
+    def __init__(self, pid: int | None = None):
+        self.pid = os.getpid() if pid is None else pid
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+
+    # -- event constructors -------------------------------------------------
+    def add_complete(self, name: str, ts: float, dur: float,
+                     tid: int | None = None, cat: str = "op",
+                     args: dict | None = None) -> None:
+        """One "X" (complete) event; ts/dur in seconds on the perf_counter
+        timeline."""
+        ev = {
+            "name": name, "ph": "X", "cat": cat, "pid": self.pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF if tid is None else tid,
+            "ts": int(ts * 1e6), "dur": int(dur * 1e6),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def add_begin(self, name: str, ts: float, tid: int | None = None,
+                  cat: str = "op") -> None:
+        self._add_mark("B", name, ts, tid, cat)
+
+    def add_end(self, name: str, ts: float, tid: int | None = None,
+                cat: str = "op") -> None:
+        self._add_mark("E", name, ts, tid, cat)
+
+    def add_instant(self, name: str, ts: float, cat: str = "instant") -> None:
+        self._add_mark("i", name, ts, None, cat)
+
+    def _add_mark(self, ph, name, ts, tid, cat):
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": ph, "cat": cat, "pid": self.pid,
+                "tid": threading.get_ident() & 0x7FFFFFFF if tid is None
+                else tid,
+                "ts": int(ts * 1e6),
+            })
+
+    def add_counter(self, name: str, ts: float, values: dict) -> None:
+        """One "C" (counter) event — e.g. the stat gauges over time."""
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "C", "pid": self.pid, "tid": 0,
+                "ts": int(ts * 1e6), "args": dict(values),
+            })
+
+    def extend(self, events) -> None:
+        with self._lock:
+            self._events.extend(events)
+
+    # -- access / export ----------------------------------------------------
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def to_json(self) -> str:
+        return json.dumps({"traceEvents": self.events(),
+                           "displayTimeUnit": "ms"})
+
+    def write(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_json())
+        return path
+
+
+_writer = TraceWriter()
+
+
+def get_writer() -> TraceWriter:
+    return _writer
+
+
+def is_tracing() -> bool:
+    return TRACING[0]
+
+
+def start_tracing(clear: bool = True) -> TraceWriter:
+    if clear:
+        _writer.clear()
+    TRACING[0] = True
+    return _writer
+
+
+def stop_tracing() -> TraceWriter:
+    TRACING[0] = False
+    return _writer
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "op", args: dict | None = None):
+    """Record a span around a block — free when tracing is off."""
+    if not TRACING[0]:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _writer.add_complete(name, t0, time.perf_counter() - t0,
+                             cat=cat, args=args)

@@ -1,0 +1,82 @@
+"""Per-step training telemetry.
+
+`TrainerMonitor` brackets each training step (``step_begin``/``step_end``)
+and derives wall time, examples/s, and the per-step deltas of the hot-path
+stats — recompiles (jit_compile), dispatches, collective launches. The
+hapi `Monitor` callback and `tools/scaling_report.py` feed from it; it is
+the host-side analog of the reference's benchmark per-step logging
+(FLAGS_benchmark step dump + VisualDL scalars).
+"""
+from __future__ import annotations
+
+import time
+
+from . import stats
+
+__all__ = ["TrainerMonitor"]
+
+_TRACKED = ("jit_compile", "op_dispatch", "collective_calls")
+
+
+class TrainerMonitor:
+    """Step-time / throughput / recompile telemetry around a train loop."""
+
+    def __init__(self):
+        self.history: list[dict] = []
+        self.step_idx = 0
+        self._t0 = None
+        self._marks = None
+
+    def reset(self) -> None:
+        self.history.clear()
+        self.step_idx = 0
+        self._t0 = None
+        self._marks = None
+
+    def step_begin(self) -> None:
+        self._marks = tuple(stats.stat_get(n) for n in _TRACKED)
+        self._t0 = time.perf_counter()
+
+    def step_end(self, examples: int | None = None) -> dict:
+        """Close the step; returns the telemetry dict (also appended to
+        ``history``). Safe to call without step_begin (returns {})."""
+        if self._t0 is None:
+            return {}
+        dt = time.perf_counter() - self._t0
+        compiles, dispatches, collectives = (
+            stats.stat_get(n) - m for n, m in zip(_TRACKED, self._marks))
+        tele = {
+            "step": self.step_idx,
+            "step_time_s": dt,
+            "recompiles": compiles,
+            "op_dispatches": dispatches,
+            "collective_calls": collectives,
+        }
+        if examples:
+            tele["examples_per_sec"] = examples / dt if dt > 0 else 0.0
+        self.history.append(tele)
+        self.step_idx += 1
+        self._t0 = None
+        self._marks = None
+        stats.TRAIN_STEPS.add()
+        return tele
+
+    def summary(self) -> dict:
+        """Aggregate over recorded steps. Mean step time excludes step 0
+        when possible — the first step carries compilation."""
+        if not self.history:
+            return {"steps": 0}
+        steady = self.history[1:] if len(self.history) > 1 else self.history
+        times = [h["step_time_s"] for h in steady]
+        out = {
+            "steps": len(self.history),
+            "first_step_time_s": self.history[0]["step_time_s"],
+            "mean_step_time_s": sum(times) / len(times),
+            "max_step_time_s": max(times),
+            "total_recompiles": sum(h["recompiles"] for h in self.history),
+        }
+        ips = [h["examples_per_sec"] for h in steady
+               if "examples_per_sec" in h]
+        if ips:
+            out["mean_examples_per_sec"] = sum(ips) / len(ips)
+        return out

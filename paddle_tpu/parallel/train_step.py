@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..monitor import stats as _mstats
+from ..monitor.trace import span as _trace_span
 from .mesh import get_mesh, mesh_shape
 from .sharding import zero_shard_specs
 
@@ -431,12 +433,15 @@ class DistributedTrainStep:
 
     def __call__(self, batch):
         lr = jnp.float32(self.current_lr())
-        with self.mesh:
-            (self.params, self.opt_state, self.aux, loss,
-             self.scaler_state) = self._step(
-                self.params, self.opt_state, self.aux, batch, lr,
-                self.scaler_state)
+        with _trace_span("DistributedTrainStep.step", cat="step",
+                         args={"step": self._step_count}):
+            with self.mesh:
+                (self.params, self.opt_state, self.aux, loss,
+                 self.scaler_state) = self._step(
+                    self.params, self.opt_state, self.aux, batch, lr,
+                    self.scaler_state)
         self._step_count += 1
+        _mstats.TRAIN_STEPS.add()
         return loss
 
     def loss_scale(self) -> Optional[float]:

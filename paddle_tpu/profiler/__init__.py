@@ -1,17 +1,29 @@
 """Profiler (reference paddle/fluid/platform/profiler.h RecordEvent,
-python/paddle/fluid/profiler.py).
+python/paddle/fluid/profiler.py, python/paddle/profiler).
 
-TPU-native: jax.profiler emits TensorBoard/perfetto traces (the
-chrome-trace analog); RecordEvent maps to jax.profiler.TraceAnnotation named
-scopes which show up inside the XLA trace timeline.
+TPU-native: three cooperating layers —
+- ``RecordEvent`` named scopes feed (a) the host summary table, (b) the
+  paddle_tpu.monitor chrome-trace writer when tracing is on, and (c)
+  jax.profiler.TraceAnnotation so the spans also appear inside an XLA
+  TensorBoard trace when one is being captured;
+- ``start_profiler``/``stop_profiler``/``Profiler`` drive collection and
+  write a Perfetto/chrome://tracing-loadable JSON via
+  monitor.trace.TraceWriter — independent of jax.profiler, so trace
+  export works on any backend;
+- ``jax.profiler.start_trace`` (TensorBoard/XLA timeline) is opt-in and
+  failure-tolerant: where the plugin is unavailable the chrome-trace file
+  is still produced.
 """
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from collections import defaultdict
 
 import jax
+
+from ..monitor import trace as _mtrace
 
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
            "Profiler", "summary", "reset_profiler", "cuda_profiler", "npu_profiler",
@@ -20,6 +32,8 @@ __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
 _events = defaultdict(list)
 _active = [False]
 _trace_dir = [None]
+
+_SORT_KEYS = ("total", "calls", "avg", "max", "min")
 
 
 class RecordEvent:
@@ -53,8 +67,12 @@ class RecordEvent:
         if self._native_cm is not None:
             self._native_cm.__exit__(*exc)
             self._native_cm = None
+        dur = time.perf_counter() - self._t0
         if _active[0]:
-            _events[self.name].append(time.perf_counter() - self._t0)
+            _events[self.name].append(dur)
+        if _mtrace.TRACING[0]:
+            _mtrace.get_writer().add_complete(self.name, self._t0, dur,
+                                              cat="record_event")
         return False
 
     def begin(self):
@@ -64,34 +82,86 @@ class RecordEvent:
         self.__exit__(None, None, None)
 
 
-def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+# -- jax trace, guarded (plugin may be unavailable / already running) -------
+
+_jax_tracing = [False]
+
+
+def _try_start_jax_trace(trace_dir) -> bool:
+    if _jax_tracing[0] or not trace_dir:
+        return False
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _jax_tracing[0] = True
+        return True
+    except Exception:
+        return False
+
+
+def _try_stop_jax_trace() -> None:
+    if not _jax_tracing[0]:
+        return
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _jax_tracing[0] = False
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None,
+                   use_jax_trace=True):
     _active[0] = True
     _events.clear()
+    _mtrace.start_tracing()
     if trace_dir:
         _trace_dir[0] = trace_dir
-        jax.profiler.start_trace(trace_dir)
+        if use_jax_trace:
+            _try_start_jax_trace(trace_dir)
 
 
 def stop_profiler(sorted_key="total", profile_path=None):
+    """Stop collection; print the summary table; when a trace dir was
+    given, write the chrome-trace JSON there; when ``profile_path`` is
+    given, write the summary table to that file (reference
+    fluid/profiler.py stop_profiler semantics)."""
     _active[0] = False
+    writer = _mtrace.stop_tracing()
     if _trace_dir[0]:
-        jax.profiler.stop_trace()
+        writer.write(os.path.join(_trace_dir[0], "paddle_tpu_trace.json"))
+        _try_stop_jax_trace()
         _trace_dir[0] = None
-    return summary(sorted_key)
+    rows = summary(sorted_key)
+    if profile_path:
+        d = os.path.dirname(os.path.abspath(profile_path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(profile_path, "w") as f:
+            summary(sorted_key, file=f)
+    return rows
 
 
-def summary(sorted_key="total"):
+def summary(sorted_key="total", file=None):
+    """Aggregate RecordEvent timings; sort by ``sorted_key`` in
+    total|calls|avg|max|min (reference fluid/profiler.py sorted_key),
+    print the table to ``file`` (stdout by default), return the rows."""
+    if sorted_key not in _SORT_KEYS:
+        raise ValueError(
+            f"summary: sorted_key must be one of {_SORT_KEYS}, "
+            f"got {sorted_key!r}")
     rows = []
     for name, times in _events.items():
         rows.append({
             "name": name, "calls": len(times), "total": sum(times),
             "avg": sum(times) / len(times), "max": max(times), "min": min(times),
         })
-    rows.sort(key=lambda r: -r["total"])
+    rows.sort(key=lambda r: -r[sorted_key])
     if rows:
-        print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}")
+        print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"
+              f"{'Max(s)':>12}{'Min(s)':>12}", file=file)
         for r in rows:
-            print(f"{r['name']:<40}{r['calls']:>8}{r['total']:>12.6f}{r['avg']:>12.6f}")
+            print(f"{r['name']:<40}{r['calls']:>8}{r['total']:>12.6f}"
+                  f"{r['avg']:>12.6f}{r['max']:>12.6f}{r['min']:>12.6f}",
+                  file=file)
     return rows
 
 
@@ -105,19 +175,112 @@ def profiler(state="All", sorted_key="total", profile_path="/tmp/profile", trace
 
 
 class Profiler:
-    """paddle.profiler.Profiler-style API over jax.profiler."""
+    """paddle.profiler.Profiler-style API.
 
-    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, trace_dir="/tmp/paddle_tpu_trace"):
+    - ``scheduler``: ``(wait, warmup, active)`` ints, or a callable
+      ``step -> "wait"|"warmup"|"active"``; None records the whole
+      start..stop window. During *wait* nothing is recorded; *warmup*
+      records but its spans are discarded when the *active* window opens;
+      after the last *active* step the trace is flushed: written under
+      ``trace_dir`` and handed to ``on_trace_ready(self)``.
+    - ``on_trace_ready``: callable(profiler) invoked at each flush;
+      ``self.last_trace_path`` holds the file just written.
+    - ``use_jax_trace``: also drive jax.profiler.start_trace for the XLA
+      TensorBoard timeline (best-effort; the chrome-trace JSON is
+      produced regardless).
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 trace_dir="/tmp/paddle_tpu_trace", timer_only=False,
+                 use_jax_trace=False):
         self.trace_dir = trace_dir
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.use_jax_trace = use_jax_trace
+        self.last_trace_path = None
+        if scheduler is None or callable(scheduler):
+            self._sched = scheduler
+        else:
+            w, u, a = (int(x) for x in scheduler)
+            if a <= 0:
+                raise ValueError("scheduler active window must be positive")
+            self._sched = self._make_window_fn(w, u, a)
+        self._step_num = 0
+        self._cycle_idx = 0
+        self._recording = False
 
+    @staticmethod
+    def _make_window_fn(wait, warmup, active):
+        cycle = wait + warmup + active
+
+        def phase(step):
+            pos = step % cycle
+            if pos < wait:
+                return "wait"
+            if pos < wait + warmup:
+                return "warmup"
+            return "active"
+
+        return phase
+
+    def _phase(self, step):
+        return self._sched(step) if self._sched is not None else "active"
+
+    # -- lifecycle ----------------------------------------------------------
     def start(self):
-        start_profiler(trace_dir=self.trace_dir)
-
-    def stop(self):
-        stop_profiler()
+        self._step_num = 0
+        self._cycle_idx = 0
+        self._recording = False
+        _active[0] = True
+        _events.clear()
+        self._apply_phase(self._phase(0), prev=None)
+        if self.use_jax_trace and not self.timer_only:
+            _try_start_jax_trace(self.trace_dir)
 
     def step(self):
-        pass
+        prev = self._phase(self._step_num)
+        self._step_num += 1
+        cur = self._phase(self._step_num)
+        if self._sched is not None and prev == "active" and cur != "active":
+            self._flush()
+        self._apply_phase(cur, prev)
+
+    def stop(self):
+        if self._recording and (self._sched is None
+                                or self._phase(self._step_num) == "active"):
+            self._flush()
+        _mtrace.stop_tracing()
+        self._recording = False
+        _active[0] = False
+        _try_stop_jax_trace()
+
+    def _apply_phase(self, phase, prev):
+        if phase == "wait":
+            if self._recording:
+                _mtrace.stop_tracing()
+                self._recording = False
+            return
+        if not self._recording:
+            _mtrace.start_tracing()
+            self._recording = True
+        if phase == "active" and prev == "warmup":
+            # warmup spans exist only to stabilize caches — drop them
+            _mtrace.get_writer().clear()
+
+    def _flush(self):
+        writer = _mtrace.get_writer()
+        if self.trace_dir and not self.timer_only:
+            name = (f"paddle_tpu_trace_{self._cycle_idx}.json"
+                    if self._sched is not None else "paddle_tpu_trace.json")
+            self.last_trace_path = writer.write(
+                os.path.join(self.trace_dir, name))
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+        writer.clear()
+        self._cycle_idx += 1
+
+    def summary(self, sorted_key="total"):
+        return summary(sorted_key)
 
     def __enter__(self):
         self.start()
@@ -139,21 +302,16 @@ def reset_profiler():
     _events.clear()
 
 
+@contextlib.contextmanager
 def cuda_profiler(output_file=None, output_mode=None, config=None):
     """Reference fluid/profiler.py:39 wraps nvprof; the TPU analog is the
-    jax profiler trace already driven by start/stop_profiler, so this is a
+    host profiler already driven by start/stop_profiler, so this is a
     documented alias for porting scripts."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def _ctx():
-        start_profiler()
-        try:
-            yield
-        finally:
-            stop_profiler()
-
-    return _ctx()
+    start_profiler()
+    try:
+        yield
+    finally:
+        stop_profiler()
 
 
 npu_profiler = cuda_profiler

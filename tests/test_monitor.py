@@ -1,0 +1,293 @@
+"""paddle_tpu.monitor observability subsystem (ISSUE 1): stat registry,
+chrome-trace export, jit-cache/compile counters in apply_op,
+FLAGS_benchmark per-op table, Profiler scheduler, hapi Monitor callback,
+tools/trace_report.py."""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, profiler
+from paddle_tpu.framework.core import apply_op
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(_ROOT, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestStatRegistry:
+    def test_add_get_reset(self):
+        monitor.stat_reset("t_basic")
+        monitor.stat_add("t_basic", 5)
+        monitor.stat_add("t_basic")
+        assert monitor.stat_get("t_basic") == 6
+        monitor.stat_reset("t_basic")
+        assert monitor.stat_get("t_basic") == 0
+
+    def test_singleton_and_names(self):
+        r1 = monitor.StatRegistry.instance()
+        r2 = monitor.StatRegistry.instance()
+        assert r1 is r2
+        monitor.stat_add("t_named", 1)
+        assert "t_named" in monitor.stat_names()
+        assert monitor.stat_snapshot()["t_named"] >= 1
+        # pre-registered dashboard stats exist from import time
+        for name in monitor.DEFAULT_STATS:
+            assert name in monitor.stat_names()
+
+    def test_thread_safety_smoke(self):
+        monitor.stat_reset("t_threads")
+
+        def worker():
+            for _ in range(1000):
+                monitor.stat_add("t_threads")
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert monitor.stat_get("t_threads") == 8000
+
+    def test_gauge_set_and_memory_stats(self):
+        out = monitor.update_memory_stats()
+        assert out["host_memory_bytes"] > 0  # RSS of a live jax process
+
+
+class TestJitCacheCounters:
+    def test_two_identical_apply_ops_one_compile(self):
+        """Acceptance: 2 dispatches -> 1 miss (compile), 1 hit."""
+        def uniquely_named_op(x):
+            return x * 3.0
+
+        for n in ("jit_cache_miss", "jit_cache_hit", "jit_compile",
+                  "op_dispatch"):
+            monitor.stat_reset(n)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        apply_op(uniquely_named_op, x)
+        apply_op(uniquely_named_op, x)
+        assert monitor.stat_get("op_dispatch") == 2
+        assert monitor.stat_get("jit_cache_miss") == 1
+        assert monitor.stat_get("jit_compile") == 1
+        assert monitor.stat_get("jit_cache_hit") == 1
+
+
+class TestBenchmarkFlag:
+    def test_per_op_table(self, capsys):
+        def benched_op(x):
+            return x + 1.0
+
+        monitor.benchmark_reset()
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        paddle.set_flags({"FLAGS_benchmark": 1})
+        try:
+            apply_op(benched_op, x, op_name="benched_op")
+            apply_op(benched_op, x, op_name="benched_op")
+        finally:
+            paddle.set_flags({"FLAGS_benchmark": 0})
+        rows = monitor.benchmark_summary()
+        out = capsys.readouterr().out
+        byname = {r["op"]: r for r in rows}
+        assert byname["benched_op"]["calls"] == 2
+        assert byname["benched_op"]["total"] >= byname["benched_op"]["max"]
+        assert "benched_op" in out and "Calls" in out
+        # off again: no accumulation
+        monitor.benchmark_reset()
+        apply_op(benched_op, x, op_name="benched_op")
+        assert monitor.benchmark_rows() == []
+
+
+class TestTraceWriter:
+    def test_valid_json_matched_events(self, tmp_path):
+        w = monitor.TraceWriter(pid=1)
+        w.add_complete("op_a", 0.0, 0.001)
+        w.add_begin("op_b", 0.002, tid=7)
+        w.add_end("op_b", 0.005, tid=7)
+        w.add_counter("stats", 0.006, {"dispatch": 3})
+        path = w.write(str(tmp_path / "sub" / "trace.json"))
+        data = json.load(open(path))
+        evs = data["traceEvents"]
+        assert len(evs) == 4
+        assert sum(e["ph"] == "B" for e in evs) == sum(
+            e["ph"] == "E" for e in evs)
+        x = [e for e in evs if e["ph"] == "X"][0]
+        assert x["name"] == "op_a" and x["dur"] == 1000
+
+    def test_span_free_when_off(self):
+        w = monitor.get_writer()
+        n0 = len(w)
+        with monitor.span("idle"):
+            pass
+        assert len(w) == n0  # gate off: nothing recorded
+
+
+class TestProfilerTraceExport:
+    def _model(self):
+        paddle.seed(7)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 2))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        model.prepare(optimizer=opt, loss=paddle.nn.CrossEntropyLoss())
+        return model
+
+    def test_train_batch_trace_file(self, tmp_path):
+        """Acceptance: Profiler(trace_dir=d) around train_batch writes a
+        chrome-trace JSON under d that tools/trace_report.py parses."""
+        model = self._model()
+        x = np.random.randn(4, 8).astype(np.float32)
+        y = np.random.randint(0, 2, (4, 1)).astype(np.int64)
+        d = str(tmp_path / "traces")
+        with profiler.Profiler(trace_dir=d) as prof:
+            model.train_batch([x], [y])
+        assert prof.last_trace_path and prof.last_trace_path.startswith(d)
+        tr = _load_trace_report()
+        rows = tr.aggregate(tr.load_events(prof.last_trace_path))
+        assert rows, "trace must contain span events"
+        names = {r["name"] for r in rows}
+        assert "Model.train_batch" in names
+        # report prints without error and respects --top
+        top = tr.report(rows, top=3)
+        assert len(top) <= 3
+
+    def test_scheduler_and_on_trace_ready(self, tmp_path):
+        ready = []
+        p = profiler.Profiler(
+            scheduler=(1, 1, 2), trace_dir=str(tmp_path),
+            on_trace_ready=lambda prof: ready.append(prof.last_trace_path))
+        p.start()
+        for _ in range(8):  # two full (wait=1, warmup=1, active=2) cycles
+            with profiler.RecordEvent("tick"):
+                pass
+            p.step()
+        p.stop()
+        assert len(ready) == 2
+        for path in ready:
+            assert os.path.exists(path)
+            evs = json.load(open(path))["traceEvents"]
+            # only the 2 active steps of the window survive (warmup dropped)
+            assert len([e for e in evs if e["name"] == "tick"]) == 2
+
+    def test_tracing_gate_restored(self):
+        assert not monitor.is_tracing()
+
+
+class TestProfilerSummary:
+    def _record(self):
+        with profiler.RecordEvent("warmup"):  # first TraceAnnotation is slow
+            pass
+        profiler.reset_profiler()
+        profiler.start_profiler()
+        with profiler.RecordEvent("ev_two_calls"):
+            time.sleep(0.001)
+        with profiler.RecordEvent("ev_two_calls"):
+            pass
+        with profiler.RecordEvent("ev_slow"):
+            time.sleep(0.03)
+
+    def test_sorted_key_respected(self, capsys):
+        self._record()
+        rows = profiler.stop_profiler(sorted_key="calls")
+        assert rows[0]["name"] == "ev_two_calls"
+        assert profiler.summary(sorted_key="total")[0]["name"] == "ev_slow"
+        assert profiler.summary(sorted_key="max")[0]["name"] == "ev_slow"
+        assert profiler.summary(sorted_key="min")[0]["name"] == "ev_slow"
+        out = capsys.readouterr().out
+        assert "Max(s)" in out and "Min(s)" in out
+        with pytest.raises(ValueError):
+            profiler.summary(sorted_key="bogus")
+
+    def test_stop_profiler_writes_profile_path(self, tmp_path, capsys):
+        self._record()
+        path = str(tmp_path / "profile.txt")
+        profiler.stop_profiler(sorted_key="total", profile_path=path)
+        capsys.readouterr()
+        text = open(path).read()
+        assert "ev_slow" in text and "Calls" in text
+        # file is sorted by total: ev_slow row comes first
+        assert text.index("ev_slow") < text.index("ev_two_calls")
+
+
+class TestTrainerTelemetry:
+    def test_trainer_monitor_step(self):
+        tm = monitor.TrainerMonitor()
+        tm.step_begin()
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        (x + x).numpy()
+        tele = tm.step_end(examples=2)
+        assert tele["step_time_s"] > 0
+        assert tele["op_dispatches"] >= 1
+        assert tele["recompiles"] >= 0
+        assert tele["examples_per_sec"] > 0
+        assert tm.summary()["steps"] == 1
+        # step_end without begin is a no-op
+        assert tm.step_end() == {}
+
+    def test_hapi_monitor_callback(self):
+        from paddle_tpu.hapi import callbacks as cbks
+
+        paddle.seed(7)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                            parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss())
+        seen = []
+
+        class Recorder(cbks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(dict(logs or {}))
+
+        mon = cbks.Monitor()
+        x = np.random.randn(16, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (16, 1)).astype(np.int64)
+
+        class DS(paddle.io.Dataset):
+            def __getitem__(self, i):
+                return x[i], y[i]
+
+            def __len__(self):
+                return len(x)
+
+        model.fit(DS(), batch_size=8, epochs=1, verbose=0,
+                  callbacks=[mon, Recorder()])
+        assert seen and all("step_time_s" in s for s in seen)
+        assert all("recompiles" in s for s in seen)
+        assert all(s["examples_per_sec"] > 0 for s in seen)
+        assert mon.summary()["steps"] == len(seen)
+
+
+class TestCollectiveCounters:
+    def test_all_reduce_counted(self):
+        from paddle_tpu import distributed as dist
+        from paddle_tpu.parallel import create_mesh
+
+        import jax
+
+        monitor.stat_reset("collective_calls")
+        monitor.stat_reset("collective_all_reduce")
+        create_mesh(dp=len(jax.devices()))
+        try:
+            t = paddle.to_tensor(
+                np.ones((len(jax.devices()), 2), np.float32))
+            dist.all_reduce(t)
+        finally:
+            # drop the cached default group (nranks snapshots world size —
+            # later tests monkeypatch it and must rebuild the group)
+            dist.destroy_process_group()
+        assert monitor.stat_get("collective_calls") == 1
+        assert monitor.stat_get("collective_all_reduce") == 1

@@ -1,0 +1,91 @@
+"""Chrome-trace hot-span report: load a trace-event JSON (as written by
+paddle_tpu.profiler / monitor.trace.TraceWriter, or any chrome://tracing
+export) and print the top-N spans by total time — so CI and bench rounds
+can diff hot paths without TensorBoard.
+
+    python tools/trace_report.py /path/to/paddle_tpu_trace.json [--top 20]
+
+Handles both "X" (complete) events and matched "B"/"E" pairs; events come
+either as a bare list or under the {"traceEvents": [...]} envelope.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path: str) -> list:
+    with open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", []) if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a chrome-trace file "
+                         "(expected a list or a traceEvents envelope)")
+    return events
+
+
+def aggregate(events: list) -> list:
+    """Per-name rows {name, calls, total_us, avg_us, max_us} sorted by
+    total, descending. B/E pairs are matched per (pid, tid) as a stack —
+    the format guarantees nesting within a thread."""
+    acc: dict = {}  # name -> [calls, total_us, max_us]
+    open_marks: dict = {}  # (pid, tid) -> [(name, ts)]
+
+    def feed(name, dur):
+        r = acc.get(name)
+        if r is None:
+            acc[name] = [1, dur, dur]
+        else:
+            r[0] += 1
+            r[1] += dur
+            if dur > r[2]:
+                r[2] = dur
+
+    for ev in events:
+        ph = ev.get("ph")
+        name = ev.get("name", "?")
+        if ph == "X":
+            feed(name, float(ev.get("dur", 0)))
+        elif ph == "B":
+            open_marks.setdefault((ev.get("pid"), ev.get("tid")), []).append(
+                (name, float(ev.get("ts", 0))))
+        elif ph == "E":
+            stack = open_marks.get((ev.get("pid"), ev.get("tid")))
+            if stack:
+                bname, bts = stack.pop()
+                feed(bname, float(ev.get("ts", 0)) - bts)
+    rows = [{"name": n, "calls": r[0], "total_us": r[1],
+             "avg_us": r[1] / r[0], "max_us": r[2]}
+            for n, r in acc.items()]
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def report(rows: list, top: int = 20, file=None) -> list:
+    rows = rows[:top]
+    if not rows:
+        print("no span events found", file=file)
+        return rows
+    print(f"{'Span':<48}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"
+          f"{'Max(ms)':>12}", file=file)
+    for r in rows:
+        print(f"{r['name'][:47]:<48}{r['calls']:>8}"
+              f"{r['total_us'] / 1e3:>12.3f}{r['avg_us'] / 1e3:>12.3f}"
+              f"{r['max_us'] / 1e3:>12.3f}", file=file)
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="chrome-trace JSON file")
+    ap.add_argument("--top", type=int, default=20,
+                    help="number of spans to print (by total time)")
+    args = ap.parse_args(argv)
+    rows = aggregate(load_events(args.trace))
+    report(rows, args.top)
+    return rows
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() is not None else 1)
