@@ -94,6 +94,13 @@ check_nan_inf = [False]
 # paddle_tpu.monitor.benchmark.
 benchmark = [False]
 
+# Fast-path mirror of FLAGS_eager_grad_jit (ISSUE 2): gates the cached
+# jitted-VJP fast path on grad-enabled eager dispatch (the training-side
+# PreparedOp-cache analog in framework.core). Default ON; flip with
+# `paddle.set_flags({"FLAGS_eager_grad_jit": 0})` to fall back to raw
+# per-call jax.vjp closures.
+eager_grad_jit = [True]
+
 
 def _truthy(value) -> bool:
     return str(value).lower() in ("1", "true", "yes", "on")
@@ -104,6 +111,8 @@ def set_flag(name: str, value) -> None:
         check_nan_inf[0] = _truthy(value)
     elif name.endswith("benchmark"):
         benchmark[0] = _truthy(value)
+    elif name.endswith("eager_grad_jit"):
+        eager_grad_jit[0] = _truthy(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

@@ -42,6 +42,14 @@ def _keep(v):
     return v if isinstance(v, Tensor) else Tensor(_arr(v))
 
 
+def _val(v):
+    """Evaluation-point arg for apply_op: a Tensor passes through LIVE so
+    d log_prob / d value flows (unwrapping with _arr would sever the
+    tape); raw values become plain arrays. Categorical keeps _arr — its
+    value is an integer index with no gradient."""
+    return v if isinstance(v, Tensor) else _arr(v)
+
+
 class Distribution:
     """Abstract base (reference distribution.py:42)."""
 
@@ -99,11 +107,11 @@ class Uniform(Distribution):
 
     def log_prob(self, value):
         return apply_op(_uniform_log_prob_op, self.low, self.high,
-                        _arr(value), op_name="uniform_log_prob")
+                        _val(value), op_name="uniform_log_prob")
 
     def probs(self, value):
         return apply_op(_uniform_probs_op, self.low, self.high,
-                        _arr(value), op_name="uniform_probs")
+                        _val(value), op_name="uniform_probs")
 
     def entropy(self):
         return apply_op(_uniform_entropy_op, self.low, self.high,
@@ -155,11 +163,11 @@ class Normal(Distribution):
 
     def log_prob(self, value):
         return apply_op(_normal_log_prob_op, self.loc, self.scale,
-                        _arr(value), op_name="normal_log_prob")
+                        _val(value), op_name="normal_log_prob")
 
     def probs(self, value):
         return apply_op(_normal_probs_op, self.loc, self.scale,
-                        _arr(value), op_name="normal_probs")
+                        _val(value), op_name="normal_probs")
 
     def entropy(self):
         return apply_op(_normal_entropy_op, self.loc, self.scale,
@@ -194,14 +202,24 @@ def _categorical_log_prob_op(lg, v):
 
 
 def _categorical_entropy_op(lg):
-    p = jax.nn.softmax(lg, axis=-1)
-    return -jnp.sum(p * jnp.log(p), axis=-1)
+    # from log_softmax with a where(p>0, lp, 0) guard: p * log(p) at
+    # p == 0 is 0 * -inf = NaN under the naive jnp.log(p) form (extreme
+    # logit gaps underflow the softmax to exactly 0). Guarding lp ITSELF
+    # (not the product) keeps both the 0*log0=0 convention and a NaN-free
+    # gradient — where() grads still multiply by the untaken branch's
+    # cotangent, so a -inf must never reach the product
+    lp = jax.nn.log_softmax(lg, axis=-1)
+    p = jnp.exp(lp)
+    return -jnp.sum(p * jnp.where(p > 0, lp, 0.0), axis=-1)
 
 
 def _categorical_kl_op(lg, olg):
-    p = jax.nn.softmax(lg, axis=-1)
-    return jnp.sum(p * (jax.nn.log_softmax(lg, axis=-1)
-                        - jax.nn.log_softmax(olg, axis=-1)), axis=-1)
+    # same where(p>0, ., 0) guard as entropy: a zero-probability category
+    # contributes 0 to the KL sum, not 0 * (-inf - lp') = NaN
+    lp = jax.nn.log_softmax(lg, axis=-1)
+    olp = jax.nn.log_softmax(olg, axis=-1)
+    p = jnp.exp(lp)
+    return jnp.sum(p * jnp.where(p > 0, lp - olp, 0.0), axis=-1)
 
 
 class Categorical(Distribution):

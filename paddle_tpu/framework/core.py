@@ -17,6 +17,11 @@ This is the TPU-native analog of the reference's dygraph runtime:
   by op-level ``jax.jit`` caching keyed on (fn, static attrs) — XLA's trace
   cache plays the role of the reference's ``PreparedOp`` kernel cache
   (/root/reference/paddle/fluid/imperative/prepared_operator.cc:92).
+  The same PreparedOp treatment covers the TRAINING path: grad-enabled
+  dispatches and the backward walk's vjp applications + cotangent adds go
+  through the (fn, attrs, avals)-keyed grad-jit cache (``_grad_jit_cache``
+  below; gauges grad_jit_hit/miss/compile in paddle_tpu.monitor; disable
+  with ``FLAGS_eager_grad_jit=0``).
 
 Inside a ``jax.jit``/``jax.grad`` trace (our "static"/functional mode) the
 tape is bypassed: differentiation is handled by JAX's own machinery, so
@@ -382,9 +387,26 @@ _jit_cache: dict = {}
 EAGER_JIT = True
 
 
+def _hashable_attrs(attrs):
+    """Attrs as a canonical hashable tuple for cache keys. List/dict
+    values are normalized (conv strides/paddings arrive as lists — they
+    would otherwise force the raw fallback on every conv dispatch);
+    genuinely unhashable values (arrays) raise TypeError."""
+    def norm(v):
+        if isinstance(v, (list, tuple)):
+            return ("#seq",) + tuple(norm(x) for x in v)
+        if isinstance(v, dict):
+            return ("#map",) + tuple(
+                sorted((k, norm(x)) for k, x in v.items()))
+        hash(v)
+        return v
+
+    return tuple(sorted((k, norm(v)) for k, v in attrs.items()))
+
+
 def _jitted(fn, attrs):
     try:
-        key = (fn, tuple(sorted(attrs.items())))
+        key = (fn, _hashable_attrs(attrs))
         hash(key)
     except TypeError:
         return None
@@ -397,6 +419,134 @@ def _jitted(fn, attrs):
     else:
         _mstats.JIT_CACHE_HIT.add()
     return j
+
+
+# -- grad-jit cache: the PreparedOp analog for the TRAINING path -----------
+#
+# The no-grad dispatch above amortizes trace+compile through _jit_cache,
+# but a grad-enabled dispatch used to pay jax.vjp(f, *arrays) — a full
+# un-jitted trace-and-execute — on EVERY call, and the backward walk then
+# replayed raw Python vjp closures node by node. This cache extends the
+# compile-once-dispatch-many model to training: keyed on
+# (fn, sorted(attrs), input avals) it holds a jitted forward plus a jitted
+# vjp-apply companion ``bwd(primals, cotangents)`` that re-derives the vjp
+# INSIDE the compiled program (XLA dead-code-eliminates whatever part of
+# the forward the residuals don't need — for matmul the recompute vanishes
+# entirely; for tanh-like ops it is the standard remat trade). Residuals
+# are therefore just the primal args the node already keeps for double
+# grad — nothing extra is stored. Aval keying makes shape-churn recompile
+# storms visible: every new (fn, attrs, avals) combination is a
+# GRAD_JIT_MISS + GRAD_JIT_COMPILE, steady-state training is pure
+# GRAD_JIT_HIT. Unhashable attrs or non-array args fall back to the raw
+# per-call jax.vjp path; `set_flags({"FLAGS_eager_grad_jit": 0})` disables
+# the cache entirely.
+
+_grad_jit_cache: dict = {}
+
+
+class _GradJitEntry:
+    __slots__ = ("f", "fwd", "bwd", "name", "fwd_primed", "bwd_primed")
+
+    def __init__(self, fn, attrs, name):
+        f_raw = functools.partial(fn, **attrs) if attrs else fn
+
+        # normalize multi-output structure to a PLAIN tuple (NamedTuple
+        # outputs of jnp.linalg ops reject plain-tuple cotangents — see
+        # the raw-vjp path below)
+        def f(*a, _f=f_raw):
+            o = _f(*a)
+            return tuple(o) if isinstance(o, (tuple, list)) else o
+
+        def bwd(primals, cts, _f=f):
+            _, vjp = jax.vjp(_f, *primals)
+            return vjp(cts)
+
+        self.f = f
+        self.fwd = jax.jit(f)
+        self.bwd = jax.jit(bwd)
+        self.name = name
+        self.fwd_primed = False
+        self.bwd_primed = False
+
+
+def _grad_aval_sig(arrays):
+    """Aval cache key: (shape, dtype) per array arg, python-scalar args by
+    type (they trace weak-typed, so same type => same aval). Raises
+    TypeError for anything else — the caller falls back to raw jax.vjp."""
+    sig = []
+    for a in arrays:
+        sh = getattr(a, "shape", None)
+        if sh is None:
+            if not isinstance(a, (int, float, complex)):
+                raise TypeError("non-array positional arg")
+            sig.append(type(a).__name__)
+        else:
+            dt = getattr(a, "dtype", None)
+            if dt is None:
+                raise TypeError("shaped arg without dtype")
+            sig.append((tuple(sh), str(dt)))
+    return tuple(sig)
+
+
+def _grad_jitted(fn, attrs, arrays, name=None):
+    """Cache lookup for the grad-enabled fast path; None => raw fallback."""
+    try:
+        key = (fn, _hashable_attrs(attrs) if attrs else (),
+               _grad_aval_sig(arrays))
+        hash(key)
+    except TypeError:
+        return None
+    e = _grad_jit_cache.get(key)
+    if e is None:
+        _mstats.GRAD_JIT_MISS.add()
+        _mstats.GRAD_JIT_COMPILE.add()
+        e = _GradJitEntry(fn, attrs, name or getattr(fn, "__name__", "op"))
+        _grad_jit_cache[key] = e
+    else:
+        _mstats.GRAD_JIT_HIT.add()
+    return e
+
+
+def _grad_jit_fwd(entry, arrays):
+    if not entry.fwd_primed:
+        entry.fwd_primed = True
+        if _benchmark[0]:
+            # first call pays trace+compile: surface it in the
+            # FLAGS_benchmark table so recompile storms are attributable
+            t0 = time.perf_counter()
+            out = entry.fwd(*arrays)
+            _bench_record(entry.name + "@grad_jit_compile",
+                          time.perf_counter() - t0)
+            return out
+    return entry.fwd(*arrays)
+
+
+def _grad_jit_bwd(entry, primals, cts):
+    if not entry.bwd_primed:
+        entry.bwd_primed = True
+        if _benchmark[0]:
+            t0 = time.perf_counter()
+            out = entry.bwd(primals, cts)
+            _bench_record(entry.name + "@grad_jit_bwd_compile",
+                          time.perf_counter() - t0)
+            return out
+    return entry.bwd(primals, cts)
+
+
+def _ct_add_op(a, b):
+    return a + b
+
+
+def _ct_accum(a, b):
+    """Cotangent accumulation through the grad-jit cache: the backward
+    walk's adds (the reference's GradientAccumulator) hit the same
+    compiled-once path as the vjp applications, so a steady-state train
+    step executes only cache hits."""
+    if _eager_grad_jit[0]:
+        e = _grad_jitted(_ct_add_op, {}, (a, b))
+        if e is not None:
+            return _grad_jit_fwd(e, (a, b))
+    return a + b
 
 
 _symbolic_dispatch_hook = [None]
@@ -414,6 +564,7 @@ def set_symbolic_dispatch(fn):
 # core.native so `paddle.set_flags({"FLAGS_check_nan_inf": 1})` flips it.
 from ..core.native import check_nan_inf as _nan_check  # noqa: E402
 from ..core.native import benchmark as _benchmark  # noqa: E402
+from ..core.native import eager_grad_jit as _eager_grad_jit  # noqa: E402
 # Observability hooks (paddle_tpu.monitor): stat handles are pre-created
 # module attributes so the idle dispatch path pays one counter add; span
 # timing and FLAGS_benchmark accumulation are gated on shared cells.
@@ -482,18 +633,29 @@ def _apply_op_eager(fn, args, attrs, op_name):
     )
 
     if needs_grad:
-        f_raw = functools.partial(fn, **attrs) if attrs else fn
+        entry = (_grad_jitted(fn, attrs, arrays,
+                              op_name or getattr(fn, "__name__", "op"))
+                 if _eager_grad_jit[0] else None)
+        if entry is not None:
+            # fast path: compiled forward; the grad node's "vjp closure"
+            # is the cached jitted bwd bound to the primal args (which
+            # double as the residuals — see the cache's module comment)
+            f = entry.f
+            out = _grad_jit_fwd(entry, arrays)
+            vjp_fn = functools.partial(_grad_jit_bwd, entry, arrays)
+        else:
+            f_raw = functools.partial(fn, **attrs) if attrs else fn
 
-        # normalize multi-output structure to a PLAIN tuple before vjp:
-        # ops built on jnp.linalg (svd/qr/eigh) return NamedTuples, and a
-        # vjp built on that structure rejects the plain-tuple cotangents
-        # the backward walk supplies (found by the decomposition grad
-        # sweep)
-        def f(*a, _f=f_raw):
-            o = _f(*a)
-            return tuple(o) if isinstance(o, (tuple, list)) else o
+            # normalize multi-output structure to a PLAIN tuple before
+            # vjp: ops built on jnp.linalg (svd/qr/eigh) return
+            # NamedTuples, and a vjp built on that structure rejects the
+            # plain-tuple cotangents the backward walk supplies (found by
+            # the decomposition grad sweep)
+            def f(*a, _f=f_raw):
+                o = _f(*a)
+                return tuple(o) if isinstance(o, (tuple, list)) else o
 
-        out, vjp_fn = jax.vjp(f, *arrays)
+            out, vjp_fn = jax.vjp(f, *arrays)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)
         if _nan_check[0]:
@@ -555,7 +717,15 @@ def _is_float0(ct) -> bool:
 
 
 def backward(tensor: Tensor, grad_tensor=None, retain_graph: bool = False):
-    """Reverse-mode walk of the tape (BasicEngine::Execute analog)."""
+    """Reverse-mode walk of the tape (BasicEngine::Execute analog).
+
+    The walk is a single coalesced pass over the reversed topo order:
+    each node's vjp application is the cached jitted ``bwd`` the forward
+    dispatch installed (grad-jit fast path) or its raw vjp closure
+    (fallback), and cotangent accumulation routes through the same cache
+    (:func:`_ct_accum`) — in steady state a train step's backward
+    executes nothing but compiled-cache hits.
+    """
     if tensor._grad_node is None:
         if not tensor.stop_gradient:
             g = (
@@ -576,42 +746,46 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph: bool = False):
     node_cts[id(root)] = [None] * len(root.out_avals)
     node_cts[id(root)][tensor._out_index] = seed_ct
 
-    order = _topo_order(root)
-    for node in reversed(order):
-        cts = node_cts.get(id(node))
+    pop = node_cts.pop
+    for node in reversed(_topo_order(root)):
+        cts = pop(id(node), None)
         if cts is None:
             continue
-        full = [
-            c if c is not None else jnp.zeros(sh, dt)
-            for c, (sh, dt) in zip(cts, node.out_avals)
-        ]
         if node.vjp_fn is None:
             raise RuntimeError(
                 "Trying to backward through the graph a second time; "
                 "pass retain_graph=True."
             )
-        in_cts = node.vjp_fn(tuple(full) if node.multi_out else full[0])
+        if node.multi_out:
+            arg = tuple(
+                c if c is not None else jnp.zeros(sh, dt)
+                for c, (sh, dt) in zip(cts, node.out_avals)
+            )
+        else:
+            arg = cts[0]
+            if arg is None:
+                sh, dt = node.out_avals[0]
+                arg = jnp.zeros(sh, dt)
+        in_cts = node.vjp_fn(arg)
         if not retain_graph:
             node.vjp_fn = None
         for t, ct in zip(node.inputs, in_cts):
-            if t is None or _is_float0(ct) or ct is None:
+            if t is None or ct is None or _is_float0(ct):
                 continue
-            if t._grad_node is not None:
-                slot = node_cts.setdefault(
-                    id(t._grad_node), [None] * len(t._grad_node.out_avals)
-                )
+            gn = t._grad_node
+            if gn is not None:
+                slot = node_cts.setdefault(id(gn), [None] * len(gn.out_avals))
                 i = t._out_index
-                slot[i] = ct if slot[i] is None else slot[i] + ct
+                slot[i] = ct if slot[i] is None else _ct_accum(slot[i], ct)
             elif not t.stop_gradient:
                 _accum_leaf(t, ct)
-        node_cts.pop(id(node), None)
 
 
 def _accum_leaf(t: Tensor, ct):
     if t.grad is None:
         t.grad = Tensor(ct)
     else:
-        t.grad = Tensor(t.grad._data + ct)
+        t.grad = Tensor(_ct_accum(t.grad._data, ct))
     for hook in _leaf_hooks.get(id(t), ()):
         hook(t)
 

@@ -143,6 +143,9 @@ DEFAULT_STATS = (
     "jit_cache_hit",      # op-level jit cache hits (PreparedOp-cache analog)
     "jit_cache_miss",     # op-level jit cache misses
     "jit_compile",        # new jax.jit wrappers built (one per miss)
+    "grad_jit_hit",       # grad-enabled dispatch: cached jitted-VJP hits
+    "grad_jit_miss",      # grad-enabled dispatch: cache misses (new aval key)
+    "grad_jit_compile",   # new fwd+vjp jit pairs built (one per miss)
     "collective_calls",   # distributed.* collective API launches
     "train_steps",        # compiled/eager training steps completed
     "nan_inf_trips",      # FLAGS_check_nan_inf violations raised
@@ -157,6 +160,9 @@ OP_DISPATCH = _registry.get_stat("op_dispatch")
 JIT_CACHE_HIT = _registry.get_stat("jit_cache_hit")
 JIT_CACHE_MISS = _registry.get_stat("jit_cache_miss")
 JIT_COMPILE = _registry.get_stat("jit_compile")
+GRAD_JIT_HIT = _registry.get_stat("grad_jit_hit")
+GRAD_JIT_MISS = _registry.get_stat("grad_jit_miss")
+GRAD_JIT_COMPILE = _registry.get_stat("grad_jit_compile")
 COLLECTIVE_CALLS = _registry.get_stat("collective_calls")
 TRAIN_STEPS = _registry.get_stat("train_steps")
 NAN_INF_TRIPS = _registry.get_stat("nan_inf_trips")
@@ -164,13 +170,41 @@ HOST_MEMORY_BYTES = _registry.get_stat("host_memory_bytes")
 DEVICE_MEMORY_BYTES = _registry.get_stat("device_memory_bytes")
 
 
+# per-mesh-axis device-memory gauges published by the last
+# update_memory_stats call ("device_memory_bytes.<axis>"); tracked so a
+# refresh can zero the axes that disappeared (mesh torn down, buffers freed)
+_mem_axis_gauges: set = set()
+
+
+def _buffer_axes(arr) -> set:
+    """Mesh axes a live buffer is sharded over (empty = replicated /
+    single-device)."""
+    spec = getattr(getattr(arr, "sharding", None), "spec", None)
+    axes = set()
+    if spec is not None:
+        for part in spec:
+            if part is None:
+                continue
+            for ax in (part if isinstance(part, (tuple, list)) else (part,)):
+                if ax is not None:
+                    axes.add(str(ax))
+    return axes
+
+
 def update_memory_stats() -> dict:
     """Refresh the host/device memory gauges and return {name: bytes}.
 
     Host side reads the process peak RSS; device side sums
     ``bytes_in_use`` over visible jax devices (not every backend reports
-    memory_stats — missing values leave the gauge unchanged).
+    memory_stats — missing values leave the gauge unchanged). Device
+    bytes are additionally SPLIT PER MESH AXIS: every live buffer's size
+    is attributed to the mesh axis (or axes) its PartitionSpec shards it
+    over — ``device_memory_bytes.data``, ``.model``, ... — with
+    unsharded buffers under ``device_memory_bytes.replicated``, so a
+    memory regression can be pinned to the parallelism dimension that
+    grew (ROADMAP monitor follow-up).
     """
+    out = {}
     try:
         import resource
 
@@ -196,5 +230,29 @@ def update_memory_stats() -> dict:
             DEVICE_MEMORY_BYTES.set(total)
     except Exception:
         pass
-    return {"host_memory_bytes": HOST_MEMORY_BYTES.get(),
-            "device_memory_bytes": DEVICE_MEMORY_BYTES.get()}
+    try:
+        import jax
+
+        per_axis: dict = {}
+        for arr in jax.live_arrays():
+            try:
+                nbytes = int(arr.nbytes)
+            except Exception:
+                continue
+            axes = _buffer_axes(arr) or {"replicated"}
+            for ax in axes:
+                per_axis[ax] = per_axis.get(ax, 0) + nbytes
+        for ax, nbytes in per_axis.items():
+            name = f"device_memory_bytes.{ax}"
+            _registry.get_stat(name).set(nbytes)
+            _mem_axis_gauges.add(name)
+            out[name] = nbytes
+        for name in _mem_axis_gauges - {
+                f"device_memory_bytes.{ax}" for ax in per_axis}:
+            _registry.get_stat(name).set(0)
+            out[name] = 0
+    except Exception:
+        pass
+    out["host_memory_bytes"] = HOST_MEMORY_BYTES.get()
+    out["device_memory_bytes"] = DEVICE_MEMORY_BYTES.get()
+    return out

@@ -15,7 +15,8 @@ from . import stats
 
 __all__ = ["TrainerMonitor"]
 
-_TRACKED = ("jit_compile", "op_dispatch", "collective_calls")
+_TRACKED = ("jit_compile", "op_dispatch", "collective_calls",
+            "grad_jit_compile")
 
 
 class TrainerMonitor:
@@ -43,12 +44,13 @@ class TrainerMonitor:
         if self._t0 is None:
             return {}
         dt = time.perf_counter() - self._t0
-        compiles, dispatches, collectives = (
+        compiles, dispatches, collectives, grad_compiles = (
             stats.stat_get(n) - m for n, m in zip(_TRACKED, self._marks))
         tele = {
             "step": self.step_idx,
             "step_time_s": dt,
             "recompiles": compiles,
+            "grad_recompiles": grad_compiles,
             "op_dispatches": dispatches,
             "collective_calls": collectives,
         }
@@ -74,6 +76,8 @@ class TrainerMonitor:
             "mean_step_time_s": sum(times) / len(times),
             "max_step_time_s": max(times),
             "total_recompiles": sum(h["recompiles"] for h in self.history),
+            "total_grad_recompiles": sum(
+                h.get("grad_recompiles", 0) for h in self.history),
         }
         ips = [h["examples_per_sec"] for h in steady
                if "examples_per_sec" in h]

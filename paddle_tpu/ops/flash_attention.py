@@ -32,6 +32,12 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _compiler_params(pltpu, **kw):
+    """pltpu.CompilerParams was TPUCompilerParams before jax 0.5."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform in ("tpu", "axon")
@@ -164,8 +170,8 @@ def _flash_forward(q, k, v, causal=False, scale=None, block_q=512,
             pltpu.VMEM((bb, bq, 128), jnp.float32),   # running sum
             pltpu.VMEM((bb, bq, d), jnp.float32),     # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
+        compiler_params=_compiler_params(
+            pltpu, vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, sq, d), lse
@@ -272,8 +278,8 @@ def _flash_backward(q, k, v, o, lse, g, causal=False, scale=None,
                                 lambda i, kk, j: (kk, i, j, 0))),
         scratch_shapes=[pltpu.VMEM((bb, bk, d), jnp.float32),
                         pltpu.VMEM((bb, bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
+        compiler_params=_compiler_params(
+            pltpu, vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(qr, kr, vr, dor, lse, delta)
 

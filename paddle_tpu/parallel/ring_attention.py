@@ -34,9 +34,37 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import get_mesh
 
+# jax.shard_map is top-level only from 0.5; 0.4.x ships it under
+# jax.experimental (same signature)
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _shard_map_call(fn, mesh, in_specs, out_specs):
+    """check_rep=False on 0.4.x (its replication checker rejects the
+    lax.switch hop branches; the newer vma typing path needs no flag and
+    has no such kwarg)."""
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    except TypeError:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 _NEG_INF = -1e30
+
+def _axis_size(axis_name):
+    """jax.lax.axis_size compat (added in jax 0.5): psum of the literal 1
+    is evaluated statically from the axis env on 0.4.x."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
 
 
 def _block_attn(q, k, v, mask, scale):
@@ -64,7 +92,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
                    scale: Optional[float] = None):
     """Blockwise ring attention; call INSIDE shard_map with the seq dim of
     q/k/v sharded over ``axis_name``. Shapes: (B, H, S_local, D)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
     if scale is None:
@@ -131,6 +159,5 @@ def ring_attention_sharded(q, k, v, causal: bool = True,
 
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal, scale=scale)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec)
+    mapped = _shard_map_call(fn, mesh, (spec, spec, spec), spec)
     return mapped(q, k, v)

@@ -37,6 +37,24 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax.shard_map is top-level only from 0.5; 0.4.x ships it under
+# jax.experimental (same signature)
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _shard_map_call(fn, mesh, in_specs, out_specs):
+    """check_rep=False on 0.4.x (its replication checker rejects the
+    lax.switch hop branches; the newer vma typing path needs no flag and
+    has no such kwarg)."""
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    except TypeError:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+
 from ..ops.flash_attention import (_attention_reference, _flash_backward,
                                    _flash_forward, _on_tpu)
 from .mesh import get_mesh
@@ -45,14 +63,38 @@ __all__ = ["ring_flash_attention", "ring_flash_attention_sharded"]
 
 _NEG = -1e30
 
+def _axis_size(axis_name):
+    """jax.lax.axis_size compat (added in jax 0.5): psum of the literal 1
+    is evaluated statically from the axis env on 0.4.x."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+
 # chunk relations (lax.switch branch indices)
 _FULL, _DIAG, _DEAD = 0, 1, 2
+
+
+def _pick_block(s, cap):
+    """Largest multiple of 128 <= cap that tiles s exactly, or None.
+
+    The flash kernels floor-divide the sequence into a grid of
+    ``s // block`` blocks — a chunk length that is NOT a multiple of the
+    block size (S_local = 640/768/896 with the default 512/1024 blocks)
+    would silently compute only the first ``n * block`` rows."""
+    for b in range(min(cap, s), 127, -128):
+        if s % b == 0:
+            return b
+    return None
 
 
 def _supported_by_kernel(q):
     b, h, s, d = q.shape
     return _on_tpu() and s >= 128 and s % 128 == 0 and \
-        (d == 64 or d % 128 == 0)
+        (d == 64 or d % 128 == 0) and \
+        _pick_block(s, 512) is not None and _pick_block(s, 1024) is not None
 
 
 # -- per-hop forward blocks: (q, k, v) -> (out, lse) -----------------------
@@ -78,7 +120,9 @@ def _block_fwd(q, k, v, causal, scale):
     """One block: normalized out + log-sum-exp, both per query row."""
     if _supported_by_kernel(q):
         b, h, s, _ = q.shape
-        out, lse = _flash_forward(q, k, v, causal=causal, scale=scale)
+        out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
+                                  block_q=_pick_block(s, 512),
+                                  block_k=_pick_block(k.shape[2], 1024))
         return out, lse.reshape(b, h, s)
     return _ref_block_fwd(q, k, v, causal, scale)
 
@@ -116,7 +160,9 @@ def _block_bwd(q, k, v, out, lse, g, causal, scale):
         b, h, sq = q.shape[0], q.shape[1], q.shape[2]
         return _flash_backward(q, k, v, out,
                                lse.reshape(b * h, sq, 1), g,
-                               causal=causal, scale=scale)
+                               causal=causal, scale=scale,
+                               block_q=_pick_block(sq, 512),
+                               block_k=_pick_block(k.shape[2], 1024))
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
     return _ref_block_bwd(q, k, v, out, lse, g, delta, causal, scale)
@@ -143,7 +189,7 @@ def _merge(o1, lse1, o2, lse2):
 
 
 def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -179,7 +225,7 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
 
 
 def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, scale):
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -282,6 +328,5 @@ def ring_flash_attention_sharded(q, k, v, causal: bool = True,
     spec = P(batch_axis, head_axis, seq_axis, None)
     fn = functools.partial(ring_flash_attention, axis_name=seq_axis,
                            causal=causal, scale=scale)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec)
+    mapped = _shard_map_call(fn, mesh, (spec, spec, spec), spec)
     return mapped(q, k, v)

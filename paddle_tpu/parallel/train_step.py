@@ -425,6 +425,11 @@ class DistributedTrainStep:
             donate_argnums=(0, 1, 2) if self._has_aux else (0, 1),
         )
         self._step_count = 0
+        # batch aval signatures already compiled for: keeps the jit
+        # cache-hit/compile gauges honest for the compiled-step path (a
+        # shape-churning data loader shows up as a jit_compile storm here
+        # exactly like an eager recompile storm does in grad_jit_compile)
+        self._seen_batch_avals: set = set()
 
     def current_lr(self) -> float:
         if callable(self._lr):
@@ -433,6 +438,15 @@ class DistributedTrainStep:
 
     def __call__(self, batch):
         lr = jnp.float32(self.current_lr())
+        sig = tuple(
+            (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "?")))
+            for x in jax.tree_util.tree_leaves(batch))
+        if sig in self._seen_batch_avals:
+            _mstats.JIT_CACHE_HIT.add()
+        else:
+            self._seen_batch_avals.add(sig)
+            _mstats.JIT_CACHE_MISS.add()
+            _mstats.JIT_COMPILE.add()
         with _trace_span("DistributedTrainStep.step", cat="step",
                          args={"step": self._step_count}):
             with self.mesh:

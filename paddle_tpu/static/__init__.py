@@ -917,11 +917,14 @@ def save(program, model_path, protocol=4, **configs):
         return np.asarray(v._data) if isinstance(v, Tensor) else v
 
     opt_state = {}
+    # ALWAYS prefix with the spec index (previously single-spec programs
+    # wrote bare keys): a checkpoint then round-trips into a program with
+    # a different optimizer-spec count — load matches by prefix and warns
+    # about the specs it cannot fill
     for i, (optimizer, _loss) in enumerate(getattr(program, "train_specs",
                                                    [])):
         sd = optimizer.state_dict()
-        opt_state.update({f"opt{i}.{k}" if len(program.train_specs) > 1
-                          else k: _np(v) for k, v in sd.items()})
+        opt_state.update({f"opt{i}.{k}": _np(v) for k, v in sd.items()})
     _save(opt_state, model_path + ".pdopt")
 
 
@@ -943,14 +946,28 @@ def load(program, model_path, executor=None, var_list=None):
         if name in by_name:
             by_name[name].set_value(np.asarray(arr))
     if var_list is None and os.path.exists(model_path + ".pdopt"):
+        import re
+        import warnings
+
         opt_state = _load(model_path + ".pdopt")
         specs = getattr(program, "train_specs", [])
+        # legacy checkpoints from single-spec programs wrote bare keys
+        # (no opt0. prefix) — detect and accept them for spec 0
+        has_prefixed = any(re.match(r"opt\d+\.", k) for k in opt_state)
         for i, (optimizer, _loss) in enumerate(specs):
-            prefix = f"opt{i}." if len(specs) > 1 else ""
+            prefix = f"opt{i}."
             sd = {k[len(prefix):]: v for k, v in opt_state.items()
-                  if k.startswith(prefix)} if prefix else dict(opt_state)
+                  if k.startswith(prefix)}
+            if not sd and i == 0 and opt_state and not has_prefixed:
+                sd = dict(opt_state)
             if sd:
                 optimizer.set_state_dict(sd)
+            elif opt_state:
+                warnings.warn(
+                    f"static.load: no optimizer-state entries under prefix "
+                    f"'{prefix}' in {model_path}.pdopt (checkpoint has "
+                    f"{len(opt_state)} entries) — optimizer spec {i} keeps "
+                    "its current state")
 
 
 def load_program_state(model_path, var_list=None):

@@ -1,0 +1,240 @@
+"""Jitted autograd fast path (ISSUE 2): the (fn, attrs, avals)-keyed
+grad-jit cache in framework/core.py — cached jitted VJP on grad-enabled
+dispatch, batched backward execution through the same cache, recompile
+gauges, and the FLAGS_eager_grad_jit escape hatch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.framework.core import apply_op
+
+GRAD_STATS = ("grad_jit_hit", "grad_jit_miss", "grad_jit_compile")
+
+
+def _reset():
+    for n in GRAD_STATS:
+        monitor.stat_reset(n)
+
+
+def _snap():
+    return {n: monitor.stat_get(n) for n in GRAD_STATS}
+
+
+@pytest.fixture(autouse=True)
+def _grad_jit_on():
+    """Every test starts (and ends) with the fast path enabled."""
+    paddle.set_flags({"FLAGS_eager_grad_jit": 1})
+    yield
+    paddle.set_flags({"FLAGS_eager_grad_jit": 1})
+
+
+class TestCacheCounters:
+    def test_repeat_dispatch_compiles_once(self):
+        """Acceptance: same fn/attrs/avals repeated => compile count 1,
+        hits thereafter."""
+        def uniquely_named_grad_op(x, w):
+            return x @ w
+
+        _reset()
+        x = paddle.to_tensor(np.ones((3, 4), np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.ones((4, 2), np.float32),
+                             stop_gradient=False)
+        for _ in range(4):
+            apply_op(uniquely_named_grad_op, x, w)
+        s = _snap()
+        assert s["grad_jit_compile"] == 1
+        assert s["grad_jit_miss"] == 1
+        assert s["grad_jit_hit"] == 3
+
+    def test_aval_keying_recompiles_per_shape(self):
+        """A new input shape is a new cache entry (recompile storms from
+        shape churn must be visible in the gauges)."""
+        def aval_keyed_grad_op(x):
+            return x * 2.0
+
+        _reset()
+        for n in (4, 8, 4, 8):
+            t = paddle.to_tensor(np.ones((n,), np.float32),
+                                 stop_gradient=False)
+            apply_op(aval_keyed_grad_op, t)
+        s = _snap()
+        assert s["grad_jit_compile"] == 2  # one per distinct aval
+        assert s["grad_jit_hit"] == 2
+
+    def test_attrs_key_distinguishes(self):
+        def attr_keyed_grad_op(x, *, k):
+            return x * k
+
+        _reset()
+        t = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        apply_op(attr_keyed_grad_op, t, k=2.0)
+        apply_op(attr_keyed_grad_op, t, k=3.0)
+        apply_op(attr_keyed_grad_op, t, k=2.0)
+        s = _snap()
+        assert s["grad_jit_compile"] == 2
+        assert s["grad_jit_hit"] == 1
+
+    def test_unhashable_attrs_fall_back(self):
+        """Array-valued attrs cannot key the cache: the op must still
+        dispatch and differentiate through the raw jax.vjp path."""
+        def unhashable_attr_op(x, *, table):
+            return x * table[0]
+
+        _reset()
+        t = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        out = apply_op(unhashable_attr_op, t, table=np.array([2.0, 5.0]))
+        out.backward()
+        assert np.allclose(t.grad.numpy(), [2.0])
+        assert _snap()["grad_jit_compile"] == 0
+
+    def test_list_attrs_are_canonicalized(self):
+        """List attrs (conv strides/paddings idiom) hash via the
+        canonical tuple form — no fallback, one entry."""
+        def list_attr_grad_op(x, *, strides):
+            return x * float(strides[0])
+
+        _reset()
+        t = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        apply_op(list_attr_grad_op, t, strides=[2, 2])
+        apply_op(list_attr_grad_op, t, strides=[2, 2])
+        s = _snap()
+        assert s["grad_jit_compile"] == 1
+        assert s["grad_jit_hit"] == 1
+
+
+class TestSteadyStateTraining:
+    def _mlp_and_batch(self):
+        paddle.seed(7)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(16, 8)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 4, (16,)).astype("int64"))
+        return net, opt, x, y
+
+    def test_steady_state_is_pure_cache_hits(self):
+        """Acceptance: after the first train step, further steps add ZERO
+        grad-jit compiles — every forward op and backward application is
+        a cache hit."""
+        net, opt, x, y = self._mlp_and_batch()
+
+        def step():
+            loss = paddle.nn.functional.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step()  # populates the cache
+        _reset()
+        for _ in range(3):
+            step()
+        s = _snap()
+        assert s["grad_jit_compile"] == 0
+        assert s["grad_jit_miss"] == 0
+        assert s["grad_jit_hit"] > 0
+
+    def test_cached_and_raw_paths_numerically_equal(self):
+        """Acceptance: grads of a small MLP via the cached jitted VJP ==
+        grads via raw jax.vjp (flag off)."""
+        def grads_with(flag):
+            paddle.set_flags({"FLAGS_eager_grad_jit": flag})
+            net, _opt, x, y = self._mlp_and_batch()
+            loss = paddle.nn.functional.cross_entropy(net(x), y)
+            loss.backward()
+            return ([p.grad.numpy().copy() for p in net.parameters()],
+                    float(loss._data))
+
+        g_cached, l_cached = grads_with(1)
+        g_raw, l_raw = grads_with(0)
+        assert np.allclose(l_cached, l_raw, atol=1e-6)
+        for a, b in zip(g_cached, g_raw):
+            assert np.allclose(a, b, atol=1e-5)
+
+    def test_escape_hatch_disables_cache(self):
+        def hatch_test_op(x):
+            return x * 4.0
+
+        paddle.set_flags({"FLAGS_eager_grad_jit": 0})
+        _reset()
+        t = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        out = apply_op(hatch_test_op, t)
+        out.backward()
+        assert np.allclose(t.grad.numpy(), np.full(4, 4.0))
+        assert _snap() == {n: 0 for n in GRAD_STATS}
+
+
+class TestBackwardSemanticsThroughCache:
+    """The autograd contract must be identical on the fast path."""
+
+    def test_backward_twice_raises_without_retain(self):
+        x = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+        y = paddle.sum(x * x)
+        y.backward(retain_graph=True)
+        y.backward()
+        with pytest.raises(RuntimeError, match="second time"):
+            y.backward()
+        assert np.allclose(x.grad.numpy(), np.full(3, 4.0))  # accumulated
+
+    def test_fanout_accumulation(self):
+        """Cotangent accumulation (the _ct_accum cache path) on a
+        branching graph."""
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        a = x * 3.0
+        y = a * a + a  # a used twice + once: d/da = 2a + 1 = 13
+        y.backward()
+        assert np.allclose(x.grad.numpy(), [39.0])  # 13 * 3
+
+    def test_double_grad_through_cached_nodes(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (g,) = paddle.grad(y, x, create_graph=True)
+        (gg,) = paddle.grad(g, x)
+        assert np.allclose(gg.numpy(), [12.0])
+
+    def test_multi_output_op_partial_use(self):
+        """Multi-output node where only some outputs feed the loss: the
+        missing cotangents are zero-filled before the cached bwd."""
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32),
+                             stop_gradient=False)
+        a, b = paddle.split(x, 2)
+        loss = paddle.sum(a * 5.0)  # b unused
+        loss.backward()
+        expect = np.concatenate([np.full(4, 5.0), np.zeros(4)])
+        assert np.allclose(x.grad.numpy(), expect)
+
+    def test_int_inputs_get_no_cotangent(self):
+        """float0 cotangents from the jitted bwd are skipped exactly like
+        the raw path's."""
+        x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"),
+                             stop_gradient=False)
+        idx = paddle.to_tensor(np.array([0, 2], np.int64))
+        out = paddle.gather(x, idx)
+        paddle.sum(out).backward()
+        assert x.grad is not None
+        assert np.allclose(x.grad.numpy()[1], 0.0)
+
+    def test_benchmark_table_records_grad_compiles(self):
+        """FLAGS_benchmark surfaces per-op compile time for cache misses."""
+        def benched_grad_op(x):
+            return x + 1.5
+
+        monitor.benchmark_reset()
+        t = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        paddle.set_flags({"FLAGS_benchmark": 1})
+        try:
+            out = apply_op(benched_grad_op, t, op_name="benched_grad_op")
+            out.backward()
+        finally:
+            paddle.set_flags({"FLAGS_benchmark": 0})
+        rows = {r["op"] for r in monitor.benchmark_rows()}
+        assert "benched_grad_op@grad_jit_compile" in rows
+        assert "benched_grad_op@grad_jit_bwd_compile" in rows

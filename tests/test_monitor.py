@@ -64,6 +64,34 @@ class TestStatRegistry:
         out = monitor.update_memory_stats()
         assert out["host_memory_bytes"] > 0  # RSS of a live jax process
 
+    def test_grad_jit_gauges_registered(self):
+        for name in ("grad_jit_hit", "grad_jit_miss", "grad_jit_compile"):
+            assert name in monitor.DEFAULT_STATS
+            assert name in monitor.stat_names()
+
+    def test_device_memory_split_per_mesh_axis(self):
+        """ROADMAP monitor follow-up: device bytes attributed to the mesh
+        axis each live buffer is sharded over, not just a global sum."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("bench_ax",))
+        arr = jax.device_put(jnp.ones((64, 64), jnp.float32),
+                             NamedSharding(mesh, P("bench_ax")))
+        out = monitor.update_memory_stats()
+        assert out.get("device_memory_bytes.bench_ax", 0) >= arr.nbytes
+        assert monitor.stat_get(
+            "device_memory_bytes.bench_ax") >= arr.nbytes
+        # an unsharded buffer lands in the replicated bucket
+        plain = jnp.ones((32,), jnp.float32) + 0.0
+        out = monitor.update_memory_stats()
+        assert out.get("device_memory_bytes.replicated", 0) >= plain.nbytes
+        # once the sharded buffer dies, a refresh zeroes its axis gauge
+        del arr
+        out = monitor.update_memory_stats()
+        assert out.get("device_memory_bytes.bench_ax", 0) == 0
+
 
 class TestJitCacheCounters:
     def test_two_identical_apply_ops_one_compile(self):
