@@ -302,12 +302,32 @@ def bench_ring_attention(on_accel):
 
 # -- eager-TrainStep configs (dispatch included: the eager user's view) ----
 
+def _rtt_ms(reps=15):
+    """Median dispatch+sync round-trip of a trivial device op — the
+    axon-tunnel RTT floor an eager step pays that a local-host deployment
+    would not. Published alongside the eager numbers so the dispatch cost
+    and the tunnel cost are separable (ISSUE 3 LeNet methodology)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.zeros((8,), jnp.float32)
+    jax.block_until_ready(x + 1)  # warm the kernel
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(x + 1)
+        times.append(time.perf_counter() - t0)
+    return 1e3 * float(np.median(times))
+
+
 def _eager_and_device_sps(model, loss_fn, opt, batch_tensors, batch,
-                          on_accel, K=10, eager_iters=15):
+                          on_accel, K=10, eager_iters=15, eager_runs=1):
     """Measure BOTH views of a TrainStep config: per-call eager dispatch
     (what an eager user pays, including axon-tunnel RTT here) and K steps
     inside one jit (pure device time — the steady-state number the A100
-    DeepLearningExamples baselines report)."""
+    DeepLearningExamples baselines report). ``eager_runs`` repeats the
+    eager measurement for a median + variance band (the tunnel makes
+    single runs vary ~2x). Returns (eager_sps_runs: list, device_sps)."""
     import functools as _ft
 
     import jax
@@ -320,11 +340,13 @@ def _eager_and_device_sps(model, loss_fn, opt, batch_tensors, batch,
         loss = step(*batch_tensors)
     float(loss._data)
     n = eager_iters if on_accel else 3
-    t0 = time.perf_counter()
-    for _ in range(n):
-        loss = step(*batch_tensors)
-    float(loss._data)
-    eager_sps = batch / ((time.perf_counter() - t0) / n)
+    eager_runs_sps = []
+    for _ in range(max(1, eager_runs)):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step(*batch_tensors)
+        float(loss._data)
+        eager_runs_sps.append(batch / ((time.perf_counter() - t0) / n))
 
     impl = step._step_impl
     lr = float(opt.get_lr())
@@ -352,7 +374,7 @@ def _eager_and_device_sps(model, loss_fn, opt, batch_tensors, batch,
         out = k_steps(*out)
         jax.block_until_ready(out[0])
         best = min(best, (time.perf_counter() - t0) / (K if on_accel else 2))
-    return eager_sps, batch / best
+    return eager_runs_sps, batch / best
 
 
 def _eager_tape_sps(model, opt, batch_tensors, batch, iters):
@@ -415,11 +437,28 @@ def bench_lenet(on_accel):
     labels = paddle.to_tensor(rng.integers(0, 10, (batch,)).astype("int64"))
     tape_sps, tape_stats = _eager_tape_sps(model, opt, (images, labels),
                                            batch, 10 if on_accel else 3)
-    eager_sps, device_sps = _eager_and_device_sps(
+    # >=5 eager runs for a median + band (single runs vary ~2x through the
+    # tunnel) plus the measured RTT floor, so the published number
+    # separates framework dispatch cost from tunnel latency
+    runs, device_sps = _eager_and_device_sps(
         model, loss_fn, opt, (images, labels), batch, on_accel, K=50,
-        eager_iters=30)
-    return eager_sps, device_sps, {"sps": round(tape_sps, 2),
-                                   "grad_jit": tape_stats}
+        eager_iters=30, eager_runs=5 if on_accel else 2)
+    rtt = _rtt_ms()
+    eager = {
+        "median_sps": round(float(np.median(runs)), 2),
+        "band_sps": [round(min(runs), 2), round(max(runs), 2)],
+        "runs": len(runs),
+        "rtt_ms": round(rtt, 3),
+    }
+    # RTT-corrected eager throughput: subtract the measured tunnel
+    # round-trip from the median step time, floored at the pure device
+    # step — models what a LOCAL host would see from the same dispatch
+    # path (the derived baseline assumes local ~us-scale launches)
+    med_step = batch / eager["median_sps"]
+    corr_step = max(med_step - rtt / 1e3, batch / device_sps)
+    eager["rtt_corrected_sps"] = round(batch / corr_step, 2)
+    return eager, device_sps, {"sps": round(tape_sps, 2),
+                               "grad_jit": tape_stats}
 
 
 def bench_resnet50(on_accel):
@@ -453,8 +492,10 @@ def bench_resnet50(on_accel):
     images = paddle.to_tensor(
         rng.normal(size=(batch, 3, size, size)).astype("float32"))
     labels = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype("int64"))
-    return _eager_and_device_sps(model, loss_fn, opt, (images, labels),
-                                 batch, on_accel, K=10, eager_iters=15)
+    runs, device_sps = _eager_and_device_sps(
+        model, loss_fn, opt, (images, labels), batch, on_accel, K=10,
+        eager_iters=15)
+    return float(np.median(runs)), device_sps
 
 
 def main():
@@ -551,25 +592,34 @@ def main():
         try:
             lenet_eager, lenet_dev, lenet_tape = bench_lenet(on_accel)
             configs["mnist_lenet"] = {
-                "sps": round(lenet_eager, 2),
+                "sps": lenet_eager["median_sps"],
+                "eager": lenet_eager,  # median/band/runs/rtt_ms/corrected
                 "device_sps": round(lenet_dev, 2),
                 "eager_tape": lenet_tape,
-                "vs_baseline": round(lenet_eager / LENET_A100_BASELINE, 4),
-                # the derived baseline models LOCAL ~50us/op dispatch; the
-                # axon tunnel adds ~ms RTT per eager step that a local-host
-                # deployment would not pay — the device figure is the
-                # dispatch-free bound
+                # vs_baseline uses the RTT-corrected eager figure: the
+                # derived baseline models LOCAL ~50us/op dispatch, and the
+                # axon tunnel's ~ms per-step RTT is an environment cost a
+                # local-host deployment would not pay. The raw-median and
+                # device-loop ratios are published alongside.
+                "vs_baseline": round(
+                    lenet_eager["rtt_corrected_sps"] / LENET_A100_BASELINE, 4),
+                "vs_baseline_raw_eager": round(
+                    lenet_eager["median_sps"] / LENET_A100_BASELINE, 4),
                 "vs_baseline_device": round(lenet_dev / LENET_A100_BASELINE, 4),
                 "baseline": "derived: eager dispatch model ~50us/op x ~60 "
                             "ops => ~3ms/step, batch 256 => ~85k img/s on "
                             "A100-class eager frameworks (no published LeNet "
                             "benchmark exists)",
-                "note": "eager sps includes per-step axon-tunnel RTT (~2x "
-                        "run-to-run variance); device_sps is the "
-                        "dispatch-corrected figure (50 steps in one jit); "
-                        "eager_tape is the per-op tape path through the "
-                        "grad-jit cache (steady state: grad_jit_compile "
-                        "delta 0)"}
+                "note": "eager = median + [min,max] band over >=5 runs of "
+                        "the FLAGS_fast_step donated async TrainStep "
+                        "(dispatch pipelined, loss read once per run); "
+                        "rtt_ms is the measured axon-tunnel round-trip and "
+                        "rtt_corrected_sps removes it from the median step "
+                        "(floored at the device-loop step), which is what "
+                        "vs_baseline scores; device_sps is 50 steps in one "
+                        "jit; eager_tape is the per-op tape path through "
+                        "the grad-jit cache (steady state: "
+                        "grad_jit_compile delta 0)"}
         except Exception as e:  # noqa: BLE001 — auxiliary config must not kill the bench
             configs["mnist_lenet"] = f"error: {type(e).__name__}: {e}"
         try:
@@ -613,7 +663,42 @@ def main():
         "flash_ab": flash_ab,
         "configs": configs,
     }
-    print(json.dumps(out))
+    # every completed config carries value + mfu keys in the artifact
+    for cfg_ in configs.values():
+        if isinstance(cfg_, dict):
+            cfg_.setdefault("value", cfg_.get("sps"))
+            cfg_.setdefault("mfu", None)
+
+    # Truncation-proofing (r5 lost gpt_760m_adamw this way): the driver
+    # keeps only the TAIL of stdout, so a single huge json line loses its
+    # FRONT keys. Full results go to BENCH_OUT.json on disk; stdout ends
+    # with a compact digest — headline + per-config value/mfu/vs_baseline
+    # only, a few hundred bytes that always survive the tail capture.
+    out_path = os.environ.get(
+        "BENCH_OUT", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "BENCH_OUT.json"))
+    try:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError as e:
+        out["bench_out_error"] = repr(e)
+
+    def _digest(c):
+        if not isinstance(c, dict):
+            return str(c)[:60]
+        return {k: c[k] for k in ("value", "mfu", "vs_baseline",
+                                  "device_sps", "rtt_corrected_sps")
+                if c.get(k) is not None}
+
+    compact = {
+        "metric": out["metric"], "value": out["value"], "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"], "mfu": out["mfu"],
+        "configs": {k: _digest(v) for k, v in configs.items()},
+        "flash_ab": {k: (v.get("sps") if isinstance(v, dict) else str(v)[:40])
+                     for k, v in flash_ab.items()},
+        "detail": "BENCH_OUT.json",
+    }
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

@@ -106,6 +106,22 @@ def _truthy(value) -> bool:
     return str(value).lower() in ("1", "true", "yes", "on")
 
 
+# Fast-path mirror of FLAGS_use_shared_memory (ISSUE 3 — the reference's
+# fluid/dataloader flags.use_shared_memory): multiprocess DataLoader
+# workers ship batches through a shared-memory ring instead of pickling
+# them over pipes. Default ON; the pipe path stays the automatic fallback
+# for non-numpy payloads and platform errors.
+use_shared_memory = [_truthy(os.environ.get("FLAGS_use_shared_memory", "1"))]
+
+# Fast-path mirror of FLAGS_fast_step (ISSUE 3): donated async train-step
+# fast path — params/opt-state stay device-resident across steps with
+# buffer donation, the step is dispatched without blocking, and reading
+# the loss is the only sync point (counted by the step_async_syncs gauge).
+# `paddle.set_flags({"FLAGS_fast_step": 0})` restores the per-step
+# writeback + per-step host scalar paths.
+fast_step = [_truthy(os.environ.get("FLAGS_fast_step", "1"))]
+
+
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
         check_nan_inf[0] = _truthy(value)
@@ -113,6 +129,10 @@ def set_flag(name: str, value) -> None:
         benchmark[0] = _truthy(value)
     elif name.endswith("eager_grad_jit"):
         eager_grad_jit[0] = _truthy(value)
+    elif name.endswith("use_shared_memory"):
+        use_shared_memory[0] = _truthy(value)
+    elif name.endswith("fast_step"):
+        fast_step[0] = _truthy(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

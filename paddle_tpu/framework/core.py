@@ -43,6 +43,7 @@ from . import dtype as dtypes
 __all__ = [
     "Tensor",
     "Parameter",
+    "AsyncLoss",
     "apply_op",
     "backward",
     "grad",
@@ -324,6 +325,59 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+
+
+class AsyncLoss(Tensor):
+    """Loss handle from an async (FLAGS_fast_step) train step.
+
+    The step's XLA program is dispatched but NOT awaited; the handle
+    behaves like any scalar Tensor, and the first host read (float()/
+    numpy()/item()/bool()) is the sync point — counted once per handle by
+    the ``step_async_syncs`` gauge, so a training loop that accidentally
+    materializes every step shows up as step_async_syncs == train_steps.
+    """
+
+    __slots__ = ("_synced",)
+
+    def __init__(self, data, name=None):
+        super().__init__(data, stop_gradient=True, name=name)
+        self._synced = False
+
+    def _materialize(self):
+        if not self._synced:
+            self._synced = True
+            _mstats.STEP_ASYNC_SYNCS.add()
+
+    def numpy(self):
+        self._materialize()
+        return super().numpy()
+
+    def item(self, *args):
+        self._materialize()
+        return super().item(*args)
+
+    def tolist(self):
+        self._materialize()
+        return super().tolist()
+
+    def __float__(self):
+        self._materialize()
+        return super().__float__()
+
+    def __int__(self):
+        self._materialize()
+        return super().__int__()
+
+    def __bool__(self):
+        self._materialize()
+        return super().__bool__()
+
+    def __array__(self, dtype=None):
+        # unlike base Tensor, the loss handle cooperates with np.asarray /
+        # np.testing directly (it is a read-only scalar result)
+        self._materialize()
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

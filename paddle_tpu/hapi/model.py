@@ -16,8 +16,9 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..framework.core import Tensor, backward
-from ..io import DataLoader
+from ..core.native import fast_step as _fast_step
+from ..framework.core import AsyncLoss, Tensor, backward
+from ..io import DataLoader, DevicePrefetcher
 from ..metric import Metric
 from ..monitor.trace import span as _trace_span
 from ..nn.layer.layers import Layer
@@ -121,11 +122,15 @@ class Model:
 
         return TrainStep(self.network, loss_fn, self._optimizer)
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, sync=True):
+        """One training step. ``sync=False`` (the fit() fast path) returns
+        the loss as an un-awaited AsyncLoss handle instead of a float —
+        the device step is dispatched and the host moves on; reading the
+        handle is the sync point."""
         with _trace_span("Model.train_batch", cat="step"):
-            return self._train_batch_impl(inputs, labels, update)
+            return self._train_batch_impl(inputs, labels, update, sync)
 
-    def _train_batch_impl(self, inputs, labels=None, update=True):
+    def _train_batch_impl(self, inputs, labels=None, update=True, sync=True):
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
@@ -144,6 +149,8 @@ class Model:
 
             if isinstance(self._optimizer._learning_rate, LRScheduler):
                 pass  # stepped by LRScheduler callback
+            if not sync and isinstance(loss, AsyncLoss):
+                return [loss]
             return [float(loss.numpy())]
         outputs = self.network(*inputs)
         loss = self._loss(outputs, *labels)
@@ -227,23 +234,53 @@ class Model:
             metrics=["loss"] + [n for m in self._metrics for n in _to_list(m.name())])
         self.stop_training = False
         cbks.on_train_begin({})
+        # FLAGS_fast_step input-and-step fast path: batches are device_put
+        # one step ahead (double buffering — the H2D copy of batch N+1
+        # overlaps step N) and the per-step loss is kept as an un-awaited
+        # AsyncLoss handle; the host only blocks on it at log_freq
+        # boundaries and at epoch end, so steps pipeline instead of paying
+        # a device round-trip each (step_async_syncs counts the blocks).
+        fast = _fast_step[0] and getattr(self, "_static", None) is None
+        loss_val = None
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch, {})
-            for step, batch in enumerate(train_loader):
+            epoch_iter = (DevicePrefetcher(train_loader, size=2) if fast
+                          else train_loader)
+            pending = None
+            for step, batch in enumerate(epoch_iter):
                 cbks.on_train_batch_begin(step, {})
                 *ins, label = batch if isinstance(batch, (list, tuple)) else (batch,)
-                losses = self.train_batch(ins, [label])
-                logs = {"loss": losses[0]}
+                losses = self.train_batch(ins, [label], sync=not fast)
+                raw = losses[0]
+                if isinstance(raw, Tensor):
+                    pending = raw
+                    if step % log_freq == 0 or (
+                            num_iters is not None and step + 1 >= num_iters):
+                        loss_val = float(raw)
+                else:
+                    loss_val = raw
+                logs = {"loss": loss_val}
                 cbks.on_train_batch_end(step, logs)
                 if num_iters is not None and step + 1 >= num_iters:
                     break
+            if pending is not None:  # epoch-end logs carry the real value
+                loss_val = float(pending)
+                logs = {"loss": loss_val}
+            self._sync_train_step()
             cbks.on_epoch_end(epoch, logs if steps else {})
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
                               callbacks=cbks)
             if self.stop_training:
                 break
+        self._sync_train_step()
         cbks.on_train_end({})
+
+    def _sync_train_step(self):
+        """Flush the fast path's lazily-deferred optimizer-slot mirrors so
+        state_dict()/save() readers see current device state."""
+        if self._train_step is not None and hasattr(self._train_step, "sync"):
+            self._train_step.sync()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
@@ -299,6 +336,7 @@ class Model:
     def save(self, path, training=True):
         from ..framework.io import save
 
+        self._sync_train_step()
         save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             save(self._optimizer.state_dict(), path + ".pdopt")

@@ -15,6 +15,7 @@ from typing import Iterable, List, Optional
 
 import numpy as np
 
+from ..core.native import use_shared_memory as _shm_flag
 from ..framework import random as grandom
 from ..framework.core import Tensor
 
@@ -23,6 +24,7 @@ __all__ = [
     "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
     "RandomSampler", "WeightedRandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "get_worker_info",
+    "DevicePrefetcher", "prefetch_to_device",
 ]
 
 
@@ -291,27 +293,53 @@ def _dataset_holds_device_arrays(ds, depth=0) -> bool:
     return False
 
 
-def _mp_worker_loop(wid, nw, dataset, worker_init_fn, in_q, out_q):
+def _mp_worker_loop(wid, nw, dataset, worker_init_fn, in_q, out_q,
+                    ring_cfg=None, stop_event=None):
     """DataLoader child-process loop (module-level so spawn can pickle it).
 
-    numpy-only in the child: never touches XLA."""
+    numpy-only in the child: never touches XLA. With ``ring_cfg`` the
+    worker ships batches through the shared-memory ring (descriptors only
+    on the queue — see shm_ring.py); a batch the ring can't take (non-
+    numpy leaves, bigger than a slot) falls back to the pickled payload
+    for that batch only."""
     import pickle
+
+    from .shm_ring import WorkerRing
 
     _worker_info[0] = _WorkerInfo(wid, nw, dataset)
     if worker_init_fn is not None:
         worker_init_fn(wid)
-    while True:
-        job = in_q.get()
-        if job is None:
-            break
-        seq, idxs = job
+    ring = None
+    if ring_cfg is not None:
         try:
-            samples = [_to_numpy_tree(dataset[i]) for i in idxs]
-            batch = _numpy_collate_fn(samples)
-            payload = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
-            out_q.put((seq, payload, None))
-        except Exception as e:  # noqa: BLE001
-            out_q.put((seq, None, repr(e)))
+            ring = WorkerRing(ring_cfg)
+        except Exception:  # platform error → pipe transport
+            ring = None
+    try:
+        while True:
+            job = in_q.get()
+            if job is None:
+                break
+            seq, idxs = job
+            try:
+                samples = [_to_numpy_tree(dataset[i]) for i in idxs]
+                batch = _numpy_collate_fn(samples)
+                desc = None
+                if ring is not None:
+                    desc = ring.put_batch(batch, stop_event)
+                if desc is not None:
+                    out_q.put((seq, ("shm", desc), None))
+                else:
+                    if stop_event is not None and stop_event.is_set():
+                        break
+                    payload = pickle.dumps(
+                        batch, protocol=pickle.HIGHEST_PROTOCOL)
+                    out_q.put((seq, payload, None))
+            except Exception as e:  # noqa: BLE001
+                out_q.put((seq, None, repr(e)))
+    finally:
+        if ring is not None:
+            ring.close()
 
 
 def _numpy_collate_fn(batch):
@@ -357,15 +385,20 @@ class DataLoader:
     fluid/dataloader/dataloader_iter.py + worker.py) behind ``num_workers``.
 
     num_workers>0 forks/spawns a worker pool: children index the dataset
-    and collate IN NUMPY (never touching XLA), pickle batches over mp
-    queues, and a reader thread pushes them through the NATIVE blocking
-    queue (core/csrc/ptpu_core.cc, the LoDTensorBlockingQueue analog) for
+    and collate IN NUMPY (never touching XLA), ship batches through the
+    SHARED-MEMORY ring (shm_ring.py — descriptors only on the queue, the
+    reference's flags.use_shared_memory transport; pickled pipe payloads
+    remain the automatic per-batch/per-epoch fallback and the
+    `use_shared_memory=False` / FLAGS_use_shared_memory=0 path), and a
+    reader thread pushes frames through the NATIVE blocking queue
+    (core/csrc/ptpu_core.cc, the LoDTensorBlockingQueue analog) for
     bounded prefetch — so a PIL/augmentation-heavy pipeline escapes the
     GIL and scales with workers (tests/test_native_core.py pins >=2x at 4
-    workers). Falls back to a prefetch THREAD when multiprocessing can't
-    preserve semantics: custom collate_fn (sees in-process Tensors),
-    IterableDataset sharding, device arrays reachable from the dataset
-    (fork-after-XLA hazard), or an unpicklable dataset under spawn.
+    workers; tests/test_io_fastpath.py pins shm >= 1.5x pipe). Falls back
+    to a prefetch THREAD when multiprocessing can't preserve semantics:
+    custom collate_fn (sees in-process Tensors), IterableDataset
+    sharding, device arrays reachable from the dataset (fork-after-XLA
+    hazard), or an unpicklable dataset under spawn.
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
@@ -425,8 +458,9 @@ class DataLoader:
         # fork workers only when safe AND semantics-preserving: the default
         # collate (custom collate_fns see Tensors in-process — the threaded
         # path keeps that contract) and no device buffers reachable from
-        # the dataset (fork-after-XLA-init hazard).
-        if self.use_shared_memory and not self._iterable_ds \
+        # the dataset (fork-after-XLA-init hazard). Transport (shared
+        # memory vs pipe) is chosen inside _iter_multiprocess.
+        if not self._iterable_ds \
                 and self.batch_sampler is not None \
                 and self.collate_fn is default_collate_fn \
                 and not _dataset_holds_device_arrays(self.dataset) \
@@ -505,25 +539,52 @@ class DataLoader:
         return mp.get_context(
             "forkserver" if "forkserver" in methods else "spawn")
 
+    def _make_ring(self, ctx, batches, nw):
+        """Build the shared-memory ring when the transport is enabled; any
+        failure (flag off, platform without shm, probe error) returns None
+        and the epoch runs on the pipe transport."""
+        if not (self.use_shared_memory and _shm_flag[0]):
+            return None
+        try:
+            from .shm_ring import ShmRing, estimate_slot_bytes
+
+            sample = _to_numpy_tree(self.dataset[batches[0][0]])
+            slot_bytes = estimate_slot_bytes(
+                sample, max(len(b) for b in batches))
+            return ShmRing(ctx, n_slots=self.prefetch_factor * nw,
+                           slot_bytes=slot_bytes)
+        except Exception:  # noqa: BLE001 — fall back to pipes
+            return None
+
     def _iter_multiprocess(self):
         """True multiprocess workers — the reference's dataloader_iter.py
-        worker pool. Workers pickle collated batches over mp queues; a
-        reader thread pushes them through the NATIVE blocking queue
-        (core/csrc/ptpu_core.cc — the LoDTensorBlockingQueue analog) which
-        provides the bounded prefetch/flow control; the main iterator pops
-        and deserialises in sampler order."""
+        worker pool. Transport: workers write numpy batches into the
+        shared-memory ring and ship only descriptors (shm_ring.py — the
+        reference's flags.use_shared_memory path), falling back to pickled
+        payloads per batch (non-numpy leaves, oversized batch) or per
+        epoch (flag off, ring setup failure). Either way a reader thread
+        pushes frames through the NATIVE blocking queue (core/csrc/
+        ptpu_core.cc, the LoDTensorBlockingQueue analog) for bounded
+        prefetch; the main iterator pops and decodes in sampler order."""
         from ..core import BlockingQueue
+        from ..monitor import stats as _mstats
+        from .shm_ring import (KIND_ERROR, KIND_PICKLE, KIND_SHM, dumps_desc,
+                               loads_desc)
 
         ctx = self._mp_context()
         batches = list(self.batch_sampler)
         nw = max(1, self.num_workers)
         in_queues = [ctx.Queue() for _ in range(nw)]
         out_queue = ctx.Queue(maxsize=self.prefetch_factor * nw)
+        ring = self._make_ring(ctx, batches, nw)
+        stop_event = ctx.Event()
+        ring_cfg = ring.worker_config() if ring is not None else None
 
         worker_init = getattr(self, "worker_init_fn", None)
         procs = [ctx.Process(
             target=_mp_worker_loop,
-            args=(w, nw, self.dataset, worker_init, in_queues[w], out_queue),
+            args=(w, nw, self.dataset, worker_init, in_queues[w], out_queue,
+                  ring_cfg, stop_event),
             daemon=True) for w in range(nw)]
         for p in procs:
             p.start()
@@ -533,8 +594,9 @@ class DataLoader:
             q_.put(None)
 
         # native bounded buffer: reader thread drains the mp queue into it;
-        # a fixed 9-byte header (seq:int64, err:u8) prefixes the payload so
-        # the already-pickled batch bytes are never re-serialized
+        # a fixed 9-byte header (seq:int64, kind:u8) prefixes the payload —
+        # pickled batch bytes are never re-serialized, shm descriptors stay
+        # tiny (the batch bytes never touch a pipe at all)
         import struct
 
         native_q = BlockingQueue(capacity=self.prefetch_factor * nw)
@@ -553,7 +615,8 @@ class DataLoader:
                 except _q.Empty:
                     if all(not p.is_alive() for p in procs):
                         dead = [p.exitcode for p in procs]
-                        body = struct.pack("<qB", -1 & 0x7FFFFFFFFFFFFFFF, 1) + (
+                        body = struct.pack(
+                            "<qB", -1 & 0x7FFFFFFFFFFFFFFF, KIND_ERROR) + (
                             f"all workers exited (exitcodes={dead}) with "
                             f"{n_total - done} batches outstanding").encode()
                         try:
@@ -564,9 +627,12 @@ class DataLoader:
                     continue
                 done += 1
                 if err is not None:
-                    body = struct.pack("<qB", seq, 1) + err.encode()
+                    body = struct.pack("<qB", seq, KIND_ERROR) + err.encode()
+                elif isinstance(payload, tuple) and payload[0] == "shm":
+                    body = struct.pack("<qB", seq, KIND_SHM) + \
+                        dumps_desc(payload[1])
                 else:
-                    body = struct.pack("<qB", seq, 0) + payload
+                    body = struct.pack("<qB", seq, KIND_PICKLE) + payload
                 try:
                     if not native_q.push(body):
                         return  # closed by consumer — stop draining
@@ -584,18 +650,34 @@ class DataLoader:
                 item = native_q.pop()
                 if item is None:
                     break
-                seq, is_err = struct.unpack_from("<qB", item)
-                if is_err:
+                seq, kind = struct.unpack_from("<qB", item)
+                if kind == KIND_ERROR:
                     raise RuntimeError(
                         f"DataLoader worker failed: {item[9:].decode()}")
-                pending[seq] = item[9:]
+                if kind == KIND_SHM:
+                    desc = loads_desc(item[9:])
+                    # copy out + recycle the slot IMMEDIATELY even when the
+                    # frame is out of order — a slot parked behind an
+                    # earlier seq would starve the writers
+                    pending[seq] = ring.read_batch(desc)
+                    _mstats.SHM_BATCHES.add()
+                    if desc[2]:
+                        _mstats.SHM_RING_FULL.add()
+                else:
+                    pending[seq] = pk.loads(item[9:])
                 while next_seq in pending:
-                    yield _from_numpy_tree(pk.loads(pending.pop(next_seq)))
+                    yield _from_numpy_tree(pending.pop(next_seq))
                     next_seq += 1
         finally:
+            stop_event.set()
             native_q.close()
             for p in procs:
                 p.join(timeout=5)
                 if p.is_alive():
                     p.terminate()
             rt.join(timeout=5)
+            if ring is not None:
+                ring.close()
+
+
+from .prefetch import DevicePrefetcher, prefetch_to_device  # noqa: E402

@@ -1,0 +1,268 @@
+"""Shared-memory ring transport for multiprocess DataLoader workers.
+
+The pipe transport pickles every collated batch and pushes the bytes
+through an mp queue — one serialize copy, two pipe copies, one
+deserialize copy per batch. The reference avoids this with shared memory
+(fluid/dataloader/worker.py + flags.use_shared_memory: workers place
+tensors in mmap'd segments and ship only descriptors). This module is
+the TPU build's equivalent:
+
+- the PARENT creates a ring of ``multiprocessing.shared_memory`` slots
+  (``prefetch_factor * num_workers`` of them) and a free-slot queue;
+- a WORKER claims a slot index (the queue token confers exclusive
+  ownership — that IS the flow control), writes the batch's numpy leaves
+  into the slot, and sends only a tiny descriptor (slot, leaf offsets/
+  shapes/dtypes skeleton) through the normal result queue;
+- the PARENT copies the leaves out and releases the slot index back to
+  the free queue (slot recycling).
+
+Only the parent ever CREATES or unlinks segments — attaching processes
+unregister from the resource tracker (pre-3.13 Python registers on
+attach too, and a worker's exit would otherwise unlink segments the
+parent still uses). A batch whose leaves aren't plain numpy arrays (or
+whose total size exceeds the slot) falls back to the pipe payload for
+that batch only; platform errors during ring setup disable the ring for
+the epoch. ``FLAGS_use_shared_memory=0`` turns the transport off.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import uuid
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+_ALIGN = 64  # leaf offsets aligned for cheap vectorized copies
+
+# descriptor kinds on the wire (the byte after the seq header)
+KIND_PICKLE = 0   # payload is a pickled batch (pipe transport)
+KIND_ERROR = 1    # payload is an error string
+KIND_SHM = 2      # payload is a pickled (slot, skeleton, waited) descriptor
+
+
+def _attach(name: str):
+    """Attach an existing segment. Pre-3.13 SharedMemory registers with
+    the resource tracker on attach too, but the tracker process is SHARED
+    across the worker pool (inherited fd), so the duplicate registration
+    is an idempotent set-add: the name stays tracked until the parent's
+    unlink unregisters it once, and a crashed run still gets cleaned up
+    at tracker shutdown. Unregistering here instead would cancel the
+    parent's registration and double-unregister at close."""
+    from multiprocessing import shared_memory
+
+    return shared_memory.SharedMemory(name=name)
+
+
+class ShmRing:
+    """Parent-side ring owner: creates slots, recycles them, reads batches."""
+
+    def __init__(self, ctx, n_slots: int, slot_bytes: int):
+        from multiprocessing import shared_memory
+
+        self.slot_bytes = int(slot_bytes)
+        self.prefix = f"ptpu_{os.getpid()}_{uuid.uuid4().hex[:8]}_"
+        self._segments = []
+        try:
+            for i in range(n_slots):
+                seg = shared_memory.SharedMemory(
+                    name=f"{self.prefix}{i}", create=True,
+                    size=self.slot_bytes)
+                # pre-touch: force physical page allocation NOW (setup,
+                # amortized) instead of zero-fill faulting inside the
+                # first worker writes (steady state)
+                mv = np.ndarray((self.slot_bytes,), np.uint8,
+                                buffer=seg.buf)
+                mv[::4096] = 0
+                del mv
+                self._segments.append(seg)
+        except Exception:
+            self.close()
+            raise
+        self.free_slots = ctx.Queue()
+        for i in range(n_slots):
+            self.free_slots.put(i)
+
+    def worker_config(self) -> dict:
+        """Picklable config handed to each worker process."""
+        return {"prefix": self.prefix, "slot_bytes": self.slot_bytes,
+                "free_slots": self.free_slots}
+
+    def read_batch(self, desc) -> Any:
+        """Decode a KIND_SHM descriptor: copy leaves out of the slot, then
+        recycle it. The copy is what bounds slot occupancy — the batch
+        handed downstream owns its own memory."""
+        slot, skeleton, _waited = desc
+        buf = self._segments[slot].buf
+        batch = _decode(skeleton, buf)
+        self.free_slots.put(slot)
+        return batch
+
+    def close(self):
+        for seg in getattr(self, "_segments", []):
+            try:
+                seg.close()
+            except Exception:
+                pass
+            try:
+                seg.unlink()
+            except Exception:
+                pass
+        self._segments = []
+
+
+# -- batch <-> slot encoding ------------------------------------------------
+#
+# The skeleton mirrors the batch pytree with every ndarray leaf replaced by
+# ("__shm__", offset, shape, dtype_str); scalars ride along inline. A
+# non-encodable leaf aborts the attempt (caller falls back to pickle).
+
+class _NotShmable(Exception):
+    pass
+
+
+def _plan(tree, offset: int) -> Tuple[Any, int, List[Tuple[int, np.ndarray]]]:
+    if isinstance(tree, np.ndarray):
+        off = (offset + _ALIGN - 1) // _ALIGN * _ALIGN
+        if tree.dtype == object:
+            raise _NotShmable
+        return (("__shm__", off, tree.shape, tree.dtype.str),
+                off + tree.nbytes, [(off, tree)])
+    if isinstance(tree, (list, tuple)):
+        out, writes = [], []
+        for v in tree:
+            sk, offset, w = _plan(v, offset)
+            out.append(sk)
+            writes.extend(w)
+        return type(tree)(out), offset, writes
+    if isinstance(tree, dict):
+        out, writes = {}, []
+        for k, v in tree.items():
+            sk, offset, w = _plan(v, offset)
+            out[k] = sk
+            writes.extend(w)
+        return out, offset, writes
+    if tree is None or isinstance(tree, (bool, int, float, str, bytes,
+                                         np.integer, np.floating)):
+        return tree, offset, []
+    raise _NotShmable
+
+
+def encode_into(batch, buf, slot_bytes: int) -> Optional[Any]:
+    """Write batch leaves into ``buf``; returns the skeleton, or None when
+    the batch isn't shm-shippable (non-numpy leaf / doesn't fit)."""
+    try:
+        skeleton, total, writes = _plan(batch, 0)
+    except _NotShmable:
+        return None
+    if total > slot_bytes:
+        return None
+    for off, arr in writes:
+        dst = np.ndarray(arr.shape, arr.dtype, buffer=buf, offset=off)
+        np.copyto(dst, arr)
+    return skeleton
+
+
+def _decode(skeleton, buf):
+    if isinstance(skeleton, tuple) and len(skeleton) == 4 \
+            and skeleton[0] == "__shm__":
+        _, off, shape, dtype = skeleton
+        src = np.ndarray(shape, np.dtype(dtype), buffer=buf, offset=off)
+        return src.copy()
+    if isinstance(skeleton, (list, tuple)):
+        return type(skeleton)(_decode(v, buf) for v in skeleton)
+    if isinstance(skeleton, dict):
+        return {k: _decode(v, buf) for k, v in skeleton.items()}
+    return skeleton
+
+
+class WorkerRing:
+    """Worker-side view: claim slots, write batches, report ring pressure."""
+
+    def __init__(self, cfg: dict):
+        self.prefix = cfg["prefix"]
+        self.slot_bytes = cfg["slot_bytes"]
+        self.free_slots = cfg["free_slots"]
+        self._attached: dict = {}
+
+    def _buf(self, slot: int):
+        shm = self._attached.get(slot)
+        if shm is None:
+            shm = _attach(f"{self.prefix}{slot}")
+            self._attached[slot] = shm
+        return shm.buf
+
+    def put_batch(self, batch, stop_event) -> Optional[Tuple]:
+        """Try to ship ``batch`` through the ring. Returns the descriptor
+        tuple (slot, skeleton, waited) or None (caller uses pickle).
+        ``waited`` marks that every slot was in flight when the worker got
+        here — the parent surfaces it as the shm_ring_full gauge."""
+        import queue as _q
+
+        # cheap pre-check before claiming a slot: a non-shippable batch
+        # must not consume (and then bounce) a ring token
+        try:
+            _, total, _ = _plan(batch, 0)
+        except _NotShmable:
+            return None
+        if total > self.slot_bytes:
+            return None
+
+        waited = False
+        try:
+            slot = self.free_slots.get_nowait()
+        except _q.Empty:
+            waited = True
+            while True:
+                if stop_event is not None and stop_event.is_set():
+                    return None
+                try:
+                    slot = self.free_slots.get(timeout=0.2)
+                    break
+                except _q.Empty:
+                    continue
+        try:
+            skeleton = encode_into(batch, self._buf(slot), self.slot_bytes)
+        except Exception:
+            skeleton = None
+        if skeleton is None:  # raced size estimate / platform error
+            self.free_slots.put(slot)
+            return None
+        return (slot, skeleton, waited)
+
+    def close(self):
+        for shm in self._attached.values():
+            try:
+                shm.close()
+            except Exception:
+                pass
+        self._attached = {}
+
+
+def estimate_slot_bytes(sample, batch_size: int,
+                        floor: int = 1 << 20,
+                        headroom: float = 2.0) -> int:
+    """Slot size from one probed sample: stacked-batch bytes x headroom
+    (variable-length samples overflow into the per-batch pickle fallback,
+    so the estimate only needs to be right for the common case)."""
+    try:
+        skel, total, _ = _plan(sample, 0)
+        del skel
+    except _NotShmable:
+        total = 0
+    est = int(total * max(1, batch_size) * headroom)
+    env = os.environ.get("FLAGS_shm_slot_bytes")
+    if env:
+        try:
+            return max(int(env), 4096)
+        except ValueError:
+            pass
+    return max(floor, est)
+
+
+def dumps_desc(desc) -> bytes:
+    return pickle.dumps(desc, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads_desc(raw: bytes):
+    return pickle.loads(raw)

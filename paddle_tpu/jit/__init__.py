@@ -25,7 +25,8 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..framework.core import Parameter, Tensor
+from ..core.native import fast_step as _fast_step
+from ..framework.core import AsyncLoss, Parameter, Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["state", "functional_call", "to_static", "TrainStep", "not_to_static",
@@ -225,6 +226,13 @@ class TrainStep:
         self._hyper = {k: tuple(sorted(self.optimizer._hyper(self._params[k]).items()))
                        for k in self._param_names}
         self._compiled = None
+        # fast-step (FLAGS_fast_step) state: donated-buffers jit, cached
+        # buffer-tensor refs, cached device lr scalar, lazy optimizer-slot
+        # sync marker
+        self._compiled_fast = None
+        self._buffer_tensors: Dict[str, Tensor] = {}
+        self._lr_cache = (None, None)
+        self._slots_dirty = False
 
     def _build(self):
         model = self.model
@@ -267,13 +275,19 @@ class TrainStep:
             return new_params, new_slots, new_buffers, loss
 
         # pure step exposed for K-steps-in-one-jit timing (bench.py) and
-        # custom outer loops; _compiled is the per-call dispatch path
+        # custom outer loops; _compiled is the per-call dispatch path,
+        # _compiled_fast additionally donates the buffer tree (FLAGS_fast_step)
         self._step_impl = step_impl
         self._compiled = jax.jit(step_impl, donate_argnums=(0, 1))
+        self._compiled_fast = jax.jit(step_impl, donate_argnums=(0, 1, 2))
+        self._buffer_tensors = {k: b for k, b in self.model.named_buffers()
+                                if b is not None}
 
     def __call__(self, *batch):
         if self._compiled is None:
             self._build()
+        if _fast_step[0]:
+            return self._call_fast(batch)
         params = {k: self._params[k]._data for k in self._param_names}
         buffers = {k: b._data for k, b in self.model.named_buffers() if b is not None}
         lr = self.optimizer.get_lr()
@@ -288,6 +302,46 @@ class TrainStep:
         for name, arr in new_buffers.items():
             tensors[name]._data = arr
         return Tensor(loss)
+
+    def _call_fast(self, batch):
+        """FLAGS_fast_step path: the bench device loop as framework code.
+
+        Per step: pointer-read the device state (no module-tree walks),
+        dispatch the donated step (params AND slots AND buffers — nothing
+        is double-buffered), pointer-write the new arrays back into the
+        same eager tensors, and return the loss WITHOUT blocking — the
+        AsyncLoss handle syncs (and bumps step_async_syncs) only when the
+        user reads it. Optimizer slot mirrors are synced lazily
+        (:meth:`sync`), since ``_set_slots`` walks per-param dicts the
+        step itself never reads."""
+        params = {k: self._params[k]._data for k in self._param_names}
+        buffers = {k: t._data for k, t in self._buffer_tensors.items()}
+        lr = self.optimizer.get_lr()
+        if self._lr_cache[0] != lr:
+            # device-cache the lr scalar: a python-float jit arg is a
+            # fresh host->device transfer every step
+            self._lr_cache = (lr, jnp.float32(lr))
+        arr_batch = _tree_tensor_to_array(batch)
+        new_params, new_slots, new_buffers, loss = self._compiled_fast(
+            params, self._slot_values, buffers, self._lr_cache[1], arr_batch)
+        for k in self._param_names:
+            self._params[k]._data = new_params[k]
+            self._slot_values[k] = new_slots[k]
+        for name, arr in new_buffers.items():
+            self._buffer_tensors[name]._data = arr
+        self._slots_dirty = True
+        return AsyncLoss(loss)
+
+    def sync(self):
+        """Flush lazily-deferred state mirrors (optimizer slot dicts) so
+        host-side readers — optimizer.state_dict(), checkpoint save — see
+        the current device state. Called automatically by hapi Model.fit
+        at epoch boundaries and by Model.save."""
+        if self._slots_dirty:
+            for k in self._param_names:
+                self.optimizer._set_slots(self._params[k],
+                                          self._slot_values[k])
+            self._slots_dirty = False
 
 
 def save(layer, path, input_spec=None, **configs):

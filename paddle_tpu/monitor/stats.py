@@ -151,6 +151,12 @@ DEFAULT_STATS = (
     "nan_inf_trips",      # FLAGS_check_nan_inf violations raised
     "host_memory_bytes",  # gauge: peak host RSS (update_memory_stats)
     "device_memory_bytes",  # gauge: device bytes in use (update_memory_stats)
+    # input-and-step fast path (ISSUE 3)
+    "prefetch_queue_depth",  # gauge: batches staged ahead by DevicePrefetcher
+    "h2d_copy_ms",        # cumulative host->device copy dispatch time (ms)
+    "shm_ring_full",      # DataLoader shm batches that waited for a free slot
+    "shm_batches",        # batches shipped via the shared-memory transport
+    "step_async_syncs",   # async-step loss/metric materializations (blocking reads)
 )
 
 for _n in DEFAULT_STATS:
@@ -168,6 +174,11 @@ TRAIN_STEPS = _registry.get_stat("train_steps")
 NAN_INF_TRIPS = _registry.get_stat("nan_inf_trips")
 HOST_MEMORY_BYTES = _registry.get_stat("host_memory_bytes")
 DEVICE_MEMORY_BYTES = _registry.get_stat("device_memory_bytes")
+PREFETCH_QUEUE_DEPTH = _registry.get_stat("prefetch_queue_depth")
+H2D_COPY_MS = _registry.get_stat("h2d_copy_ms")
+SHM_RING_FULL = _registry.get_stat("shm_ring_full")
+SHM_BATCHES = _registry.get_stat("shm_batches")
+STEP_ASYNC_SYNCS = _registry.get_stat("step_async_syncs")
 
 
 # per-mesh-axis device-memory gauges published by the last

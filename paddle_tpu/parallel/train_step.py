@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.native import fast_step as _fast_step
+from ..framework.core import AsyncLoss as _AsyncLoss
 from ..monitor import stats as _mstats
 from ..monitor.trace import span as _trace_span
 from .mesh import get_mesh, mesh_shape
@@ -430,6 +432,10 @@ class DistributedTrainStep:
         # shape-churning data loader shows up as a jit_compile storm here
         # exactly like an eager recompile storm does in grad_jit_compile)
         self._seen_batch_avals: set = set()
+        # FLAGS_fast_step: device-cache the lr scalar between steps — a
+        # fresh jnp.float32 per call is a host->device transfer per step
+        # that the compiled program then waits on
+        self._lr_cache = (None, None)
 
     def current_lr(self) -> float:
         if callable(self._lr):
@@ -437,7 +443,13 @@ class DistributedTrainStep:
         return float(self._lr)
 
     def __call__(self, batch):
-        lr = jnp.float32(self.current_lr())
+        lrf = self.current_lr()
+        if _fast_step[0]:
+            if self._lr_cache[0] != lrf:
+                self._lr_cache = (lrf, jnp.float32(lrf))
+            lr = self._lr_cache[1]
+        else:
+            lr = jnp.float32(lrf)
         sig = tuple(
             (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "?")))
             for x in jax.tree_util.tree_leaves(batch))
@@ -456,6 +468,11 @@ class DistributedTrainStep:
                     self.scaler_state)
         self._step_count += 1
         _mstats.TRAIN_STEPS.add()
+        if _fast_step[0]:
+            # async handle: params/opt-state stay device-resident and the
+            # dispatch is not awaited; the first host read of the loss is
+            # the sync point (step_async_syncs gauge)
+            return _AsyncLoss(loss)
         return loss
 
     def loss_scale(self) -> Optional[float]:

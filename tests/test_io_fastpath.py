@@ -1,0 +1,240 @@
+"""Input fast path: shared-memory worker transport + device prefetcher.
+
+ISSUE 3 test satellite:
+- the shm transport must beat the pipe transport >=1.5x at 4 workers on a
+  synthetic image pipeline (transport-bound: big samples, cheap decode);
+- fallback correctness: pipe path byte-identical batches, FLAGS off, and
+  non-numpy payloads all land on the pickle path;
+- DevicePrefetcher preserves order and actually runs ahead of the
+  consumer (overlap) under JAX_PLATFORMS=cpu.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.io import DataLoader, DevicePrefetcher, prefetch_to_device
+
+
+# -- datasets (module-level so spawn/forkserver workers can pickle them) ----
+
+class _ImgDS:
+    """Synthetic image pipeline: cheap per-sample decode, big sample —
+    the regime where transport, not transform, is the bottleneck."""
+
+    def __init__(self, n=384, hw=224):
+        self.n = n
+        self.hw = hw
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        x = np.empty((3, self.hw, self.hw), np.float32)
+        x.fill(i * 0.01)
+        return x, np.int64(i % 10)
+
+
+class _ObjDS:
+    """Items carrying a non-numpy, non-scalar leaf (a set) — picklable but
+    not shm-encodable, so every batch must take the pipe fallback."""
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32), {i}
+
+
+class _BigSampleDS:
+    """Every sample much larger than the probed-first-sample slot estimate
+    would suggest — forces the per-batch pickle fallback on later
+    batches while batch 0 still fits."""
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        size = 8 if i < 4 else 100_000
+        return np.full((size,), i, np.float32)
+
+
+def _steady_seconds(loader):
+    """Wall time after the first batch (pool spawn amortized), plus count."""
+    it = iter(loader)
+    next(it)
+    t0 = time.perf_counter()
+    n = sum(1 for _ in it)
+    return time.perf_counter() - t0, n + 1
+
+
+def _collect(loader):
+    out = []
+    for b in loader:
+        leaves = b if isinstance(b, (tuple, list)) else (b,)
+        out.append(tuple(np.asarray(x._data) if hasattr(x, "_data") else x
+                         for x in leaves))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    paddle.set_flags({"FLAGS_use_shared_memory": 1})
+
+
+class TestShmTransport:
+    def test_shm_beats_pipe_4_workers(self):
+        """Acceptance: shm >=1.5x over pipe at 4 workers. Best-of-3 per
+        transport damps scheduler noise (single runs vary ~2x)."""
+        ds = _ImgDS()
+
+        def best(shm):
+            paddle.set_flags({"FLAGS_use_shared_memory": int(shm)})
+            times = []
+            for _ in range(3):
+                t, n = _steady_seconds(DataLoader(
+                    ds, batch_size=16, num_workers=4, shuffle=False))
+                assert n == 24
+                times.append(t)
+            return min(times)
+
+        t_shm = best(True)
+        t_pipe = best(False)
+        speedup = t_pipe / t_shm
+        assert speedup >= 1.5, (
+            f"shm transport speedup {speedup:.2f}x < 1.5x "
+            f"(shm {t_shm:.3f}s, pipe {t_pipe:.3f}s)")
+
+    def test_shm_and_pipe_byte_identical(self):
+        ds = _ImgDS(n=32, hw=16)
+        mark = monitor.stat_get("shm_batches")
+        got_shm = _collect(DataLoader(ds, batch_size=8, num_workers=2,
+                                      shuffle=False))
+        assert monitor.stat_get("shm_batches") > mark  # shm really used
+        paddle.set_flags({"FLAGS_use_shared_memory": 0})
+        got_pipe = _collect(DataLoader(ds, batch_size=8, num_workers=2,
+                                       shuffle=False))
+        assert len(got_shm) == len(got_pipe) == 4
+        for a, b in zip(got_shm, got_pipe):
+            for xa, xb in zip(a, b):
+                assert xa.tobytes() == xb.tobytes()
+
+    def test_flag_off_restores_pipe_path(self):
+        paddle.set_flags({"FLAGS_use_shared_memory": 0})
+        ds = _ImgDS(n=16, hw=8)
+        mark = monitor.stat_get("shm_batches")
+        got = _collect(DataLoader(ds, batch_size=4, num_workers=2,
+                                  shuffle=False))
+        assert len(got) == 4
+        assert monitor.stat_get("shm_batches") == mark  # nothing via shm
+        np.testing.assert_array_equal(
+            got[0][0][0], np.zeros((3, 8, 8), np.float32))
+
+    def test_non_numpy_payload_falls_back_per_batch(self):
+        ds = _ObjDS()
+        mark = monitor.stat_get("shm_batches")
+        batches = list(DataLoader(ds, batch_size=4, num_workers=2,
+                                  shuffle=False))
+        assert len(batches) == 4
+        assert monitor.stat_get("shm_batches") == mark  # all via pickle
+        arrs, metas = zip(*batches)
+        flat = np.concatenate([np.asarray(a._data)[:, 0] for a in arrs])
+        np.testing.assert_array_equal(flat, np.arange(16.0))
+        assert list(metas[0]) == [{i} for i in range(4)]
+
+    def test_oversized_batch_falls_back_not_fails(self):
+        ds = _BigSampleDS()
+        batches = list(DataLoader(ds, batch_size=4, num_workers=2,
+                                  shuffle=False))
+        assert len(batches) == 4
+        # later (huge) batches fell back to pickle but arrived intact
+        np.testing.assert_array_equal(
+            np.asarray(batches[-1]._data)[:, 0],
+            np.asarray([12.0, 13.0, 14.0, 15.0]))
+
+    def test_ring_recycles_slots_across_many_batches(self):
+        # 16 batches through prefetch_factor*workers = 4 slots: every slot
+        # is reused repeatedly; ordering must survive recycling
+        ds = _ImgDS(n=64, hw=8)
+        mark = monitor.stat_get("shm_batches")
+        got = _collect(DataLoader(ds, batch_size=4, num_workers=2,
+                                  shuffle=False))
+        assert len(got) == 16
+        assert monitor.stat_get("shm_batches") - mark == 16
+        firsts = np.asarray([g[0][0, 0, 0, 0] for g in got])
+        np.testing.assert_allclose(firsts, np.arange(0, 64, 4) * 0.01,
+                                   rtol=1e-6)
+
+
+class _RecordingSource:
+    """Iterable that records when each item was produced."""
+
+    def __init__(self, n=6):
+        self.n = n
+        self.produced = []
+
+    def __iter__(self):
+        for i in range(self.n):
+            self.produced.append((i, time.perf_counter()))
+            yield np.full((4,), i, np.float32)
+
+
+class TestDevicePrefetcher:
+    def test_preserves_order_and_structure(self):
+        src = [(np.arange(3.0) + i, {"y": np.int64(i)}) for i in range(5)]
+        out = list(DevicePrefetcher(src, size=2))
+        assert len(out) == 5
+        for i, (x, d) in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(x), np.arange(3.0) + i)
+            assert int(d["y"]) == i
+
+    def test_tensor_leaves_stay_tensors_on_device(self):
+        import jax
+
+        src = [(paddle.to_tensor(np.ones((2, 2), np.float32) * i),)
+               for i in range(3)]
+        out = list(DevicePrefetcher(src, size=2))
+        from paddle_tpu.framework.core import Tensor
+
+        assert all(isinstance(b[0], Tensor) for b in out)
+        assert all(isinstance(b[0]._data, jax.Array) for b in out)
+
+    def test_overlap_runs_ahead_of_consumer(self):
+        """Double buffering: while the consumer 'computes' on batch N, the
+        producer must already have staged batch N+1 (and with depth 2,
+        N+2) — i.e. production timestamps run ahead of consumption."""
+        src = _RecordingSource(n=6)
+        it = iter(DevicePrefetcher(src, size=2))
+        first = next(it)
+        np.testing.assert_array_equal(np.asarray(first),
+                                      np.zeros(4, np.float32))
+        time.sleep(0.3)  # simulated step N on the consumer side
+        # producer was not blocked by our sleep: it staged ahead
+        assert len(src.produced) >= 3, (
+            f"prefetcher produced only {len(src.produced)} items while the "
+            "consumer slept — no overlap")
+        rest = list(it)
+        assert len(rest) == 5
+
+    def test_gauges_and_functional_form(self):
+        mark = monitor.stat_get("h2d_copy_ms")
+        src = [np.zeros((256, 256), np.float32) for _ in range(8)]
+        out = list(prefetch_to_device(src, size=2))
+        assert len(out) == 8
+        assert monitor.stat_get("h2d_copy_ms") >= mark
+        assert monitor.stat_get("prefetch_queue_depth") == 0  # drained
+
+    def test_trace_spans_recorded(self):
+        from paddle_tpu.monitor import trace as mtrace
+
+        w = mtrace.start_tracing(clear=True)
+        try:
+            list(DevicePrefetcher([np.zeros((8,), np.float32)] * 3, size=2))
+        finally:
+            mtrace.stop_tracing()
+        names = {e["name"] for e in w.events()}
+        assert "prefetch.h2d_copy" in names

@@ -62,6 +62,43 @@ def aggregate(events: list) -> list:
     return rows
 
 
+def input_pipeline_report(rows: list, file=None) -> dict:
+    """Input-vs-compute verdict from the prefetch/H2D spans (ISSUE 3).
+
+    The DevicePrefetcher emits ``prefetch.h2d_copy`` (host->device copy of
+    each staged batch) and ``prefetch.wait`` (consumer blocked on an empty
+    prefetch queue) spans; step-level spans land under names containing
+    "step"/"train_batch". Comparing them answers the question a slow
+    trace always raises: is the step starving on INPUT (wait time rivals
+    step time) or is input fully hidden behind COMPUTE?"""
+    def total(pred):
+        return sum(r["total_us"] for r in rows if pred(r["name"]))
+
+    h2d = total(lambda n: n == "prefetch.h2d_copy")
+    wait = total(lambda n: n == "prefetch.wait")
+    step = total(lambda n: "step" in n.lower() or "train_batch" in n.lower())
+    if h2d == 0 and wait == 0:
+        return {}
+    out = {"h2d_copy_ms": h2d / 1e3, "prefetch_wait_ms": wait / 1e3,
+           "step_ms": step / 1e3}
+    if step > 0:
+        out["wait_frac_of_step"] = wait / step
+        out["verdict"] = ("input-bound: the consumer waited on the "
+                          "prefetch queue for a significant share of "
+                          "step time — add workers / enable shared "
+                          "memory / deepen prefetch"
+                          if wait > 0.1 * step else
+                          "compute-bound: H2D copies are hidden behind "
+                          "the step")
+    print("\nInput pipeline:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<22}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def report(rows: list, top: int = 20, file=None) -> list:
     rows = rows[:top]
     if not rows:
@@ -84,6 +121,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     rows = aggregate(load_events(args.trace))
     report(rows, args.top)
+    input_pipeline_report(rows)
     return rows
 
 
