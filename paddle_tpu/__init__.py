@@ -100,6 +100,18 @@ from .jit import to_static  # noqa: F401
 from .nn import DataParallel  # noqa: F401
 
 
+def __getattr__(name):
+    # paddle_tpu.serving is LAZY (PEP 562): it imports the model code
+    # (models.gpt prefill/decode variants), and a Predictor-only serving
+    # process must stay model-code-free (test_inference pins that a fresh
+    # process importing paddle_tpu.inference never loads paddle_tpu.models)
+    if name == "serving":
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def in_dynamic_mode() -> bool:
     from .static import _static_mode
 

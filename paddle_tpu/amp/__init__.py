@@ -169,15 +169,27 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # armed by FleetEngine.step: a deferred pull of the COMPILED
+        # scaler counters, so the engine never blocks on float(scale) per
+        # step; any observable read below materializes it first
+        self._lazy_sync = None
+
+    def _materialize(self):
+        cb = self._lazy_sync
+        if cb is not None:
+            self._lazy_sync = None
+            cb()
 
     def scale(self, var):
         if not self._enable:
             return var
+        self._materialize()
         return var * self._scale
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        self._materialize()
         grads = [p.grad._data for p in optimizer._parameter_list or []
                  if p.grad is not None]
         if not grads:
@@ -211,6 +223,7 @@ class GradScaler:
     def update(self):
         if not (self._enable and self._dynamic):
             return
+        self._materialize()
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
@@ -231,16 +244,20 @@ class GradScaler:
         return self._dynamic
 
     def get_loss_scaling(self):
+        self._materialize()
         return Tensor(jnp.asarray(self._scale))
 
     def set_init_loss_scaling(self, v):
+        self._lazy_sync = None   # explicit override beats pending state
         self._scale = float(v)
 
     def state_dict(self):
+        self._materialize()
         return {"scale": self._scale, "good_steps": self._good_steps,
                 "bad_steps": self._bad_steps}
 
     def load_state_dict(self, d):
+        self._lazy_sync = None   # explicit override beats pending state
         self._scale = d.get("scale", self._scale)
         self._good_steps = d.get("good_steps", 0)
         self._bad_steps = d.get("bad_steps", 0)

@@ -121,6 +121,13 @@ use_shared_memory = [_truthy(os.environ.get("FLAGS_use_shared_memory", "1"))]
 # writeback + per-step host scalar paths.
 fast_step = [_truthy(os.environ.get("FLAGS_fast_step", "1"))]
 
+# Fast-path mirror of FLAGS_serving_jit (ISSUE 4): the serving engine's
+# jit-compiled KV-cache prefill/decode programs. Default ON;
+# `paddle.set_flags({"FLAGS_serving_jit": 0})` drops the engine to an
+# un-jitted full-recompute reference decode (same scheduler, same
+# sampling) — the numerics escape hatch for debugging cache bugs.
+serving_jit = [_truthy(os.environ.get("FLAGS_serving_jit", "1"))]
+
 
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
@@ -133,6 +140,8 @@ def set_flag(name: str, value) -> None:
         use_shared_memory[0] = _truthy(value)
     elif name.endswith("fast_step"):
         fast_step[0] = _truthy(value)
+    elif name.endswith("serving_jit"):
+        serving_jit[0] = _truthy(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

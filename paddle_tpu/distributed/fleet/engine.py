@@ -520,12 +520,15 @@ class FleetEngine:
             opt_kwargs=opt_kwargs, aux=buffers,
             dynamic_scale=dynamic_scale)
         if self._scaler is not None:
-            # start from the eager scaler's live counters
+            # start from the eager scaler's live counters (pull any state a
+            # previous engine left pending on the mirror first)
+            getattr(self._scaler, "_materialize", lambda: None)()
             self._step.scaler_state = {
                 "scale": jnp.float32(self._scaler._scale),
                 "good": jnp.int32(self._scaler._good_steps),
                 "bad": jnp.int32(self._scaler._bad_steps),
             }
+        self._scaler_dirty = False
 
     # -- builders ------------------------------------------------------------
     def _micro_loss(self, one_loss: Callable):
@@ -783,13 +786,27 @@ class FleetEngine:
         self._write_back(self._step.params)
         self._write_back_buffers(self._step.aux)
         if self._scaler is not None:
-            # keep the eager GradScaler object observable (get_loss_scaling,
-            # state_dict) in sync with the compiled counters
-            st = self._step.scaler_state
-            self._scaler._scale = float(st["scale"])
-            self._scaler._good_steps = int(st["good"])
-            self._scaler._bad_steps = int(st["bad"])
+            # LAZY mirror sync (ROADMAP PR-3 follow-up): float(scale) here
+            # was a blocking device read every step — the one sync the
+            # async fast path had left. Instead the eager GradScaler is
+            # armed with a deferred pull; its next observable read
+            # (get_loss_scaling / state_dict / scale) materializes the
+            # compiled counters, i.e. sync happens at log/checkpoint
+            # cadence rather than step cadence.
+            self._scaler_dirty = True
+            self._scaler._lazy_sync = self.sync_scaler
         return loss
+
+    def sync_scaler(self) -> None:
+        """Materialize the compiled scaler counters into the eager
+        GradScaler mirror (no-op when already in sync)."""
+        if self._scaler is None or not self._scaler_dirty:
+            return
+        st = self._step.scaler_state
+        self._scaler._scale = float(st["scale"])
+        self._scaler._good_steps = int(st["good"])
+        self._scaler._bad_steps = int(st["bad"])
+        self._scaler_dirty = False
 
 
 def build_engine(model, optimizer, strategy, hcg=None, loss_fn=None,

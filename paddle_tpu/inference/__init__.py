@@ -14,6 +14,13 @@ the functional analog of the reference's zero-copy tensors.
 
 No model-building Python is imported: a serving process needs only
 ``paddle_tpu.inference`` and numpy.
+
+Scope: this is the ONE-SHOT compiled-program surface (classification,
+embedding, single forward passes). For autoregressive generation under
+concurrent traffic — KV-cache decode, continuous batching, streaming —
+use :mod:`paddle_tpu.serving` (InferenceEngine), which serves many
+requests through one jitted decode step instead of one program run per
+call.
 """
 from __future__ import annotations
 
@@ -102,9 +109,21 @@ class Predictor:
         return [f"fetch_{i}" for i in range(self.meta["fetch_count"])]
 
     def get_input_handle(self, name) -> _IOTensor:
+        # validate at handle creation (a bad name used to surface only as
+        # a cryptic KeyError inside copy_to_cpu, long after the mistake)
+        names = self.get_input_names()
+        if name not in names:
+            raise ValueError(
+                f"unknown input name {name!r}; this model's inputs are "
+                f"{names} (get_input_names())")
         return _IOTensor(self, name)
 
     def get_output_handle(self, name) -> _IOTensor:
+        names = self.get_output_names()
+        if name not in names:
+            raise ValueError(
+                f"unknown output name {name!r}; this model's outputs are "
+                f"{names} (get_output_names())")
         return _IOTensor(self, name)
 
     # -- execution ------------------------------------------------------------

@@ -13,6 +13,8 @@ from .gpt import (
     gpt_forward,
     gpt_loss,
     gpt_param_specs,
+    gpt_prefill,
+    gpt_decode_step,
     gpt_tiny,
     gpt_small,
     gpt_1p3b,
@@ -21,5 +23,6 @@ from .gpt import (
 
 __all__ = [
     "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
+    "gpt_prefill", "gpt_decode_step",
     "gpt_tiny", "gpt_small", "gpt_1p3b", "bert_base_config",
 ]

@@ -32,11 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..ops.flash_attention import _attention_reference, _on_tpu
+from ..ops.flash_attention import NEG_INF, _attention_reference, _on_tpu
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
            "gpt_param_specs", "gpt_tiny", "gpt_small", "gpt_1p3b",
-           "bert_base_config"]
+           "bert_base_config", "gpt_prefill", "gpt_decode_step"]
 
 
 @dataclasses.dataclass
@@ -196,8 +196,10 @@ def _attention(cfg: GPTConfig, q, k, v):
     return _attention_reference(q, k, v, causal=True, scale=scale)
 
 
-def _block(cfg: GPTConfig, p, x):
-    """One transformer block; p leaves have no layer dim."""
+def _block_kv(cfg: GPTConfig, p, x):
+    """One transformer block; p leaves have no layer dim. Also returns the
+    per-head K/V ((B, nh, S, hd) each) so the prefill path can seed a KV
+    cache; gpt_forward discards them (XLA DCEs the dead outputs)."""
     B, S, H = x.shape
     nh, hd = cfg.n_heads, cfg.head_dim
     cd = cfg.dtype
@@ -206,14 +208,20 @@ def _block(cfg: GPTConfig, p, x):
     qkv = h @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     to_heads = lambda t: t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-    o = _attention(cfg, to_heads(q), to_heads(k), to_heads(v))
+    kh, vh = to_heads(k), to_heads(v)
+    o = _attention(cfg, to_heads(q), kh, vh)
     o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
     x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
 
     h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
     h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
     x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
-    return x
+    return x, (kh, vh)
+
+
+def _block(cfg: GPTConfig, p, x):
+    """One transformer block; p leaves have no layer dim."""
+    return _block_kv(cfg, p, x)[0]
 
 
 def _block_stack(cfg: GPTConfig, blocks, x):
@@ -345,3 +353,113 @@ def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1,
     logp = jax.nn.log_softmax(_logits(params, x), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+# --------------------------------------------------------------------------
+# KV-cache autoregressive serving path (paddle_tpu.serving, ISSUE 4)
+# --------------------------------------------------------------------------
+#
+# The reference's inference stack recomputes nothing either — its
+# AnalysisPredictor serves a compiled program; generation loops over it.
+# Here the generation loop gets its own pair of pure functions so the
+# serving engine can jit them once:
+#
+# - gpt_prefill: one causal pass over the whole prompt that ALSO emits the
+#   per-layer K/V it computed, so a cache slot can be seeded in the same
+#   program (causality makes those K/V exact: hidden state at position s
+#   never sees positions > s, so end-padding a prompt is safe).
+# - gpt_decode_step: batched one-token step — each sequence's new K/V is
+#   scattered into its cache slot at ``positions`` and the single query
+#   attends over the slot masked to ``pos <= positions``. O(S·H) per token
+#   instead of gpt_forward's O(S·H² + S²·H) full recompute.
+#
+# Both run over the cache layout paddle_tpu.serving.KVCache owns:
+# (slots, layers, heads, max_len, head_dim). Stage-stacked (n_stages > 1)
+# param trees are a training layout; serving expects the flat (L, ...)
+# blocks gpt_init produces.
+
+def _block_decode(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
+    """One-token block step against one layer's cache slice.
+
+    x (B, 1, H); kc_l/vc_l (B, nh, max_len, hd) — this layer's cache for
+    every slot; positions (B,) int32 — where each slot's incoming token
+    lands. Returns (x, updated kc_l, updated vc_l)."""
+    B = x.shape[0]
+    nh, hd = cfg.n_heads, cfg.head_dim
+    cd = cfg.dtype
+
+    h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
+    qkv = h @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)         # each (B, 1, H)
+    to_heads = lambda t: t.reshape(B, nh, hd)
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+
+    def write(c, new, pos):  # c (nh, max_len, hd), new (nh, hd)
+        return jax.lax.dynamic_update_slice(c, new[:, None, :], (0, pos, 0))
+
+    kc_l = jax.vmap(write)(kc_l, k, positions)
+    vc_l = jax.vmap(write)(vc_l, v, positions)
+
+    # same numerics as _attention_reference: scores in compute dtype,
+    # softmax in fp32; padded/garbage cache positions are masked off
+    scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bhd,bhkd->bhk", q, kc_l) * scale
+    live = jnp.arange(kc_l.shape[2])[None, :] <= positions[:, None]
+    s = jnp.where(live[:, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhk,bhkd->bhd", w, vc_l).reshape(B, 1, nh * hd)
+
+    x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
+    x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+    return x, kc_l, vc_l
+
+
+def gpt_prefill(cfg: GPTConfig, params, tokens):
+    """tokens (B, S) int32 → (logits (B, S, V) fp32, cache_entries).
+
+    cache_entries = (k, v), each (B, L, nh, S, hd) in cfg.dtype — exactly
+    the K/V gpt_forward computes for those positions, slot-major so a
+    whole prompt drops into a KVCache slot with one dynamic_update_slice
+    (serving.kv_cache.cache_insert)."""
+    x = _embed(cfg, params, tokens)
+
+    def step(h, layer_p):
+        h, kv = _block_kv(cfg, layer_p, h)
+        return h, kv
+
+    x, (ks, vs) = jax.lax.scan(step, x, params["blocks"])
+    # (L, B, nh, S, hd) → (B, L, nh, S, hd)
+    return _head(cfg, params, x), (jnp.moveaxis(ks, 0, 1),
+                                   jnp.moveaxis(vs, 0, 1))
+
+
+def gpt_decode_step(cfg: GPTConfig, params, cache, positions, tokens):
+    """Batched one-token decode against a slotted KV cache.
+
+    cache = (k, v), each (B, L, nh, max_len, hd); positions (B,) int32 —
+    the index each incoming token occupies (== tokens already cached in
+    that slot); tokens (B,) int32. Returns (logits (B, V) fp32, new cache)
+    with the new tokens' K/V written at ``positions``. Slots whose
+    position/token are stale (unoccupied engine slots) compute garbage
+    that later prefills overwrite — callers mask host-side."""
+    k_cache, v_cache = cache
+    cd = cfg.dtype
+    L = k_cache.shape[1]
+    x = (params["wte"].astype(cd)[tokens]
+         + params["wpe"].astype(cd)[positions])[:, None, :]   # (B, 1, H)
+
+    def step(carry, inp):
+        x, kc, vc = carry
+        layer_p, li = inp
+        kc_l = jnp.take(kc, li, axis=1)
+        vc_l = jnp.take(vc, li, axis=1)
+        x, kc_l, vc_l = _block_decode(cfg, layer_p, x, kc_l, vc_l, positions)
+        kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 1)
+        vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 1)
+        return (x, kc, vc), None
+
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        step, (x, k_cache, v_cache), (params["blocks"], jnp.arange(L)))
+    return _head(cfg, params, x)[:, 0], (k_cache, v_cache)

@@ -157,6 +157,13 @@ DEFAULT_STATS = (
     "shm_ring_full",      # DataLoader shm batches that waited for a free slot
     "shm_batches",        # batches shipped via the shared-memory transport
     "step_async_syncs",   # async-step loss/metric materializations (blocking reads)
+    # serving engine (ISSUE 4)
+    "serving_queue_depth",     # gauge: requests waiting for a cache slot
+    "serving_slot_occupancy",  # gauge: KV-cache slots currently generating
+    "serving_prefill_ms",      # cumulative prompt-prefill wall time (ms)
+    "serving_decode_ms",       # cumulative batched decode-tick wall time (ms)
+    "serving_tokens_per_s",    # gauge: recent generation rate (tokens/s)
+    "serving_evictions",       # sequences evicted from slots (eos/len/deadline/cancel)
 )
 
 for _n in DEFAULT_STATS:
@@ -179,6 +186,12 @@ H2D_COPY_MS = _registry.get_stat("h2d_copy_ms")
 SHM_RING_FULL = _registry.get_stat("shm_ring_full")
 SHM_BATCHES = _registry.get_stat("shm_batches")
 STEP_ASYNC_SYNCS = _registry.get_stat("step_async_syncs")
+SERVING_QUEUE_DEPTH = _registry.get_stat("serving_queue_depth")
+SERVING_SLOT_OCCUPANCY = _registry.get_stat("serving_slot_occupancy")
+SERVING_PREFILL_MS = _registry.get_stat("serving_prefill_ms")
+SERVING_DECODE_MS = _registry.get_stat("serving_decode_ms")
+SERVING_TOKENS_PER_S = _registry.get_stat("serving_tokens_per_s")
+SERVING_EVICTIONS = _registry.get_stat("serving_evictions")
 
 
 # per-mesh-axis device-memory gauges published by the last

@@ -1,0 +1,34 @@
+"""paddle_tpu.serving — continuous-batching inference engine (ISSUE 4).
+
+The generation-side counterpart of ``paddle_tpu.inference``: where the
+Predictor serves one compiled program per call (the reference's
+AnalysisPredictor shape), this package serves AUTOREGRESSIVE workloads —
+many concurrent requests sharing one jitted KV-cache decode step,
+Orca-style continuous batching instead of request-at-a-time.
+
+Layers:
+
+- :mod:`kv_cache` — fixed-slot donated device cache
+  ``(slots, layers, heads, max_len, head_dim)`` + host-side slot
+  accounting;
+- :func:`paddle_tpu.models.gpt_prefill` /
+  :func:`paddle_tpu.models.gpt_decode_step` — the cache-aware forward
+  variants (they live with the model);
+- :mod:`sampling` — fused greedy/temperature/top-k/top-p with per-slot
+  parameters;
+- :mod:`engine` — the scheduler: bounded queue with backpressure,
+  prefill-and-insert admission, one batched decode step per tick,
+  eviction without draining, deadlines/cancellation, graceful shutdown,
+  and the serving_* gauges + trace spans.
+
+Escape hatch: ``paddle.set_flags({"FLAGS_serving_jit": 0})`` swaps the
+jitted cache path for an un-jitted full-recompute reference decode.
+"""
+from .engine import GenerationRequest, InferenceEngine, QueueFull
+from .kv_cache import KVCache, cache_insert
+from .sampling import sample_tokens
+
+__all__ = [
+    "InferenceEngine", "GenerationRequest", "QueueFull",
+    "KVCache", "cache_insert", "sample_tokens",
+]

@@ -1,0 +1,491 @@
+"""Continuous-batching inference engine (Orca-style) over the KV cache.
+
+Request lifecycle::
+
+    submit() ──► bounded queue ──► [admit: prefill into a free slot]
+                                        │
+        stream()/result() ◄── tokens ◄──┤  one jitted decode step per tick,
+                                        │  batched over ALL occupied slots
+                  [evict: eos / max_tokens / deadline / cancel / capacity]
+
+A single scheduler thread owns the device state (params, cache buffers,
+jit calls); ``submit`` may be called from any thread and only touches the
+queue. Each tick the scheduler (1) admits waiting requests into free
+slots — prefill-and-insert, one sequence at a time, streaming the first
+token — and (2) runs ONE compiled decode step over the whole slot batch,
+so a late arrival starts generating next tick without draining anyone
+(the reference's AnalysisPredictor has no such path; batching there is
+caller-side). Finished sequences release their slot between ticks; the
+batch never stalls on the longest request.
+
+Jit surface: exactly two programs in steady state — a decode step at the
+fixed (n_slots,) batch shape, and a prefill per prompt-length bucket
+(prompts are end-padded to the next power of two, which causality makes
+exact). Cache buffers are donated through both, so serving allocates
+nothing per token. ``FLAGS_serving_jit=0`` swaps in an un-jitted
+full-recompute reference decode (same scheduler, same sampling) as the
+numerics escape hatch.
+
+Observability: gauges serving_queue_depth / serving_slot_occupancy /
+serving_prefill_ms / serving_decode_ms / serving_tokens_per_s /
+serving_evictions, plus ``serving.prefill`` / ``serving.decode_step``
+trace spans that ``tools/trace_report.py`` turns into a prefill-vs-decode
+verdict.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import native
+from ..models.gpt import gpt_decode_step, gpt_forward, gpt_prefill
+from ..monitor.stats import (SERVING_DECODE_MS, SERVING_EVICTIONS,
+                             SERVING_PREFILL_MS, SERVING_QUEUE_DEPTH,
+                             SERVING_SLOT_OCCUPANCY, SERVING_TOKENS_PER_S)
+from ..monitor.trace import span
+from .kv_cache import KVCache, cache_insert
+from .sampling import sample_tokens
+
+__all__ = ["InferenceEngine", "GenerationRequest", "QueueFull"]
+
+
+class QueueFull(RuntimeError):
+    """submit() backpressure: the bounded request queue is at capacity."""
+
+
+# finish reasons
+EOS = "eos"
+LENGTH = "length"
+DEADLINE = "deadline"
+CANCELLED = "cancelled"
+SHUTDOWN = "shutdown"
+ERROR = "error"
+
+
+class GenerationRequest:
+    """Per-request future returned by :meth:`InferenceEngine.submit`.
+
+    Tokens stream in as the scheduler generates them: ``stream()`` yields
+    them live, ``result()`` blocks for the full list, ``finish_reason``
+    says why generation stopped (eos/length/deadline/cancelled/shutdown).
+    """
+
+    def __init__(self, prompt, max_new_tokens: int, temperature: float,
+                 top_k: int, top_p: float, eos_id: Optional[int],
+                 deadline: Optional[float]):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_id = eos_id
+        self.deadline = deadline          # absolute time.monotonic() or None
+        self.tokens: List[int] = []       # generated ids (includes eos)
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self._cancelled = False
+        self._cv = threading.Condition()
+
+    # -- scheduler side ------------------------------------------------------
+    def _push(self, tok: int) -> None:
+        with self._cv:
+            self.tokens.append(tok)
+            self._cv.notify_all()
+
+    def _finish(self, reason: str, error: Optional[BaseException] = None):
+        with self._cv:
+            if self.finish_reason is None:
+                self.finish_reason = reason
+                self.error = error
+            self._cv.notify_all()
+
+    # -- user side -----------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+    def cancel(self) -> None:
+        """Ask the scheduler to drop this request at its next tick (or at
+        admission, if still queued)."""
+        self._cancelled = True
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until generation stops; returns the generated ids (the
+        tokens produced before an eviction are kept — a deadline/cancel
+        result is the partial sequence)."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: self.finish_reason is not None,
+                                     timeout):
+                raise TimeoutError("generation still in progress")
+        if self.error is not None:
+            raise RuntimeError("generation failed") from self.error
+        return list(self.tokens)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield token ids as they are generated; returns when finished."""
+        i = 0
+        while True:
+            with self._cv:
+                if not self._cv.wait_for(
+                        lambda: len(self.tokens) > i
+                        or self.finish_reason is not None, timeout):
+                    raise TimeoutError("generation still in progress")
+                fresh = self.tokens[i:]
+                finished = self.finish_reason is not None
+            for t in fresh:
+                yield t
+            i += len(fresh)
+            if finished and i >= len(self.tokens):
+                if self.error is not None:
+                    raise RuntimeError("generation failed") from self.error
+                return
+
+
+class _Slot:
+    """Host-side state of one occupied cache slot."""
+
+    __slots__ = ("req", "length", "last_token", "generated")
+
+    def __init__(self, req: GenerationRequest, length: int, last_token: int):
+        self.req = req
+        self.length = length          # tokens whose K/V are in the cache
+        self.last_token = last_token  # input of the next decode step
+        self.generated = 1            # prefill already streamed one token
+
+
+class InferenceEngine:
+    """Continuous-batching generation server for a functional GPT model.
+
+    ::
+
+        eng = InferenceEngine(cfg, params, n_slots=8)
+        req = eng.submit(prompt_ids, max_new_tokens=64, temperature=0.8)
+        for tok in req.stream(): ...
+        eng.shutdown()
+
+    ``params`` is a gpt_init-layout pytree (flat blocks — stage-stacked
+    training layouts must be unstacked first).
+    """
+
+    def __init__(self, cfg, params, n_slots: int = 4,
+                 max_len: Optional[int] = None, queue_size: int = 64,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        self.cfg = cfg
+        self._params = jax.device_put(params)
+        self.cache = KVCache(cfg, n_slots, max_len)
+        self.n_slots = self.cache.n_slots
+        self.max_len = self.cache.max_len
+        self.eos_id = eos_id
+        self._queue: collections.deque = collections.deque()
+        self._queue_size = int(queue_size)
+        self._cv = threading.Condition()
+        self._slots: List[Optional[_Slot]] = [None] * self.n_slots
+        self._stop = False
+        self._drain = True
+        self._base_key = jax.random.key(seed)
+        self._tick = 0
+        # float running totals behind the int ms gauges (prefetch.py idiom:
+        # sub-ms ticks still accumulate)
+        self._prefill_ms = 0.0
+        self._decode_ms = 0.0
+        self._window: collections.deque = collections.deque()  # (t, n_tokens)
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        self._thread = threading.Thread(target=self._run,
+                                        name="serving-scheduler", daemon=True)
+        self._thread.start()
+
+    # -- compiled programs ---------------------------------------------------
+    def _decode_fn(self, params, k, v, positions, tokens, key, temps,
+                   top_ks, top_ps):
+        logits, (k, v) = gpt_decode_step(self.cfg, params, (k, v),
+                                         positions, tokens)
+        toks = sample_tokens(logits, key, temps, top_ks, top_ps)
+        return toks, k, v
+
+    def _prefill_fn(self, params, k, v, tokens, slot, true_len, key, temp,
+                    top_k, top_p):
+        # tokens (1, S_pad) end-padded; causality keeps positions < true_len
+        # exact, and the logits/cache rows past true_len are never read
+        logits, (ke, ve) = gpt_prefill(self.cfg, params, tokens)
+        k, v = cache_insert(k, v, slot, ke[0], ve[0])
+        last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, 0,
+                                            keepdims=False)
+        tok = sample_tokens(last[None], key, temp[None], top_k[None],
+                            top_p[None])[0]
+        return tok, k, v
+
+    # -- public API ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_id: Optional[int] = None, deadline_s: Optional[float] = None,
+               block: bool = True,
+               timeout: Optional[float] = None) -> GenerationRequest:
+        """Queue a generation request; returns its streaming handle.
+
+        Backpressure: when the bounded queue is full, ``block=True`` waits
+        (up to ``timeout`` seconds) for space and raises :class:`QueueFull`
+        on timeout; ``block=False`` raises immediately. ``deadline_s`` is a
+        wall-clock budget from now — a request over budget is evicted with
+        ``finish_reason="deadline"`` wherever it is (queued or mid-decode).
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if prompt.size >= self.max_len:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to generate "
+                f"(cache max_len={self.max_len})")
+        req = GenerationRequest(
+            prompt, max_new_tokens, temperature, top_k, top_p,
+            self.eos_id if eos_id is None else eos_id,
+            None if deadline_s is None else time.monotonic() + deadline_s)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("InferenceEngine is shut down")
+            if len(self._queue) >= self._queue_size:
+                if not block:
+                    raise QueueFull(
+                        f"serving queue at capacity ({self._queue_size})")
+                ok = self._cv.wait_for(
+                    lambda: self._stop
+                    or len(self._queue) < self._queue_size, timeout)
+                if not ok:
+                    raise QueueFull(
+                        f"serving queue still full after {timeout}s")
+                if self._stop:
+                    raise RuntimeError("InferenceEngine is shut down")
+            self._queue.append(req)
+            SERVING_QUEUE_DEPTH.set(len(self._queue))
+            self._cv.notify_all()
+        return req
+
+    def generate(self, prompt: Sequence[int], **kw) -> List[int]:
+        """Blocking convenience wrapper: submit + result."""
+        return self.submit(prompt, **kw).result()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the scheduler. ``drain=True`` finishes every submitted
+        request first; ``drain=False`` evicts them with
+        ``finish_reason="shutdown"``."""
+        with self._cv:
+            self._stop = True
+            self._drain = drain
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    @property
+    def occupancy(self) -> int:
+        return self.cache.occupancy
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    # -- scheduler -----------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    busy = bool(self._queue) or any(
+                        s is not None for s in self._slots)
+                    if self._stop and (not self._drain or not busy):
+                        break
+                    if not busy:
+                        self._cv.wait(0.05)
+                        continue
+                self._admit()
+                if any(s is not None for s in self._slots):
+                    self._decode_tick()
+        except BaseException as e:  # noqa: BLE001 — fail every request, not silently
+            self._abort(e)
+        finally:
+            with self._cv:
+                self._stop = True
+                leftovers = list(self._queue)
+                self._queue.clear()
+                SERVING_QUEUE_DEPTH.set(0)
+                self._cv.notify_all()
+            for req in leftovers:
+                req._finish(SHUTDOWN)
+            for s, st in enumerate(self._slots):
+                if st is not None:
+                    self._evict(s, SHUTDOWN)
+
+    def _abort(self, err: BaseException) -> None:
+        for s, st in enumerate(self._slots):
+            if st is not None:
+                st.req._finish(ERROR, err)
+        with self._cv:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for req in leftovers:
+            req._finish(ERROR, err)
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots (prefill-and-insert)."""
+        while self.cache.free_count > 0:
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                SERVING_QUEUE_DEPTH.set(len(self._queue))
+                self._cv.notify_all()   # wake submitters blocked on full
+            if req._cancelled:
+                req._finish(CANCELLED)
+                continue
+            if req.deadline is not None and time.monotonic() > req.deadline:
+                req._finish(DEADLINE)
+                continue
+            self._prefill(req, self.cache.alloc())
+        SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
+
+    def _bucket(self, n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _next_key(self):
+        key = jax.random.fold_in(self._base_key, self._tick)
+        self._tick += 1
+        return key
+
+    def _prefill(self, req: GenerationRequest, slot: int) -> None:
+        S = int(req.prompt.size)
+        t0 = time.perf_counter()
+        with span("serving.prefill", cat="serving",
+                  args={"slot": slot, "prompt_len": S}):
+            if native.serving_jit[0]:
+                s_pad = self._bucket(S)
+                toks = np.zeros((1, s_pad), np.int32)
+                toks[0, :S] = req.prompt
+                tok, self.cache.k, self.cache.v = self._prefill_jit(
+                    self._params, self.cache.k, self.cache.v,
+                    jnp.asarray(toks), np.int32(slot), np.int32(S),
+                    self._next_key(), np.float32(req.temperature),
+                    np.int32(req.top_k), np.float32(req.top_p))
+            else:
+                logits = gpt_forward(self.cfg, self._params,
+                                     jnp.asarray(req.prompt[None]))
+                tok = sample_tokens(
+                    logits[:, -1], self._next_key(),
+                    jnp.float32(req.temperature)[None],
+                    jnp.int32(req.top_k)[None],
+                    jnp.float32(req.top_p)[None])[0]
+            tok = int(tok)
+        self._note_ms(SERVING_PREFILL_MS, "_prefill_ms",
+                      (time.perf_counter() - t0) * 1e3)
+        st = _Slot(req, length=S, last_token=tok)
+        self._slots[slot] = st
+        self.cache.lengths[slot] = S
+        req._push(tok)
+        self._note_tokens(1)
+        reason = self._finish_reason(st, tok)
+        if reason is not None:
+            self._evict(slot, reason)
+
+    def _decode_tick(self) -> None:
+        now = time.monotonic()
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if st.req._cancelled:
+                self._evict(s, CANCELLED)
+            elif st.req.deadline is not None and now > st.req.deadline:
+                self._evict(s, DEADLINE)
+        active = [s for s in range(self.n_slots) if self._slots[s] is not None]
+        if not active:
+            return
+
+        positions = np.zeros(self.n_slots, np.int32)
+        tokens = np.zeros(self.n_slots, np.int32)
+        temps = np.zeros(self.n_slots, np.float32)
+        top_ks = np.zeros(self.n_slots, np.int32)
+        top_ps = np.ones(self.n_slots, np.float32)
+        for s in active:
+            st = self._slots[s]
+            positions[s] = st.length
+            tokens[s] = st.last_token
+            temps[s] = st.req.temperature
+            top_ks[s] = st.req.top_k
+            top_ps[s] = st.req.top_p
+
+        t0 = time.perf_counter()
+        with span("serving.decode_step", cat="serving",
+                  args={"batch": len(active)}):
+            if native.serving_jit[0]:
+                out, self.cache.k, self.cache.v = self._decode_jit(
+                    self._params, self.cache.k, self.cache.v, positions,
+                    tokens, self._next_key(), temps, top_ks, top_ps)
+                out = np.asarray(out)
+            else:
+                # reference decode: full recompute per sequence, no cache
+                out = np.zeros(self.n_slots, np.int32)
+                key = self._next_key()
+                for s in active:
+                    st = self._slots[s]
+                    seq = np.concatenate(
+                        [st.req.prompt, np.asarray(st.req.tokens, np.int32)])
+                    logits = gpt_forward(self.cfg, self._params,
+                                         jnp.asarray(seq[None]))
+                    out[s] = int(sample_tokens(
+                        logits[:, -1], jax.random.fold_in(key, s),
+                        temps[s:s + 1], top_ks[s:s + 1], top_ps[s:s + 1])[0])
+        self._note_ms(SERVING_DECODE_MS, "_decode_ms",
+                      (time.perf_counter() - t0) * 1e3)
+
+        for s in active:
+            st = self._slots[s]
+            tok = int(out[s])
+            st.length += 1
+            st.generated += 1
+            st.last_token = tok
+            self.cache.lengths[s] = st.length
+            st.req._push(tok)
+            reason = self._finish_reason(st, tok)
+            if reason is not None:
+                self._evict(s, reason)
+        self._note_tokens(len(active))
+        SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
+
+    def _finish_reason(self, st: _Slot, tok: int) -> Optional[str]:
+        if st.req.eos_id is not None and tok == st.req.eos_id:
+            return EOS
+        if st.generated >= st.req.max_new_tokens:
+            return LENGTH
+        if st.length >= self.max_len:
+            return LENGTH      # cache slot full — nothing further fits
+        return None
+
+    def _evict(self, slot: int, reason: str) -> None:
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self.cache.release(slot)
+        SERVING_EVICTIONS.add(1)
+        SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
+        st.req._finish(reason)
+
+    # -- gauges --------------------------------------------------------------
+    def _note_ms(self, gauge, attr: str, ms: float) -> None:
+        old = getattr(self, attr)
+        new = old + ms
+        setattr(self, attr, new)
+        gauge.add(int(new) - int(old))
+
+    def _note_tokens(self, n: int) -> None:
+        now = time.monotonic()
+        self._window.append((now, n))
+        while self._window and now - self._window[0][0] > 2.0:
+            self._window.popleft()
+        total = sum(c for _, c in self._window)
+        window_span = now - self._window[0][0]
+        if window_span > 0:
+            SERVING_TOKENS_PER_S.set(max(1, int(total / window_span)))

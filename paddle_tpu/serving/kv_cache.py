@@ -1,0 +1,100 @@
+"""Fixed-slot KV cache for the serving engine.
+
+The reference's inference stack keeps per-predictor scratch memory alive
+across runs (AnalysisPredictor zero-copy tensors); the autoregressive
+analog is the decode cache. This one is Orca/vLLM-slot style, TPU-shaped:
+ONE pair of device buffers
+
+    k, v : (n_slots, n_layers, n_heads, max_len, head_dim)   cfg.dtype
+
+allocated once and donated through every jitted prefill/decode call, so
+steady-state serving allocates nothing and the compiled decode program
+has a single static shape regardless of which slots are live. A slot is
+the unit of admission: a request owns exactly one slot from prefill to
+eviction; per-slot write positions and attention masks come from the
+``positions`` argument of :func:`paddle_tpu.models.gpt_decode_step`, so
+slots at different generation depths batch into one program.
+
+Slot bookkeeping (free list, per-slot length) is host-side — it changes
+at request granularity, not token granularity, and keeping it out of the
+device state keeps the decode step free of host syncs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KVCache", "cache_insert"]
+
+
+def cache_insert(k_cache, v_cache, slot, k_new, v_new):
+    """Write one sequence's prefill entries into a slot.
+
+    k_new/v_new: (L, nh, S, hd) with S <= max_len (gpt_prefill output for
+    one sequence); ``slot`` may be traced — one compiled insert serves
+    every slot. Positions >= S keep whatever they held; decode overwrites
+    position S, S+1, ... before ever attending to them."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new[None].astype(k_cache.dtype), (slot, 0, 0, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new[None].astype(v_cache.dtype), (slot, 0, 0, 0, 0))
+    return k_cache, v_cache
+
+
+class KVCache:
+    """Slotted decode cache: device buffers + host-side slot accounting."""
+
+    def __init__(self, cfg, n_slots: int, max_len: Optional[int] = None,
+                 dtype=None):
+        if max_len is None:
+            max_len = cfg.seq_len
+        if max_len > cfg.seq_len:
+            raise ValueError(
+                f"max_len={max_len} exceeds the model's positional table "
+                f"(cfg.seq_len={cfg.seq_len})")
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len)
+        self.dtype = cfg.dtype if dtype is None else dtype
+        shape = (self.n_slots, cfg.n_layers, cfg.n_heads, self.max_len,
+                 cfg.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        # host-side per-slot token counts (== next write position)
+        self.lengths = np.zeros(self.n_slots, np.int32)
+        self._free: List[int] = list(range(self.n_slots))
+
+    # -- slot accounting -----------------------------------------------------
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot (None when full). Contents are whatever the
+        previous occupant left — prefill overwrites them."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self.lengths[slot] = 0
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+    def __repr__(self):
+        return (f"KVCache(slots={self.n_slots}, max_len={self.max_len}, "
+                f"occupied={self.occupancy}, {self.nbytes / 1e6:.1f}MB)")

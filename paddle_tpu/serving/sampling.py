@@ -1,0 +1,53 @@
+"""Token sampling for the serving engine — vectorized, per-slot params.
+
+One fused function covers greedy, temperature, top-k and top-p so it can
+ride inside the jitted decode step: every slot in the batch carries its
+OWN (temperature, top_k, top_p) triple, which is what continuous batching
+needs — requests with different sampling settings share one compiled
+program. ``temperature <= 0`` means greedy (argmax of the raw logits),
+``top_k <= 0`` and ``top_p >= 1`` disable those filters.
+
+The function is pure jnp, so the FLAGS_serving_jit=0 reference path runs
+the SAME code un-jitted — greedy outputs are identical across the escape
+hatch by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens"]
+
+
+def sample_tokens(logits, key, temperature, top_k, top_p):
+    """logits (B, V) fp32 → token ids (B,) int32.
+
+    temperature/top_p: (B,) float32; top_k: (B,) int32. Filter order
+    matches the usual serving convention: temperature scale → top-k →
+    top-p (nucleus, on the k-filtered distribution) → Gumbel-argmax draw.
+    """
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # top-k with per-row k: keep values >= the k-th largest
+    k_eff = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p: keep the smallest prefix of the sorted distribution whose
+    # mass reaches top_p (the top token always survives)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+    keep = exclusive_cum < top_p[:, None]
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    scaled = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+
+    gumbel = jax.random.gumbel(key, (B, V), jnp.float32)
+    sampled = jnp.argmax(scaled + gumbel, axis=-1)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)

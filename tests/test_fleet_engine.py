@@ -564,6 +564,40 @@ class TestCompiledLossScaling:
             np.testing.assert_array_equal(np.asarray(p._data), w_before[n],
                                           err_msg=n)
 
+    def test_scaler_mirror_syncs_lazily(self):
+        """ISSUE 4 satellite (ROADMAP PR-3 follow-up): the engine no
+        longer float()s the compiled scale every step — the eager
+        GradScaler mirror is armed with a deferred pull and materializes
+        on its next read (log/checkpoint cadence), with correct values."""
+        fleet.init(is_collective=True, strategy=_strategy(dp=4))
+        paddle.seed(17)
+        net = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=512.0,
+                                       incr_every_n_steps=2,
+                                       decr_every_n_nan_or_inf=1)
+        from paddle_tpu.distributed.fleet.engine import FleetEngine
+
+        eng = FleetEngine(net, opt, _strategy(), loss_fn=_mse, scaler=scaler)
+        for x, y in _data(2, batch=8):
+            eng.step((jnp.asarray(x), jnp.asarray(y)))
+            # no blocking read happened: the mirror still holds the
+            # armed callback and the stale host value
+            assert scaler._lazy_sync is not None
+            assert scaler.__dict__["_scale"] == 512.0
+        # first observable read materializes the compiled counters:
+        # 2 finite steps with incr_every_n_steps=2 doubled the scale
+        assert float(scaler.get_loss_scaling()._data) == 1024.0
+        assert scaler._lazy_sync is None
+        assert scaler._good_steps == 0
+        # state_dict (checkpoint path) sees fresh values too
+        x, y = next(_data(1, batch=8))
+        eng.step((jnp.asarray(x), jnp.asarray(y)))
+        assert scaler._lazy_sync is not None
+        assert scaler.state_dict()["scale"] == 1024.0
+        assert scaler._lazy_sync is None
+
     def test_scaled_training_matches_unscaled_math(self):
         """With no overflow, scaled loss + unscale is a numerical no-op."""
         fleet.init(is_collective=True, strategy=_strategy(sharding=2, dp=4))

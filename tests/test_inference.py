@@ -111,6 +111,28 @@ class TestVersionedExport:
         got4 = pred.run([np.ones((4, 6), np.float32)])[0]
         assert got4.shape == (4, 3)
 
+    def test_handle_names_validated_at_creation(self, tmp_path):
+        """ISSUE 4 satellite: a bad handle name fails LOUDLY when the
+        handle is created — not later as a cryptic KeyError inside
+        copy_to_cpu."""
+        prefix = str(tmp_path / "hv")
+        net = paddle.nn.Linear(3, 2)
+        paddle.jit.save(net, prefix,
+                        input_spec=[static.InputSpec([-1, 3], "float32")])
+        from paddle_tpu.inference import Predictor
+
+        pred = Predictor(prefix)
+        with pytest.raises(ValueError, match="get_input_names"):
+            pred.get_input_handle("not_a_feed")
+        with pytest.raises(ValueError, match="get_output_names"):
+            pred.get_output_handle("fetch_99")
+        # the real names still work end-to-end through the handles
+        inp = pred.get_input_handle(pred.get_input_names()[0])
+        inp.copy_from_cpu(np.ones((2, 3), np.float32))
+        pred.run()
+        out = pred.get_output_handle("fetch_0").copy_to_cpu()
+        assert out.shape == (2, 2)
+
 
 class TestPredictorFreshProcess:
     def test_gpt_tiny_served_without_model_code(self, tmp_path):

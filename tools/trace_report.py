@@ -99,6 +99,43 @@ def input_pipeline_report(rows: list, file=None) -> dict:
     return out
 
 
+def serving_report(rows: list, file=None) -> dict:
+    """Prefill-vs-decode verdict from the serving spans (ISSUE 4).
+
+    The serving engine emits ``serving.prefill`` (one per admitted
+    request) and ``serving.decode_step`` (one per batched decode tick)
+    spans. Their split answers the first question about a slow serving
+    trace: is admission (prompt prefill stalls the decode batch for its
+    duration) or steady-state decode eating the time budget?"""
+    pre = [r for r in rows if r["name"] == "serving.prefill"]
+    dec = [r for r in rows if r["name"] == "serving.decode_step"]
+    if not pre and not dec:
+        return {}
+    pre_us = sum(r["total_us"] for r in pre)
+    dec_us = sum(r["total_us"] for r in dec)
+    out = {"prefill_ms": pre_us / 1e3, "decode_ms": dec_us / 1e3,
+           "prefills": sum(r["calls"] for r in pre),
+           "decode_steps": sum(r["calls"] for r in dec)}
+    total = pre_us + dec_us
+    if total > 0:
+        out["prefill_frac"] = pre_us / total
+        out["verdict"] = (
+            "prefill-bound: prompt prefills stall the decode batch for a "
+            "significant share of engine time — bucket prompts tighter, "
+            "admit fewer requests per tick, or chunk long prefills"
+            if pre_us > 0.5 * total else
+            "decode-bound: steady-state batched decode dominates — "
+            "throughput scales with slot occupancy; raise n_slots or "
+            "batch more traffic")
+    print("\nServing engine:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<22}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def report(rows: list, top: int = 20, file=None) -> list:
     rows = rows[:top]
     if not rows:
@@ -122,6 +159,7 @@ def main(argv=None):
     rows = aggregate(load_events(args.trace))
     report(rows, args.top)
     input_pipeline_report(rows)
+    serving_report(rows)
     return rows
 
 
