@@ -87,6 +87,7 @@ from . import fluid  # noqa: F401
 import paddle_tpu.linalg  # noqa: F401,E402
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
+from . import resilience  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 

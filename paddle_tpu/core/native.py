@@ -128,6 +128,13 @@ fast_step = [_truthy(os.environ.get("FLAGS_fast_step", "1"))]
 # sampling) — the numerics escape hatch for debugging cache bugs.
 serving_jit = [_truthy(os.environ.get("FLAGS_serving_jit", "1"))]
 
+# FLAGS_fault_inject (ISSUE 5): deterministic fault-injection spec string
+# (e.g. "nan_grad@step=50:repeat=3,crash@step=120"); empty = no faults.
+# The resilience.faults registry registers a watcher here so set_flags
+# reconfigures it immediately; the cell holds the raw spec text.
+fault_inject = [os.environ.get("FLAGS_fault_inject", "")]
+fault_inject_watchers: list = []
+
 
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
@@ -142,6 +149,10 @@ def set_flag(name: str, value) -> None:
         fast_step[0] = _truthy(value)
     elif name.endswith("serving_jit"):
         serving_jit[0] = _truthy(value)
+    elif name.endswith("fault_inject"):
+        fault_inject[0] = str(value)
+        for watcher in fault_inject_watchers:
+            watcher(fault_inject[0])
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

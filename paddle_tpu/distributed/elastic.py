@@ -53,15 +53,34 @@ class FileKVStore:
             raise ValueError(f"bad key {key!r}")
         return os.path.join(self.root, key)
 
+    # transient-OSError retry budget for put(): the store lives on
+    # NFS/GCS-fuse on real pods, where EIO/ESTALE blips are routine — a
+    # heartbeat that dies on one would scale a healthy node in
+    PUT_RETRIES = 3
+    PUT_BACKOFF = 0.02
+
     def put(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
         path = self._path(key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + f".tmp.{os.getpid()}.{time.monotonic_ns()}"
-        with open(tmp, "wb") as f:
-            f.write(value)
-        os.replace(tmp, path)
+        last: Optional[OSError] = None
+        for attempt in range(self.PUT_RETRIES + 1):
+            tmp = path + f".tmp.{os.getpid()}.{time.monotonic_ns()}"
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(tmp, "wb") as f:
+                    f.write(value)
+                os.replace(tmp, path)
+                return
+            except OSError as e:
+                last = e
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                if attempt < self.PUT_RETRIES:
+                    time.sleep(self.PUT_BACKOFF * (2 ** attempt))
+        raise last
 
     def get(self, key: str) -> Optional[bytes]:
         try:
@@ -120,6 +139,9 @@ class ElasticManager:
         self.ttl = float(heartbeat_ttl)
         self.prefix = f"jobs/{job_id}"
         self.node_prefix = f"{self.prefix}/nodes"
+        # monotonic staleness tracking: host -> (last heartbeat payload
+        # ts, local time.monotonic() when that payload was first seen)
+        self._hb_seen: Dict[str, Tuple[float, float]] = {}
 
     # -- node registry (reference manager.py:176-225) ------------------------
     def register(self, host: str, status: str = "alive") -> None:
@@ -151,8 +173,18 @@ class ElasticManager:
 
     def alive_hosts(self) -> List[str]:
         """Hosts with a fresh, non-tombstoned registration (etcd lease
-        analog)."""
-        now = time.time()
+        analog).
+
+        Staleness is a MONOTONIC-clock delta, not a raw heartbeat-ts /
+        mtime comparison: each manager notes the local
+        ``time.monotonic()`` at which it first observed a given heartbeat
+        payload, and a host goes stale only once the SAME payload has
+        been observed for longer than the ttl. Wall-clock skew between
+        hosts, NTP steps, and NFS server time drift therefore cannot
+        kill a live node (or resurrect a dead one) — the cost is that a
+        pre-existing stale record counts as alive for one ttl after this
+        manager first sees it."""
+        now_m = time.monotonic()
         dead = set(self.dead_hosts())
         alive = []
         for key, raw in self.kv.get_prefix(self.node_prefix).items():
@@ -160,11 +192,16 @@ class ElasticManager:
                 rec = json.loads(raw.decode())
             except (ValueError, UnicodeDecodeError):
                 continue
-            if rec.get("host") in dead or rec.get("status") == "dead":
+            host = rec.get("host")
+            if host in dead or rec.get("status") == "dead":
                 continue
-            if now - float(rec.get("ts", 0)) > self.ttl:
+            ts = float(rec.get("ts", 0))
+            seen = self._hb_seen.get(host)
+            if seen is None or seen[0] != ts:
+                self._hb_seen[host] = (ts, now_m)
+            elif now_m - seen[1] > self.ttl:
                 continue
-            alive.append(rec["host"])
+            alive.append(host)
         return sorted(alive)
 
     # -- quorum / scale (reference _match :247, np watch :205) ---------------
@@ -222,3 +259,14 @@ class ElasticManager:
 
     def completed(self) -> bool:
         return self.kv.get(f"{self.prefix}/completed") == b"1"
+
+    # -- job status (ElasticStatus) ------------------------------------------
+    def set_status(self, status: str) -> None:
+        """Publish a job status (e.g. ElasticStatus.RESTART from the
+        TrainGuardian's preemption handler — the supervising agent reads
+        it and relaunches instead of treating the exit as terminal)."""
+        self.kv.put(f"{self.prefix}/status", status)
+
+    def status(self) -> Optional[str]:
+        raw = self.kv.get(f"{self.prefix}/status")
+        return raw.decode() if raw is not None else None

@@ -32,6 +32,7 @@ from ...nn.clip import ClipGradByGlobalNorm
 from ...nn.layer.layers import Layer
 from ...parallel.mesh import get_mesh, mesh_shape
 from ...parallel.train_step import DistributedTrainStep
+from ...resilience import faults as _faults
 
 __all__ = ["FleetEngine", "build_engine"]
 
@@ -304,7 +305,8 @@ class FleetEngine:
     """
 
     def __init__(self, model: Layer, optimizer, strategy, hcg=None,
-                 loss_fn: Optional[Callable] = None, mesh=None, scaler=None):
+                 loss_fn: Optional[Callable] = None, mesh=None, scaler=None,
+                 sentinel=None):
         from .meta_parallel.pp_layers import PipelineLayer
 
         self.mesh = mesh or get_mesh()
@@ -518,7 +520,7 @@ class FleetEngine:
             step_loss, params, specs, optimizer=optimizer_arg, lr=cfg["lr"],
             clip_norm=cfg["clip_norm"], zero=shard_deg > 1, mesh=self.mesh,
             opt_kwargs=opt_kwargs, aux=buffers,
-            dynamic_scale=dynamic_scale)
+            dynamic_scale=dynamic_scale, sentinel=sentinel)
         if self._scaler is not None:
             # start from the eager scaler's live counters (pull any state a
             # previous engine left pending on the mirror first)
@@ -779,6 +781,12 @@ class FleetEngine:
         return self._step
 
     def step(self, batch):
+        if _faults.ENABLED[0]:
+            # fault-injection hook (FLAGS_fault_inject): the registry
+            # evaluates each step index once, so the inner
+            # DistributedTrainStep hook seeing the same index is a no-op
+            batch = _faults.FAULTS.on_train_step(
+                self._step._step_count, batch)
         x, y = batch
         x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         y = y._data if isinstance(y, Tensor) else jnp.asarray(y)
@@ -810,6 +818,6 @@ class FleetEngine:
 
 
 def build_engine(model, optimizer, strategy, hcg=None, loss_fn=None,
-                 mesh=None) -> FleetEngine:
+                 mesh=None, sentinel=None) -> FleetEngine:
     return FleetEngine(model, optimizer, strategy, hcg=hcg, loss_fn=loss_fn,
-                       mesh=mesh)
+                       mesh=mesh, sentinel=sentinel)

@@ -20,7 +20,10 @@ mesh layout works because orbax stores the global array + metadata).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+import time
+import warnings
+from typing import Any, Optional, Tuple
 
 import jax
 
@@ -33,6 +36,33 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _save_attempt_hook() -> None:
+    """ckpt_io_error fault-injection point (resilience.faults) — raises a
+    transient OSError exactly where a flaky NFS/GCS-fuse mount would."""
+    from ..resilience.faults import ENABLED, FAULTS
+
+    if ENABLED[0]:
+        FAULTS.on_ckpt_io()
+
+
+def _with_io_retry(fn, what: str, retries: int = 3, backoff: float = 0.05):
+    """Run ``fn`` retrying transient OSErrors with exponential backoff —
+    checkpoint storage on real pods is NFS/GCS-fuse, where EIO/ESTALE
+    blips are routine and a retry is the correct first response."""
+    for attempt in range(retries + 1):
+        try:
+            _save_attempt_hook()
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            warnings.warn(f"transient OSError during {what} "
+                          f"(attempt {attempt + 1}/{retries + 1}): {e}; "
+                          f"retrying in {delay:.2f}s")
+            time.sleep(delay)
+
+
 def _abstract_tree(tree):
     """Pytree of arrays → matching ShapeDtypeStructs (with shardings) used
     to direct a placement-aware restore."""
@@ -43,15 +73,40 @@ def _abstract_tree(tree):
         tree)
 
 
-def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
-    """Write a sharded checkpoint of a pytree of jax arrays."""
+def save_checkpoint(path: str, state: Any, force: bool = True,
+                    retries: int = 3) -> None:
+    """Write a sharded checkpoint of a pytree of jax arrays.
+
+    Crash-safe: the tree is written to a sibling tmp dir and
+    atomic-renamed into place, so a reader never observes a
+    half-written checkpoint at ``path`` — a crash mid-save leaves either
+    the previous complete checkpoint or a ``.tmp-*`` leftover that
+    ``load_checkpoint`` ignores. Transient OSErrors are retried with
+    exponential backoff."""
     path = os.path.abspath(path)
-    ckptr = _checkpointer()
+    tmp = f"{path}.tmp-{os.getpid()}-{time.monotonic_ns()}"
+
+    def attempt():
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        ckptr = _checkpointer()
+        try:
+            ckptr.save(tmp, state, force=True)
+            ckptr.wait_until_finished()
+        finally:
+            ckptr.close()
+        if os.path.exists(path):
+            if not force:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise ValueError(f"checkpoint {path} exists (force=False)")
+            shutil.rmtree(path)
+        os.rename(tmp, path)
+
     try:
-        ckptr.save(path, state, force=force)
-        ckptr.wait_until_finished()
+        _with_io_retry(attempt, f"checkpoint save to {path}",
+                       retries=retries)
     finally:
-        ckptr.close()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def load_checkpoint(path: str, template: Optional[Any] = None) -> Any:
@@ -81,11 +136,13 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, save_interval_steps: int = 1,
-                 max_to_keep: Optional[int] = 3, async_save: bool = True):
+                 max_to_keep: Optional[int] = 3, async_save: bool = True,
+                 save_retries: int = 3):
         import orbax.checkpoint as ocp
 
         self.directory = os.path.abspath(directory)
         self.save_interval_steps = max(1, int(save_interval_steps))
+        self.save_retries = int(save_retries)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=self.save_interval_steps,
@@ -125,21 +182,28 @@ class CheckpointManager:
 
     # -- save/restore -------------------------------------------------------
 
-    def maybe_save(self, step: int, obj) -> bool:
-        """Interval-gated snapshot; returns False when skipped."""
+    def _save(self, step: int, obj, force: bool) -> bool:
         import orbax.checkpoint as ocp
 
         state = self._state_of(obj)
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+        return _with_io_retry(
+            lambda: self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                   force=force),
+            f"checkpoint save (step {step})", retries=self.save_retries)
+
+    def maybe_save(self, step: int, obj) -> bool:
+        """Interval-gated snapshot; returns False when skipped. Transient
+        OSErrors (flaky NFS/GCS-fuse) are retried with backoff."""
+        # gate BEFORE touching storage so skipped intervals cost nothing
+        # (and the fault-injection hook only fires on real save attempts)
+        if not self._mgr.should_save(step):
+            return False
+        return self._save(step, obj, force=False)
 
     def save(self, step: int, obj) -> bool:
         """Unconditional snapshot (bypasses save_interval_steps) — for the
         final checkpoint before shutdown."""
-        import orbax.checkpoint as ocp
-
-        state = self._state_of(obj)
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                              force=True)
+        return self._save(step, obj, force=True)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -156,25 +220,47 @@ class CheckpointManager:
         return self._install(obj, restored)
 
     def restore_latest(self, obj) -> Optional[int]:
-        """Auto-resume: restore the newest snapshot into ``obj``; returns
-        the step to continue FROM (restored step + 1) or None if no
-        checkpoint exists (reference AutoCheckpointChecker semantics).
+        """Auto-resume: restore the newest INTACT snapshot into ``obj``;
+        returns the step to continue FROM (restored step + 1) or None if
+        nothing is restorable (reference AutoCheckpointChecker semantics).
+
+        A crash mid-save can leave the newest step dir incomplete or
+        corrupt; rather than wedging the relaunch, such steps are skipped
+        with a warning and the next-newest one is tried.
 
         Only in-place-restorable objects (DistributedTrainStep-like) are
         accepted — a raw pytree could not receive the restored arrays, so
         it is rejected rather than silently resuming from stale weights;
-        use ``restore(step, template)`` for raw trees."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        out = self.restore(step, obj)
-        if out is not obj:
-            raise TypeError(
-                "restore_latest needs an object with .params/.opt_state to "
-                "install into; for a raw pytree use "
-                "mgr.restore(mgr.latest_step(), template) and keep the "
-                "returned tree")
-        return step + 1
+        use ``restore_latest_tree(template)`` for raw trees."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            try:
+                out = self.restore(step, obj)
+            except Exception as e:  # noqa: BLE001 — skip corrupt, keep looking
+                warnings.warn(
+                    f"skipping unreadable checkpoint step {step} in "
+                    f"{self.directory}: {type(e).__name__}: {e}")
+                continue
+            if out is not obj:
+                raise TypeError(
+                    "restore_latest needs an object with .params/.opt_state "
+                    "to install into; for a raw pytree use "
+                    "restore_latest_tree(template) and keep the returned "
+                    "tree")
+            return step + 1
+        return None
+
+    def restore_latest_tree(self, template) -> Optional[Tuple[int, Any]]:
+        """Raw-pytree twin of :meth:`restore_latest`: returns
+        ``(step, restored_tree)`` from the newest intact snapshot, or
+        None. Corrupt/incomplete step dirs are skipped with a warning."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            try:
+                return step, self.restore(step, template)
+            except Exception as e:  # noqa: BLE001
+                warnings.warn(
+                    f"skipping unreadable checkpoint step {step} in "
+                    f"{self.directory}: {type(e).__name__}: {e}")
+        return None
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()

@@ -335,13 +335,20 @@ class AsyncLoss(Tensor):
     numpy()/item()/bool()) is the sync point — counted once per handle by
     the ``step_async_syncs`` gauge, so a training loop that accidentally
     materializes every step shows up as step_async_syncs == train_steps.
+
+    When the step carries an in-jit health sentinel
+    (paddle_tpu.resilience), ``health`` holds its un-awaited device
+    scalars ({"trip", "trips"}) — reading THEM is also a sync, so the
+    guardian controls when (and whether) the verdict costs a host
+    round-trip.
     """
 
-    __slots__ = ("_synced",)
+    __slots__ = ("_synced", "health")
 
     def __init__(self, data, name=None):
         super().__init__(data, stop_gradient=True, name=name)
         self._synced = False
+        self.health = None
 
     def _materialize(self):
         if not self._synced:

@@ -109,7 +109,7 @@ class Model:
         return feed
 
     # -- core steps ----------------------------------------------------------
-    def _build_train_step(self):
+    def _build_train_step(self, sentinel=None):
         from ..jit import TrainStep
 
         loss_layer = self._loss
@@ -120,7 +120,8 @@ class Model:
             out = run_model(*ins)
             return loss_layer(out, label)
 
-        return TrainStep(self.network, loss_fn, self._optimizer)
+        return TrainStep(self.network, loss_fn, self._optimizer,
+                         sentinel=sentinel)
 
     def train_batch(self, inputs, labels=None, update=True, sync=True):
         """One training step. ``sync=False`` (the fit() fast path) returns
@@ -219,7 +220,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, resilience=None):
         train_loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         steps = None
@@ -233,6 +234,29 @@ class Model:
             save_freq=save_freq, save_dir=save_dir,
             metrics=["loss"] + [n for m in self._metrics for n in _to_list(m.name())])
         self.stop_training = False
+        # resilience=... wires a TrainGuardian around the jitted step: the
+        # in-jit sentinel skips poisoned updates, repeated trips rewind to
+        # the guardian's host snapshot (the epoch restarts with a fresh —
+        # re-seeded when shuffle=True — batch order), SIGTERM forces a
+        # priority checkpoint and stops cleanly. Pass a TrainGuardian, a
+        # kwargs dict for one, or True for defaults.
+        guardian = None
+        if resilience is not None and resilience is not False \
+                and getattr(self, "_static", None) is None and self._use_jit:
+            from ..resilience.guardian import TrainGuardian
+
+            if isinstance(resilience, TrainGuardian):
+                guardian = resilience
+            else:
+                kwargs = {} if resilience is True else dict(resilience)
+                guardian = TrainGuardian(**kwargs)
+            if self._train_step is None:
+                self._train_step = self._build_train_step(
+                    sentinel=guardian.sentinel_config)
+            if guardian._obj is None:
+                guardian.attach(self._train_step)
+            guardian.install_preemption_handler()
+            guardian.restore_latest()
         cbks.on_train_begin({})
         # FLAGS_fast_step input-and-step fast path: batches are device_put
         # one step ahead (double buffering — the H2D copy of batch N+1
@@ -242,11 +266,13 @@ class Model:
         # a device round-trip each (step_async_syncs counts the blocks).
         fast = _fast_step[0] and getattr(self, "_static", None) is None
         loss_val = None
-        for epoch in range(epochs):
+        epoch = 0
+        while epoch < epochs:
             cbks.on_epoch_begin(epoch, {})
             epoch_iter = (DevicePrefetcher(train_loader, size=2) if fast
                           else train_loader)
             pending = None
+            restart_epoch = False
             for step, batch in enumerate(epoch_iter):
                 cbks.on_train_batch_begin(step, {})
                 *ins, label = batch if isinstance(batch, (list, tuple)) else (batch,)
@@ -261,18 +287,34 @@ class Model:
                     loss_val = raw
                 logs = {"loss": loss_val}
                 cbks.on_train_batch_end(step, logs)
+                if guardian is not None:
+                    action = guardian.after_step(
+                        self._train_step._step_count - 1, raw)
+                    if action == "rollback":
+                        # state rewound to the snapshot; replay the epoch
+                        # with a fresh batch order
+                        pending = None
+                        restart_epoch = True
+                        break
+                    if action == "preempt":
+                        self.stop_training = True
+                        break
                 if num_iters is not None and step + 1 >= num_iters:
                     break
+            if restart_epoch:
+                continue
             if pending is not None:  # epoch-end logs carry the real value
                 loss_val = float(pending)
                 logs = {"loss": loss_val}
             self._sync_train_step()
             cbks.on_epoch_end(epoch, logs if steps else {})
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0 \
+                    and not self.stop_training:
                 self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
                               callbacks=cbks)
             if self.stop_training:
                 break
+            epoch += 1
         self._sync_train_step()
         cbks.on_train_end({})
 

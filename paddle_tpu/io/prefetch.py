@@ -36,6 +36,7 @@ from ..framework.core import Tensor
 from ..monitor import stats as _mstats
 from ..monitor.trace import TRACING as _TRACING
 from ..monitor.trace import get_writer as _trace_writer
+from ..resilience import faults as _faults
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device"]
 
@@ -110,7 +111,12 @@ class DevicePrefetcher:
 
         def producer():
             try:
-                for batch in self._it:
+                for idx, batch in enumerate(self._it):
+                    if _faults.ENABLED[0]:
+                        # input_stall@step=N fault hook (resilience.faults):
+                        # a sleeping producer starves the consumer exactly
+                        # like a wedged storage read would
+                        _faults.FAULTS.on_input(idx)
                     t0 = time.perf_counter()
                     staged = self._put_batch(batch, sharding)
                     dt = time.perf_counter() - t0

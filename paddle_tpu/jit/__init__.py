@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from ..core.native import fast_step as _fast_step
 from ..framework.core import AsyncLoss, Parameter, Tensor
 from ..nn.layer.layers import Layer
+from ..resilience import faults as _faults
+from ..resilience import sentinel as _sentinel
 
 __all__ = ["state", "functional_call", "to_static", "TrainStep", "not_to_static",
            "ProgramTranslator", "TracedLayer", "TranslatedLayer",
@@ -211,11 +213,19 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, grad_postprocess: Optional[Callable] = None):
+                 donate: bool = True, grad_postprocess: Optional[Callable] = None,
+                 sentinel=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.grad_postprocess = grad_postprocess
+        # optional in-jit health sentinel (paddle_tpu.resilience): verdict
+        # + trip counter carried as device state, update gated on it
+        self._sentinel_cfg = (_sentinel.normalize_config(sentinel)
+                              if sentinel else None)
+        self.sentinel_state = (_sentinel.init_state()
+                               if self._sentinel_cfg is not None else None)
+        self._step_count = 0
         self._param_names = [k for k, _ in model.named_parameters()]
         self._params = {k: p for k, p in model.named_parameters()}
         # materialize slots eagerly in deterministic order
@@ -243,9 +253,11 @@ class TrainStep:
         pure_update = type(opt)._pure_update
         grad_post = self.grad_postprocess
 
+        sentinel_cfg = self._sentinel_cfg
+
         # loss_fn contract: loss_fn(run_model, *batch_tensors) -> loss Tensor,
         # where run_model(*model_inputs) executes the params-bound model.
-        def step_impl(params, slots, buffers, lr, batch):
+        def step_impl(params, slots, buffers, lr, batch, sent_state):
             def loss_of(params):
                 args = _tree_array_to_tensor(batch)
                 captured = dict(buffers)
@@ -272,12 +284,26 @@ class TrainStep:
                     out = (out,)
                 new_params[k] = out[0]
                 new_slots[k] = list(out[1:])
-            return new_params, new_slots, new_buffers, loss
+            if sent_state is not None:
+                # in-jit health verdict + GradScaler-style skip gate
+                # (resilience.sentinel): a tripped step is a no-op
+                gnorm = _sentinel.global_grad_norm(grads)
+                sent_state = _sentinel.update(sent_state, loss, gnorm,
+                                              sentinel_cfg)
+                trip = sent_state["last_trip"]
+                new_params = _sentinel.gate(trip, new_params, params)
+                new_slots = _sentinel.gate(trip, new_slots, slots)
+                new_buffers = _sentinel.gate(trip, new_buffers, buffers)
+            return new_params, new_slots, new_buffers, loss, sent_state
 
         # pure step exposed for K-steps-in-one-jit timing (bench.py) and
-        # custom outer loops; _compiled is the per-call dispatch path,
-        # _compiled_fast additionally donates the buffer tree (FLAGS_fast_step)
-        self._step_impl = step_impl
+        # custom outer loops — keeps the historical 5-arg/4-output
+        # contract (no sentinel state); _compiled is the per-call dispatch
+        # path, _compiled_fast additionally donates the buffer tree
+        # (FLAGS_fast_step)
+        self._step_impl = (
+            lambda p, s, b, lr, batch: step_impl(p, s, b, lr, batch,
+                                                 None)[:4])
         self._compiled = jax.jit(step_impl, donate_argnums=(0, 1))
         self._compiled_fast = jax.jit(step_impl, donate_argnums=(0, 1, 2))
         self._buffer_tensors = {k: b for k, b in self.model.named_buffers()
@@ -286,14 +312,20 @@ class TrainStep:
     def __call__(self, *batch):
         if self._compiled is None:
             self._build()
+        if _faults.ENABLED[0]:
+            # fault-injection hook (FLAGS_fault_inject) — see
+            # resilience.faults; one list-index check when idle
+            batch = _faults.FAULTS.on_train_step(self._step_count, batch)
+        self._step_count += 1
         if _fast_step[0]:
             return self._call_fast(batch)
         params = {k: self._params[k]._data for k in self._param_names}
         buffers = {k: b._data for k, b in self.model.named_buffers() if b is not None}
         lr = self.optimizer.get_lr()
         arr_batch = _tree_tensor_to_array(batch)
-        new_params, new_slots, new_buffers, loss = self._compiled(
-            params, self._slot_values, buffers, lr, arr_batch)
+        new_params, new_slots, new_buffers, loss, self.sentinel_state = \
+            self._compiled(params, self._slot_values, buffers, lr, arr_batch,
+                           self.sentinel_state)
         for k in self._param_names:
             self._params[k]._data = new_params[k]
             self._slot_values[k] = new_slots[k]
@@ -322,15 +354,21 @@ class TrainStep:
             # fresh host->device transfer every step
             self._lr_cache = (lr, jnp.float32(lr))
         arr_batch = _tree_tensor_to_array(batch)
-        new_params, new_slots, new_buffers, loss = self._compiled_fast(
-            params, self._slot_values, buffers, self._lr_cache[1], arr_batch)
+        new_params, new_slots, new_buffers, loss, self.sentinel_state = \
+            self._compiled_fast(params, self._slot_values, buffers,
+                                self._lr_cache[1], arr_batch,
+                                self.sentinel_state)
         for k in self._param_names:
             self._params[k]._data = new_params[k]
             self._slot_values[k] = new_slots[k]
         for name, arr in new_buffers.items():
             self._buffer_tensors[name]._data = arr
         self._slots_dirty = True
-        return AsyncLoss(loss)
+        out = AsyncLoss(loss)
+        if self.sentinel_state is not None:
+            out.health = {"trip": self.sentinel_state["last_trip"],
+                          "trips": self.sentinel_state["trips"]}
+        return out
 
     def sync(self):
         """Flush lazily-deferred state mirrors (optimizer slot dicts) so

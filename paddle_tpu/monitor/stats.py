@@ -164,6 +164,13 @@ DEFAULT_STATS = (
     "serving_decode_ms",       # cumulative batched decode-tick wall time (ms)
     "serving_tokens_per_s",    # gauge: recent generation rate (tokens/s)
     "serving_evictions",       # sequences evicted from slots (eos/len/deadline/cancel)
+    # self-healing training (ISSUE 5)
+    "faults_injected",        # FLAGS_fault_inject faults actually fired
+    "sentinel_trips",         # in-jit health verdict trips observed by the guardian
+    "rollbacks",              # guardian rewinds to the host snapshot
+    "preempt_saves",          # SIGTERM-forced priority checkpoint saves
+    "watchdog_stalls",        # stalled-step detections by the watchdog thread
+    "guardian_heartbeat_ms",  # gauge: monotonic ms of the last guarded step
 )
 
 for _n in DEFAULT_STATS:
@@ -192,6 +199,12 @@ SERVING_PREFILL_MS = _registry.get_stat("serving_prefill_ms")
 SERVING_DECODE_MS = _registry.get_stat("serving_decode_ms")
 SERVING_TOKENS_PER_S = _registry.get_stat("serving_tokens_per_s")
 SERVING_EVICTIONS = _registry.get_stat("serving_evictions")
+FAULTS_INJECTED = _registry.get_stat("faults_injected")
+SENTINEL_TRIPS = _registry.get_stat("sentinel_trips")
+ROLLBACKS = _registry.get_stat("rollbacks")
+PREEMPT_SAVES = _registry.get_stat("preempt_saves")
+WATCHDOG_STALLS = _registry.get_stat("watchdog_stalls")
+GUARDIAN_HEARTBEAT_MS = _registry.get_stat("guardian_heartbeat_ms")
 
 
 # per-mesh-axis device-memory gauges published by the last

@@ -25,6 +25,8 @@ from ..core.native import fast_step as _fast_step
 from ..framework.core import AsyncLoss as _AsyncLoss
 from ..monitor import stats as _mstats
 from ..monitor.trace import span as _trace_span
+from ..resilience import faults as _faults
+from ..resilience import sentinel as _sentinel
 from .mesh import get_mesh, mesh_shape
 from .sharding import zero_shard_specs
 
@@ -275,6 +277,13 @@ class DistributedTrainStep:
         same program. Keys (GradScaler names): init_scale, incr_ratio,
         decr_ratio, incr_every_n_steps, decr_every_n. State lives in
         ``self.scaler_state`` {"scale","good","bad"} (host-readable).
+      sentinel: optional resilience.sentinel config (True for defaults):
+        a per-step health verdict (loss/grad-norm finiteness + EMA
+        z-score spike) computed INSIDE the compiled step; the whole
+        update is gated on it (a tripped step is a no-op,
+        GradScaler-style) and a device trip counter is carried in
+        ``self.sentinel_state`` — no host syncs are added; TrainGuardian
+        reads the counter at its own cadence.
     """
 
     def __init__(self, loss_fn: Callable, params, param_specs,
@@ -283,7 +292,8 @@ class DistributedTrainStep:
                  clip_norm: Optional[float] = None, zero: bool = True,
                  mesh=None, opt_kwargs: Optional[dict] = None,
                  aux=None, aux_specs=None,
-                 dynamic_scale: Optional[dict] = None):
+                 dynamic_scale: Optional[dict] = None,
+                 sentinel=None):
         self.mesh = mesh or get_mesh()
         if self.mesh is None:
             raise RuntimeError("DistributedTrainStep needs a mesh "
@@ -356,7 +366,13 @@ class DistributedTrainStep:
         else:
             self.scaler_state = None
 
-        def step(params, opt_state, aux, batch, lr, scaler_state):
+        self._sentinel_cfg = (_sentinel.normalize_config(sentinel)
+                              if sentinel else None)
+        self.sentinel_state = (_sentinel.init_state()
+                               if self._sentinel_cfg is not None else None)
+
+        def step(params, opt_state, aux, batch, lr, scaler_state,
+                 sent_state):
             scale = (scaler_state["scale"] if scaler_state is not None
                      else jnp.float32(1.0))
 
@@ -385,6 +401,10 @@ class DistributedTrainStep:
                 finite = jnp.array(True)
                 for g in jax.tree_util.tree_leaves(grads):
                     finite &= jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+            # raw (pre-clip) global grad norm: clipping would cap exactly
+            # the spikes the sentinel exists to catch
+            sent_gnorm = (_sentinel.global_grad_norm(grads)
+                          if sent_state is not None else None)
             if self._clip is not None:
                 grads, _ = global_norm_clip(grads, self._clip)
             new_params, new_opt = self._update_fn(
@@ -412,18 +432,34 @@ class DistributedTrainStep:
                 scaler_state = {"scale": new_scale,
                                 "good": jnp.where(incr, 0, good),
                                 "bad": jnp.where(decr, 0, bad)}
-            return new_params, new_opt, new_aux, loss, scaler_state
+            if sent_state is not None:
+                # in-jit health verdict (resilience.sentinel): finiteness
+                # + EMA z-spike on the raw global grad norm, then the
+                # GradScaler-style gate — a tripped step leaves params,
+                # optimizer state and buffers untouched
+                sent_state = _sentinel.update(sent_state, loss, sent_gnorm,
+                                              self._sentinel_cfg)
+                trip = sent_state["last_trip"]
+                new_params = _sentinel.gate(trip, new_params, params)
+                new_opt = _sentinel.gate(trip, new_opt, opt_state)
+                if self._has_aux:
+                    new_aux = _sentinel.gate(trip, new_aux, aux)
+            return new_params, new_opt, new_aux, loss, scaler_state, \
+                sent_state
 
         repl = NamedSharding(self.mesh, P())
         aux_sh = self._aux_sh if self._has_aux else None
         scaler_sh = ({"scale": repl, "good": repl, "bad": repl}
                      if self._dyn is not None else None)
+        sent_sh = (jax.tree_util.tree_map(lambda _: repl,
+                                          self.sentinel_state)
+                   if self.sentinel_state is not None else None)
         self._step = jax.jit(
             step,
             in_shardings=(self._param_sh, self._opt_sh, aux_sh, batch_sh,
-                          repl, scaler_sh),
+                          repl, scaler_sh, sent_sh),
             out_shardings=(self._param_sh, self._opt_sh, aux_sh, repl,
-                           scaler_sh),
+                           scaler_sh, sent_sh),
             donate_argnums=(0, 1, 2) if self._has_aux else (0, 1),
         )
         self._step_count = 0
@@ -443,6 +479,11 @@ class DistributedTrainStep:
         return float(self._lr)
 
     def __call__(self, batch):
+        if _faults.ENABLED[0]:
+            # fault-injection hook (FLAGS_fault_inject): may corrupt the
+            # batch (nan_grad), sleep (stall), raise (crash), or SIGTERM
+            # ourselves (preempt); one list-index check when idle
+            batch = _faults.FAULTS.on_train_step(self._step_count, batch)
         lrf = self.current_lr()
         if _fast_step[0]:
             if self._lr_cache[0] != lrf:
@@ -463,16 +504,20 @@ class DistributedTrainStep:
                          args={"step": self._step_count}):
             with self.mesh:
                 (self.params, self.opt_state, self.aux, loss,
-                 self.scaler_state) = self._step(
+                 self.scaler_state, self.sentinel_state) = self._step(
                     self.params, self.opt_state, self.aux, batch, lr,
-                    self.scaler_state)
+                    self.scaler_state, self.sentinel_state)
         self._step_count += 1
         _mstats.TRAIN_STEPS.add()
         if _fast_step[0]:
             # async handle: params/opt-state stay device-resident and the
             # dispatch is not awaited; the first host read of the loss is
             # the sync point (step_async_syncs gauge)
-            return _AsyncLoss(loss)
+            out = _AsyncLoss(loss)
+            if self.sentinel_state is not None:
+                out.health = {"trip": self.sentinel_state["last_trip"],
+                              "trips": self.sentinel_state["trips"]}
+            return out
         return loss
 
     def loss_scale(self) -> Optional[float]:
@@ -487,4 +532,4 @@ class DistributedTrainStep:
         tests, SURVEY.md §4.6)."""
         return self._step.lower(self.params, self.opt_state, self.aux, batch,
                                 jnp.float32(self.current_lr()),
-                                self.scaler_state)
+                                self.scaler_state, self.sentinel_state)

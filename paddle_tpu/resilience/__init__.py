@@ -1,0 +1,69 @@
+"""paddle_tpu.resilience — self-healing training.
+
+The reference ships fault tolerance as a first-class capability (fleet
+elastic relaunch-on-membership-change, AutoCheckpoint auto-resume,
+FLAGS_check_nan_inf); this package composes our equivalents —
+CheckpointManager, ElasticManager, the compiled train step — into a loop
+that survives the failure modes a preemptible TPU fleet actually hits,
+and makes every one of them deterministically reproducible on CPU via
+``FLAGS_fault_inject`` (:mod:`.faults`).
+
+Failure modes and their handling:
+
+===============  ==========================  ================================
+failure          detected by                 handled by
+===============  ==========================  ================================
+NaN/Inf loss or  in-jit sentinel             in-jit update gate skips the
+gradients        (:mod:`.sentinel`           step (params untouched); trip
+                 finiteness check)           counted on device
+loss/grad-norm   sentinel EMA z-score        same skip gate; escalates like
+spike            (``z > z_thresh``)          a NaN trip
+repeated trips   TrainGuardian ladder        rollback to the host-offloaded
+(> skip_limit)   (:mod:`.guardian`)          snapshot + re-seeded data order
+trips past       TrainGuardian               :class:`TrainingAborted` —
+max_rollbacks                                stop burning accelerator time
+process crash    next launch                 ``restore_latest()`` resumes
+                                             from the newest intact on-disk
+                                             checkpoint (corrupt step dirs
+                                             skipped with a warning)
+preemption       SIGTERM handler             priority orbax save +
+(SIGTERM)                                    ``ElasticStatus.RESTART`` mark
+stalled step     watchdog thread vs the      ``watchdog_stalls`` gauge,
+                 heartbeat gauge             all-thread stack dump, trace
+                                             flush
+flaky ckpt I/O   OSError during save         retry with exponential backoff
+                                             (framework/checkpoint.py)
+===============  ==========================  ================================
+
+Gauges: ``faults_injected``, ``sentinel_trips``, ``rollbacks``,
+``preempt_saves``, ``watchdog_stalls``, ``guardian_heartbeat_ms``.
+Trace spans: ``resilience.snapshot`` / ``resilience.rollback`` /
+``resilience.preempt_save`` + ``resilience.trip`` instants —
+``tools/trace_report.py`` renders them as a resilience timeline.
+
+Wired in: ``hapi.Model.fit(resilience=...)`` and
+``FleetEngine(..., sentinel=...)`` + ``TrainGuardian.attach(engine)``;
+any hand-written loop can use :class:`TrainGuardian` directly (see its
+docstring for the canonical loop shape).
+"""
+from . import faults  # noqa: F401  (registers the FLAGS_fault_inject watcher)
+from .faults import FAULTS, FaultSpec, InjectedCrash, configure_faults
+from . import sentinel  # noqa: F401
+
+__all__ = [
+    "faults", "sentinel", "FAULTS", "FaultSpec", "InjectedCrash",
+    "configure_faults", "TrainGuardian", "TrainingAborted", "guardian",
+]
+
+
+def __getattr__(name):
+    # guardian pulls in framework.checkpoint (orbax) — lazy so fault
+    # hooks in hot paths never pay for it
+    if name in ("TrainGuardian", "TrainingAborted", "guardian"):
+        import importlib
+
+        mod = importlib.import_module(".guardian", __name__)
+        if name == "guardian":
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,277 @@
+"""Deterministic fault injection (FLAGS_fault_inject).
+
+Production failure modes — NaN gradients, process crashes, TPU-slice
+preemption, flaky checkpoint filesystems, stalled input pipelines — are
+rare in CI and constant in the field. This registry makes each of them a
+one-flag reproduction so the failure path is exercised as routinely as
+the hot path (Orca/vLLM-style engineering; the reference ships the same
+spirit as FLAGS_check_nan_inf + elastic relaunch tests).
+
+Spec grammar (comma/semicolon-separated)::
+
+    FLAGS_fault_inject="nan_grad@step=50:repeat=3,crash@step=120"
+    FLAGS_fault_inject="ckpt_io_error@p=0.5:seed=7:repeat=4"
+    FLAGS_fault_inject="stall@step=80:secs=2,preempt@step=200"
+
+Each fault is ``kind@trigger[:opt=value]*`` where trigger is either
+``step=N`` (fires on the first ``repeat`` step-encounters with index >=
+N — consecutive steps, and NOT again after the budget is spent, so a
+rollback replay of the same step indices runs clean) or ``p=F`` (fires
+per encounter with probability F from a private ``seed``-ed RNG —
+deterministic across runs). Options: ``repeat`` (default 1 for step
+faults, unlimited for p faults), ``secs`` (stall duration), ``seed``.
+
+Kinds and their hook points:
+
+=============  ==========================================  ===============
+kind           effect                                      hook point
+=============  ==========================================  ===============
+nan_grad       float leaves of the batch become NaN        train steps
+crash          raises :class:`InjectedCrash`               train steps
+preempt        ``signal.raise_signal(SIGTERM)``            train steps
+stall          ``time.sleep(secs)`` inside the step        train steps
+input_stall    ``time.sleep(secs)`` in the prefetcher      io/prefetch.py
+ckpt_io_error  raises ``OSError`` during checkpoint save   framework/checkpoint.py
+=============  ==========================================  ===============
+
+Train-step hooks live in ``parallel/train_step.py``,
+``distributed/fleet/engine.py`` and ``jit.TrainStep``; the registry
+evaluates each step index ONCE and hands each fired fault to the first
+hook that claims it, so the fleet engine wrapping a DistributedTrainStep
+does not double-fire.
+
+Cost when idle: every hook site guards on ``ENABLED[0]`` (one list
+index), and with the flag unset no batch is touched — training is
+bit-for-bit identical to a build without this module.
+"""
+from __future__ import annotations
+
+import random as _random
+import signal
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import native as _native
+from ..monitor import stats as _mstats
+
+__all__ = ["FaultSpec", "FaultRegistry", "InjectedCrash", "FAULTS",
+           "ENABLED", "configure_faults"]
+
+# fast-path gate: hook sites read ENABLED[0] before touching the registry
+ENABLED = [False]
+
+_STEP_KINDS = ("nan_grad", "crash", "preempt", "stall")
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by a ``crash@step=N`` fault — stands in for a worker dying
+    mid-step (segfault, OOM-kill, device wedging)."""
+
+
+class FaultSpec:
+    """One parsed fault clause."""
+
+    __slots__ = ("kind", "step", "p", "repeat", "secs", "seed",
+                 "remaining", "_rng")
+
+    def __init__(self, kind: str, step: Optional[int] = None,
+                 p: Optional[float] = None, repeat: Optional[int] = None,
+                 secs: float = 1.0, seed: int = 0):
+        if (step is None) == (p is None):
+            raise ValueError(
+                f"fault {kind!r} needs exactly one trigger: step=N or p=F")
+        self.kind = kind
+        self.step = step
+        self.p = p
+        # step faults default to firing once; p faults to unlimited
+        self.repeat = repeat if repeat is not None else (1 if p is None
+                                                        else -1)
+        self.secs = float(secs)
+        self.seed = int(seed)
+        self.remaining = self.repeat
+        self._rng = _random.Random(self.seed)
+
+    def spent(self) -> bool:
+        return self.remaining == 0
+
+    def consume(self) -> None:
+        if self.remaining > 0:
+            self.remaining -= 1
+
+    def __repr__(self):
+        trig = f"step={self.step}" if self.step is not None else f"p={self.p}"
+        return (f"FaultSpec({self.kind}@{trig}, repeat={self.repeat}, "
+                f"remaining={self.remaining})")
+
+
+def parse_spec(text: str) -> List[FaultSpec]:
+    """Parse a FLAGS_fault_inject value into FaultSpecs (empty for '')."""
+    out: List[FaultSpec] = []
+    for clause in text.replace(";", ",").split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "@" not in clause:
+            raise ValueError(f"bad fault clause {clause!r} (need kind@trigger)")
+        kind, rest = clause.split("@", 1)
+        kw: Dict[str, str] = {}
+        for part in rest.split(":"):
+            if "=" not in part:
+                raise ValueError(f"bad fault option {part!r} in {clause!r}")
+            k, v = part.split("=", 1)
+            kw[k.strip()] = v.strip()
+        out.append(FaultSpec(
+            kind.strip(),
+            step=int(kw["step"]) if "step" in kw else None,
+            p=float(kw["p"]) if "p" in kw else None,
+            repeat=int(kw["repeat"]) if "repeat" in kw else None,
+            secs=float(kw.get("secs", 1.0)),
+            seed=int(kw.get("seed", 0))))
+    return out
+
+
+def _corrupt_batch(batch):
+    """NaN the float leaves of a batch pytree (lists/tuples/dicts of
+    Tensors / numpy / jax arrays). Integer leaves are untouched — NaN has
+    no integer encoding — so nan_grad needs at least one float input."""
+    from ..framework.core import Tensor
+
+    def walk(x):
+        if isinstance(x, (list, tuple)):
+            return type(x)(walk(v) for v in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        if isinstance(x, Tensor):
+            return Tensor(walk(x._data), stop_gradient=x.stop_gradient,
+                          name=x.name)
+        dt = getattr(x, "dtype", None)
+        if dt is not None and np.issubdtype(np.dtype(dt), np.floating):
+            return x * float("nan")
+        return x
+
+    return walk(batch)
+
+
+class FaultRegistry:
+    """Holds the configured faults and evaluates them at the hook points.
+
+    Step-keyed faults are evaluated once per step INDEX (the first hook
+    to see a new index computes which faults fire; re-asking for the same
+    index — e.g. FleetEngine.step delegating to DistributedTrainStep —
+    hands each fired fault out only once). A step index revisited after a
+    rollback is re-evaluated, so a fault with budget left fires again and
+    an exhausted one stays quiet.
+    """
+
+    def __init__(self):
+        self.faults: List[FaultSpec] = []
+        self._cur_step: Optional[int] = None
+        self._cur_fired: Dict[str, FaultSpec] = {}
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, text: str) -> None:
+        if str(text).strip().lower() in ("", "0", "false", "none", "off"):
+            text = ""
+        self.faults = parse_spec(text or "")
+        self._cur_step = None
+        self._cur_fired = {}
+        ENABLED[0] = bool(self.faults)
+
+    # -- evaluation ---------------------------------------------------------
+    def _fires(self, f: FaultSpec, step: Optional[int]) -> bool:
+        if f.spent():
+            return False
+        if f.step is not None:
+            return step is not None and step >= f.step
+        return f._rng.random() < f.p
+
+    def _eval_step(self, step: int) -> None:
+        if step == self._cur_step:
+            return
+        self._cur_step = step
+        self._cur_fired = {}
+        for f in self.faults:
+            if f.kind in _STEP_KINDS and f.step is not None \
+                    and self._fires(f, step):
+                f.consume()
+                self._cur_fired[f.kind] = f
+
+    def take(self, kind: str, step: int) -> Optional[FaultSpec]:
+        """Claim a step-keyed fault for this step index (None = not
+        firing, or already claimed by an outer hook)."""
+        self._eval_step(step)
+        return self._cur_fired.pop(kind, None)
+
+    def chance(self, kind: str) -> Optional[FaultSpec]:
+        """Per-encounter (p=...) fault draw."""
+        for f in self.faults:
+            if f.kind == kind and f.p is not None and self._fires(f, None):
+                f.consume()
+                return f
+        return None
+
+    # -- hook points --------------------------------------------------------
+    def on_train_step(self, step: int, batch):
+        """The train-step hook: crash / stall / preempt / nan_grad, in
+        that order. Returns the (possibly corrupted) batch."""
+        f = self.take("crash", step)
+        if f is not None:
+            _mstats.FAULTS_INJECTED.add()
+            raise InjectedCrash(f"injected crash at step {step}")
+        f = self.take("stall", step)
+        if f is not None:
+            _mstats.FAULTS_INJECTED.add()
+            time.sleep(f.secs)
+        f = self.take("preempt", step)
+        if f is not None:
+            _mstats.FAULTS_INJECTED.add()
+            signal.raise_signal(signal.SIGTERM)
+        f = self.take("nan_grad", step)
+        if f is not None:
+            _mstats.FAULTS_INJECTED.add()
+            batch = _corrupt_batch(batch)
+        return batch
+
+    def on_input(self, index: int) -> None:
+        """Input-pipeline hook (io/prefetch.py producer, keyed by batch
+        index)."""
+        for f in self.faults:
+            if f.kind != "input_stall" or f.spent():
+                continue
+            if (f.step is not None and index >= f.step) or \
+                    (f.p is not None and f._rng.random() < f.p):
+                f.consume()
+                _mstats.FAULTS_INJECTED.add()
+                time.sleep(f.secs)
+
+    def on_ckpt_io(self) -> None:
+        """Checkpoint-save hook: raises a transient OSError."""
+        f = self.chance("ckpt_io_error")
+        if f is None:
+            for g in self.faults:
+                if g.kind == "ckpt_io_error" and g.step is not None \
+                        and not g.spent():
+                    g.consume()
+                    f = g
+                    break
+        if f is not None:
+            _mstats.FAULTS_INJECTED.add()
+            raise OSError("injected transient checkpoint I/O error")
+
+
+FAULTS = FaultRegistry()
+
+
+def configure_faults(spec: str) -> None:
+    """Programmatic twin of ``paddle.set_flags({"FLAGS_fault_inject": ...})``."""
+    FAULTS.configure(spec)
+    _native.fault_inject[0] = spec or ""
+
+
+# wire the flag cell: paddle.set_flags({"FLAGS_fault_inject": "..."}) (and
+# the env default read at import) reconfigure the registry immediately
+_native.fault_inject_watchers.append(FAULTS.configure)
+if _native.fault_inject[0]:
+    FAULTS.configure(_native.fault_inject[0])

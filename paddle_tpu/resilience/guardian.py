@@ -1,0 +1,421 @@
+"""TrainGuardian — snapshot / skip / rollback / preempt / watchdog.
+
+The guardian composes the pieces that already existed in isolation
+(CheckpointManager, ElasticManager, the sentinel verdict, the stat
+gauges) into a training loop that survives NaNs, stalls, crashes and
+preemption:
+
+- **rolling snapshot**: every ``snapshot_every`` healthy steps the full
+  training state (params, optimizer state, buffers, scaler, RNG, step
+  count) is offloaded to HOST memory — O(model) RAM, no filesystem — so
+  a rollback never waits on storage. ``resilience.snapshot`` trace span.
+- **escalation ladder** on sentinel trips (read at ``check_every``
+  cadence from the device-resident trip counter): the in-jit gate has
+  already SKIPPED the poisoned update (GradScaler-style, params
+  untouched); after ``skip_limit`` consecutive tripped steps the
+  guardian REWINDS to the last snapshot (``resilience.rollback`` span,
+  ``rollbacks`` gauge) and bumps ``data_seed`` so the caller re-seeds
+  its data order; after ``max_rollbacks`` rewinds it raises
+  :class:`TrainingAborted` — a babysitter would have paged a human long
+  ago.
+- **preemption**: ``install_preemption_handler()`` catches SIGTERM (the
+  Cloud TPU preemption notice); the next ``after_step`` forces a
+  priority orbax save (``preempt_saves`` gauge), marks
+  ``ElasticStatus.RESTART`` in the elastic KV store when an
+  ElasticManager is attached, and returns ``"preempt"`` so the loop can
+  exit cleanly. The relaunched worker auto-resumes via
+  :meth:`restore_latest`.
+- **watchdog**: a daemon thread watches the heartbeat gauge
+  (``guardian_heartbeat_ms``, bumped by every ``after_step``); a step
+  silent for ``watchdog_timeout`` seconds bumps ``watchdog_stalls``,
+  dumps all thread stacks, and flushes the chrome trace for post-mortem.
+
+Usage::
+
+    g = TrainGuardian(step, ckpt_dir=dir, snapshot_every=20,
+                      sentinel=True, watchdog_timeout=300)
+    start = g.restore_latest() or 0          # crash auto-resume
+    i = start
+    while i < n_steps:
+        loss = step(batch_at(i, seed=g.data_seed))
+        action = g.after_step(i, loss)
+        if action == "rollback":
+            i = g.resume_step                # replay from the snapshot
+            continue
+        if action == "preempt":
+            break                            # priority save already done
+        i += 1
+    g.close()
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+import warnings
+from typing import Any, Optional
+
+import numpy as np
+
+from ..monitor import stats as _mstats
+from ..monitor import trace as _mtrace
+from . import sentinel as _sentinel
+
+__all__ = ["TrainGuardian", "TrainingAborted"]
+
+
+class TrainingAborted(RuntimeError):
+    """The escalation ladder ran out: more than ``max_rollbacks`` rewinds
+    (or an unrecoverable restore failure). Training must stop."""
+
+
+def _host_tree(tree):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+class TrainGuardian:
+    """Wraps a train step (jit.TrainStep, DistributedTrainStep, or a
+    FleetEngine) with self-healing behavior. See the module docstring for
+    the ladder; all thresholds are per-instance knobs."""
+
+    def __init__(self, step=None, ckpt_dir: Optional[str] = None,
+                 snapshot_every: int = 25, skip_limit: int = 2,
+                 max_rollbacks: int = 3, check_every: int = 1,
+                 sentinel=True, watchdog_timeout: Optional[float] = None,
+                 elastic=None, save_interval_steps: int = 1,
+                 max_to_keep: int = 3):
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.skip_limit = int(skip_limit)
+        self.max_rollbacks = int(max_rollbacks)
+        self.check_every = max(1, int(check_every))
+        self.sentinel_config = (_sentinel.normalize_config(sentinel)
+                                if sentinel else None)
+        self.watchdog_timeout = watchdog_timeout
+        self.elastic = elastic
+        self.data_seed = 0          # bumped by every rollback
+        self.ckpt_dir = ckpt_dir
+        self._ckpt = None
+        self._ckpt_opts = (int(save_interval_steps), int(max_to_keep))
+        self._obj = None            # as attached (may be a FleetEngine)
+        self._step_obj = None       # the underlying train step
+        self._snap = None           # (step_idx, host state tree)
+        self._consec = 0            # consecutive tripped check windows
+        self._trips_seen = 0
+        self._rollbacks = 0
+        self._preempted = False
+        self._prev_sigterm = None
+        self._last_beat = time.monotonic()
+        self._watchdog = None
+        self._watchdog_stop = threading.Event()
+        self._closed = False
+        if step is not None:
+            self.attach(step)
+
+    # -- attachment ---------------------------------------------------------
+    def attach(self, obj) -> "TrainGuardian":
+        """Bind a train step or FleetEngine; takes the initial snapshot so
+        a rollback is possible from step 0."""
+        self._obj = obj
+        self._step_obj = getattr(obj, "train_step", obj)
+        if self.ckpt_dir is not None and self._ckpt is None:
+            from ..framework.checkpoint import CheckpointManager
+
+            interval, keep = self._ckpt_opts
+            self._ckpt = CheckpointManager(
+                self.ckpt_dir, save_interval_steps=interval,
+                max_to_keep=keep, async_save=False)
+        self.snapshot(-1)
+        if self.watchdog_timeout:
+            self._start_watchdog()
+        return self
+
+    # -- state capture / install -------------------------------------------
+    def _capture(self) -> dict:
+        """Full training state as a pytree of arrays (host- or
+        device-resident, caller's choice of offload)."""
+        import jax
+
+        from ..framework.random import get_rng_state
+
+        s = self._step_obj
+        out: dict = {"rng": jax.random.key_data(get_rng_state()),
+                     # 0-d ndarray: orbax rejects numpy scalar types
+                     "step_count": np.asarray(
+                         getattr(s, "_step_count", 0), np.int64)}
+        if hasattr(s, "params") and hasattr(s, "opt_state"):
+            out["params"] = s.params
+            out["opt_state"] = s.opt_state
+            if getattr(s, "aux", None) is not None:
+                out["aux"] = s.aux
+            if getattr(s, "scaler_state", None) is not None:
+                out["scaler"] = s.scaler_state
+        elif hasattr(s, "_params") and hasattr(s, "_slot_values"):
+            out["params"] = {k: p._data for k, p in s._params.items()}
+            out["slots"] = {k: list(v) for k, v in s._slot_values.items()}
+            bufs = {k: b._data for k, b in s.model.named_buffers()
+                    if b is not None}
+            if bufs:
+                out["buffers"] = bufs
+        else:
+            raise TypeError(
+                f"TrainGuardian cannot snapshot {type(s).__name__}: need a "
+                "DistributedTrainStep-like (.params/.opt_state) or "
+                "jit.TrainStep-like (._params/._slot_values) object")
+        st = getattr(s, "sentinel_state", None)
+        if st is not None:
+            out["sentinel"] = st
+        return out
+
+    def _install(self, state: dict) -> None:
+        import jax
+
+        from ..framework.random import set_rng_state
+
+        s = self._step_obj
+        if "rng" in state:
+            set_rng_state(jax.random.wrap_key_data(
+                np.asarray(state["rng"]).astype(np.uint32)))
+        if hasattr(s, "params") and hasattr(s, "opt_state"):
+            put = lambda t, sh: (jax.device_put(t, sh) if sh is not None
+                                 else jax.device_put(t))
+            s.params = put(state["params"], getattr(s, "_param_sh", None))
+            s.opt_state = put(state["opt_state"], getattr(s, "_opt_sh", None))
+            if "aux" in state and getattr(s, "aux", None) is not None:
+                s.aux = put(state["aux"], getattr(s, "_aux_sh", None))
+            if "scaler" in state and getattr(s, "scaler_state", None) is not None:
+                s.scaler_state = jax.device_put(state["scaler"])
+        else:
+            for k, arr in state["params"].items():
+                s._params[k]._data = jax.device_put(np.asarray(arr))
+            for k, vals in state.get("slots", {}).items():
+                s._slot_values[k] = [jax.device_put(np.asarray(v))
+                                     for v in vals]
+                s.optimizer._set_slots(s._params[k], s._slot_values[k])
+            if state.get("buffers"):
+                named = {k: b for k, b in s.model.named_buffers()
+                         if b is not None}
+                for k, arr in state["buffers"].items():
+                    named[k]._data = jax.device_put(np.asarray(arr))
+        if "step_count" in state and hasattr(s, "_step_count"):
+            s._step_count = int(state["step_count"])
+        if "sentinel" in state and getattr(s, "sentinel_state", None) is not None:
+            s.sentinel_state = jax.device_put(
+                jax.tree_util.tree_map(np.asarray, state["sentinel"]))
+        # FleetEngine: mirror the restored device params back into the
+        # eager Layer so state_dict/save readers stay consistent
+        if self._obj is not self._step_obj:
+            eng = self._obj
+            if hasattr(eng, "_write_back"):
+                eng._write_back(self._step_obj.params)
+            if hasattr(eng, "_write_back_buffers"):
+                eng._write_back_buffers(getattr(self._step_obj, "aux", None))
+
+    # -- snapshot / rollback -------------------------------------------------
+    def snapshot(self, step_idx: int) -> None:
+        """Host-offloaded rolling snapshot (keeps exactly one)."""
+        with _mtrace.span("resilience.snapshot", cat="resilience",
+                          args={"step": step_idx}):
+            self._snap = (int(step_idx), _host_tree(self._capture()))
+
+    @property
+    def resume_step(self) -> int:
+        """First step index to (re)run after a rollback/restore."""
+        return (self._snap[0] + 1) if self._snap is not None else 0
+
+    def rollback(self) -> int:
+        """Rewind to the last snapshot; returns the step index to resume
+        from. Raises :class:`TrainingAborted` past ``max_rollbacks``."""
+        if self._snap is None:
+            raise TrainingAborted("sentinel tripped but no snapshot exists")
+        self._rollbacks += 1
+        _mstats.ROLLBACKS.add()
+        if self._rollbacks > self.max_rollbacks:
+            raise TrainingAborted(
+                f"aborting: {self._rollbacks} rollbacks exceed "
+                f"max_rollbacks={self.max_rollbacks}")
+        step_idx, state = self._snap
+        with _mtrace.span("resilience.rollback", cat="resilience",
+                          args={"to_step": step_idx,
+                                "rollback": self._rollbacks}):
+            self._install(state)
+            s = self._step_obj
+            if getattr(s, "sentinel_state", None) is not None:
+                # fresh verdict baseline — the EMA saw the fault window
+                s.sentinel_state = _sentinel.init_state()
+            self._consec = 0
+            self._trips_seen = 0
+            self.data_seed += 1
+        return self.resume_step
+
+    # -- per-step driver ------------------------------------------------------
+    def after_step(self, step_idx: int, loss=None) -> str:
+        """Call once per completed step. Returns ``"ok"``, ``"skip"`` (the
+        in-jit gate discarded a poisoned update), ``"rollback"`` (state
+        rewound — resume from :attr:`resume_step` with re-seeded data
+        order), or ``"preempt"`` (priority checkpoint written — exit)."""
+        del loss  # the verdict is read from device state, not the handle
+        self._beat()
+        if self._preempted:
+            self._priority_save(step_idx)
+            return "preempt"
+        if self._ckpt is not None:
+            self._ckpt.maybe_save(step_idx, self._capture())
+        action = "ok"
+        st = getattr(self._step_obj, "sentinel_state", None)
+        if st is not None and (step_idx % self.check_every == 0):
+            trips = int(st["trips"])
+            delta = trips - self._trips_seen
+            self._trips_seen = trips
+            if delta > 0:
+                _mstats.SENTINEL_TRIPS.add(delta)
+                if _mtrace.TRACING[0]:
+                    _mtrace.get_writer().add_instant(
+                        "resilience.trip", time.perf_counter(),
+                        cat="resilience")
+                self._consec += 1
+                if self._consec > self.skip_limit:
+                    self.rollback()
+                    return "rollback"
+                action = "skip"
+            else:
+                self._consec = 0
+        if action == "ok" and step_idx >= 0 \
+                and step_idx % self.snapshot_every == 0:
+            self.snapshot(step_idx)
+        return action
+
+    # -- crash auto-resume ----------------------------------------------------
+    def restore_latest(self) -> Optional[int]:
+        """Resume from the newest intact on-disk checkpoint (None when no
+        checkpoint directory or nothing restorable). Corrupt/incomplete
+        step dirs are skipped with a warning."""
+        if self._ckpt is None:
+            return None
+        got = self._ckpt.restore_latest_tree(self._capture())
+        if got is None:
+            return None
+        step_idx, state = got
+        self._install(state)
+        self.snapshot(step_idx)
+        return step_idx + 1
+
+    # -- preemption -----------------------------------------------------------
+    def install_preemption_handler(self, sig: int = signal.SIGTERM) -> bool:
+        """Install the SIGTERM handler (main thread only — returns False
+        elsewhere). The handler just flags; the save happens at the next
+        ``after_step`` on the training thread, where the device state is
+        coherent."""
+
+        def _handler(signum, frame):
+            del signum, frame
+            self._preempted = True
+
+        try:
+            self._prev_sigterm = signal.signal(sig, _handler)
+            return True
+        except ValueError:  # not the main thread
+            return False
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def _priority_save(self, step_idx: int) -> None:
+        with _mtrace.span("resilience.preempt_save", cat="resilience",
+                          args={"step": step_idx}):
+            if self._ckpt is not None:
+                self._ckpt.save(max(step_idx, 0), self._capture())
+                self._ckpt.wait_until_finished()
+            else:
+                self.snapshot(step_idx)
+            _mstats.PREEMPT_SAVES.add()
+            if self.elastic is not None:
+                try:
+                    from ..distributed.elastic import ElasticStatus
+
+                    self.elastic.set_status(ElasticStatus.RESTART)
+                except Exception as e:  # noqa: BLE001 — dying anyway
+                    warnings.warn(f"could not mark elastic RESTART: {e}")
+
+    # -- watchdog -------------------------------------------------------------
+    def _beat(self) -> None:
+        self._last_beat = time.monotonic()
+        _mstats.GUARDIAN_HEARTBEAT_MS.set(int(self._last_beat * 1e3))
+
+    def _start_watchdog(self) -> None:
+        if self._watchdog is not None:
+            return
+        self._watchdog_stop.clear()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="train-guardian-watchdog",
+            daemon=True)
+        self._watchdog.start()
+
+    def _watchdog_loop(self) -> None:
+        timeout = float(self.watchdog_timeout)
+        poll = max(0.02, min(timeout / 4.0, 0.25))
+        while not self._watchdog_stop.wait(poll):
+            if time.monotonic() - self._last_beat <= timeout:
+                continue
+            _mstats.WATCHDOG_STALLS.add()
+            self._dump_stall()
+            self._last_beat = time.monotonic()  # one report per stall
+
+    def _dump_stall(self) -> None:
+        """Stack dump + trace flush for a stalled step."""
+        import faulthandler
+
+        target = None
+        try:
+            if self.ckpt_dir is not None:
+                os.makedirs(self.ckpt_dir, exist_ok=True)
+                target = os.path.join(self.ckpt_dir, "watchdog_stall.txt")
+                with open(target, "a") as f:
+                    f.write(f"=== watchdog stall at {time.time():.3f} "
+                            f"(no heartbeat for >{self.watchdog_timeout}s) "
+                            f"===\n")
+                    faulthandler.dump_traceback(file=f)
+            else:
+                faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:  # noqa: BLE001 — diagnostics must not kill training
+            pass
+        try:
+            if _mtrace.TRACING[0]:
+                base = self.ckpt_dir or "."
+                _mtrace.get_writer().write(
+                    os.path.join(base, "watchdog_trace.json"))
+        except Exception:  # noqa: BLE001
+            pass
+        warnings.warn(
+            f"watchdog: training step stalled for >{self.watchdog_timeout}s"
+            + (f"; stacks dumped to {target}" if target else ""))
+
+    # -- lifecycle ------------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._watchdog_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=1.0)
+            self._watchdog = None
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,107 @@
+"""In-jit per-step health verdict.
+
+The sentinel runs INSIDE the already-jitted train step (the analog of the
+reference's fused check_finite_and_unscale op rather than its host-side
+FLAGS_check_nan_inf walk): it computes loss/global-grad-norm finiteness
+plus an EMA z-score spike test on the grad norm, gates the whole
+parameter/optimizer update on the verdict (a tripped step is a no-op,
+GradScaler-style), and accumulates a device-resident trip counter. The
+verdict rides the step's outputs — carried on :class:`AsyncLoss` as
+``.health`` — so the FLAGS_fast_step zero-extra-syncs property is
+preserved: nothing here forces a host read; the guardian decides when to
+look.
+
+State (replicated device scalars, carried across steps)::
+
+    {"mean": EMA of grad norm, "var": EMA of squared deviation,
+     "n": healthy steps observed, "trips": cumulative verdict trips,
+     "last_trip": last step's verdict}
+
+Verdict = NOT finite(loss, grad_norm) OR (n >= warmup AND
+|gnorm - mean| / sqrt(var + eps) > z_thresh). The EMA only absorbs
+healthy steps, so a spike does not poison the baseline it is measured
+against.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["default_config", "init_state", "update", "global_grad_norm",
+           "gate"]
+
+
+def default_config(z_thresh: float = 8.0, warmup: int = 20,
+                   ema_decay: float = 0.98) -> Dict[str, float]:
+    """Sentinel hyperparameters. ``z_thresh`` in EMA standard deviations;
+    ``warmup`` healthy steps before the spike test arms (finiteness is
+    always armed); ``ema_decay`` the baseline's smoothing factor."""
+    return {"z_thresh": float(z_thresh), "warmup": int(warmup),
+            "ema_decay": float(ema_decay)}
+
+
+def normalize_config(cfg) -> Dict[str, float]:
+    """None/True/partial-dict → full config."""
+    if cfg is None or cfg is True:
+        return default_config()
+    out = default_config()
+    out.update({k: v for k, v in dict(cfg).items() if k in out})
+    return out
+
+
+def init_state() -> Dict[str, jnp.ndarray]:
+    return {"mean": jnp.float32(0.0), "var": jnp.float32(0.0),
+            "n": jnp.int32(0), "trips": jnp.int32(0),
+            "last_trip": jnp.bool_(False)}
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """fp32 global L2 norm over a grad pytree (same reduction the
+    sharded program lowers to cross-device psums)."""
+    sq = jnp.float32(0.0)
+    for g in jax.tree_util.tree_leaves(grads):
+        sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return jnp.sqrt(sq)
+
+
+def update(state, loss, gnorm, cfg) -> Dict[str, jnp.ndarray]:
+    """Pure step: (state, loss, grad norm) -> new state (including the
+    verdict in ``last_trip``). Traced inside the jitted train step."""
+    loss32 = jnp.asarray(loss, jnp.float32)
+    g = jnp.asarray(gnorm, jnp.float32)
+    finite = jnp.isfinite(loss32) & jnp.isfinite(g)
+    z = jnp.abs(g - state["mean"]) / jnp.sqrt(state["var"] + 1e-12)
+    spike = (state["n"] >= int(cfg["warmup"])) & (z > float(cfg["z_thresh"]))
+    trip = (~finite) | spike
+    d = float(cfg["ema_decay"])
+    new_mean = d * state["mean"] + (1.0 - d) * g
+    new_var = d * state["var"] + (1.0 - d) * jnp.square(g - state["mean"])
+    healthy = ~trip
+    return {
+        "mean": jnp.where(healthy, new_mean, state["mean"]),
+        "var": jnp.where(healthy, new_var, state["var"]),
+        "n": jnp.where(healthy, state["n"] + 1, state["n"]),
+        "trips": state["trips"] + trip.astype(jnp.int32),
+        "last_trip": trip,
+    }
+
+
+def gate(trip, new_tree, old_tree):
+    """GradScaler-style skip: keep ``old_tree`` wherever the verdict
+    tripped (``where`` select — a skipped step costs nothing extra)."""
+    if new_tree is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(trip, b, a), new_tree, old_tree)
+
+
+def read_health(state) -> Optional[dict]:
+    """Host-side view of a sentinel state (device scalars, reading
+    blocks): {"trip": bool, "trips": int, "gnorm_mean": float}."""
+    if state is None:
+        return None
+    return {"trip": bool(state["last_trip"]),
+            "trips": int(state["trips"]),
+            "gnorm_mean": float(state["mean"])}

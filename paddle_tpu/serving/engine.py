@@ -188,6 +188,7 @@ class InferenceEngine:
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
         self._stop = False
         self._drain = True
+        self._error: Optional[BaseException] = None  # scheduler crash cause
         self._base_key = jax.random.key(seed)
         self._tick = 0
         # float running totals behind the int ms gauges (prefetch.py idiom:
@@ -247,8 +248,7 @@ class InferenceEngine:
             self.eos_id if eos_id is None else eos_id,
             None if deadline_s is None else time.monotonic() + deadline_s)
         with self._cv:
-            if self._stop:
-                raise RuntimeError("InferenceEngine is shut down")
+            self._check_open()
             if len(self._queue) >= self._queue_size:
                 if not block:
                     raise QueueFull(
@@ -259,8 +259,7 @@ class InferenceEngine:
                 if not ok:
                     raise QueueFull(
                         f"serving queue still full after {timeout}s")
-                if self._stop:
-                    raise RuntimeError("InferenceEngine is shut down")
+                self._check_open()
             self._queue.append(req)
             SERVING_QUEUE_DEPTH.set(len(self._queue))
             self._cv.notify_all()
@@ -319,13 +318,32 @@ class InferenceEngine:
                 if st is not None:
                     self._evict(s, SHUTDOWN)
 
+    def _check_open(self) -> None:
+        """Fail fast once the scheduler is gone: nothing will ever drain
+        the queue again, so enqueueing would hang the caller forever.
+        After a crash the stored cause rides the error so callers see WHY
+        the engine died, not just that it is closed."""
+        if not self._stop:
+            return
+        if self._error is not None:
+            raise RuntimeError(
+                f"InferenceEngine scheduler crashed: "
+                f"{type(self._error).__name__}: {self._error}") \
+                from self._error
+        raise RuntimeError("InferenceEngine is shut down")
+
     def _abort(self, err: BaseException) -> None:
+        with self._cv:
+            # close the engine BEFORE failing requests so a racing
+            # submit() cannot slip into the dead queue
+            self._error = err
+            self._stop = True
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
         for s, st in enumerate(self._slots):
             if st is not None:
                 st.req._finish(ERROR, err)
-        with self._cv:
-            leftovers = list(self._queue)
-            self._queue.clear()
         for req in leftovers:
             req._finish(ERROR, err)
 
@@ -344,7 +362,17 @@ class InferenceEngine:
             if req.deadline is not None and time.monotonic() > req.deadline:
                 req._finish(DEADLINE)
                 continue
-            self._prefill(req, self.cache.alloc())
+            slot = self.cache.alloc()
+            try:
+                self._prefill(req, slot)
+            except BaseException as e:  # noqa: BLE001
+                # mid-admission crash: the request is in neither the
+                # queue nor a slot, so _abort would miss it — fail it
+                # here before the scheduler unwinds
+                if self._slots[slot] is None:
+                    self.cache.release(slot)
+                req._finish(ERROR, e)
+                raise
         SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
 
     def _bucket(self, n: int) -> int:

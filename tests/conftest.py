@@ -22,6 +22,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 `-m 'not slow'` "
+        "budget (full fault matrices, big-model benches)")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import numpy as np

@@ -1,0 +1,691 @@
+"""Self-healing training (ISSUE 5): fault injection, in-jit sentinel,
+guardian escalation (skip -> rollback -> abort), crash auto-resume,
+preemption priority save, watchdog, and the flag-unset bit-for-bit pin.
+
+The injection matrix runs on CPU: every production failure mode
+(nan_grad / crash / preempt / stall / ckpt_io_error / input_stall) is
+provoked deterministically via FLAGS_fault_inject.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.framework.core import AsyncLoss
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.resilience import (FAULTS, InjectedCrash, configure_faults,
+                                   faults, sentinel)
+from paddle_tpu.resilience.guardian import TrainGuardian, TrainingAborted
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    configure_faults("")
+    paddle.set_flags({"FLAGS_fast_step": 1})
+
+
+def _build_mlp(seed=0, sentinel_cfg=None):
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+
+    def loss_fn(run_model, x, y):
+        return paddle.nn.functional.cross_entropy(run_model(x), y)
+
+    return net, opt, TrainStep(net, loss_fn, opt, sentinel=sentinel_cfg)
+
+
+def _mlp_batch(i, n=16):
+    rng = np.random.default_rng(100 + i)
+    x = paddle.to_tensor(rng.normal(size=(n, 8)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 4, (n,)).astype("int64"))
+    return x, y
+
+
+def _params_np(net):
+    return {k: np.asarray(p._data).copy() for k, p in net.named_parameters()}
+
+
+def _guardian_loop(step, guardian, batch_of, n_steps):
+    """The canonical guarded loop (guardian.py docstring shape)."""
+    i, actions = 0, []
+    while i < n_steps:
+        loss = step(*batch_of(i))
+        action = guardian.after_step(i, loss)
+        actions.append((i, action))
+        if action == "rollback":
+            i = guardian.resume_step
+            continue
+        if action == "preempt":
+            break
+        i += 1
+    return actions
+
+
+class TestFaultSpecs:
+    def test_parse_matrix(self):
+        specs = faults.parse_spec(
+            "nan_grad@step=50, crash@step=120:repeat=2;"
+            "ckpt_io_error@p=0.5:seed=7:repeat=4,stall@step=80:secs=2.5")
+        kinds = [s.kind for s in specs]
+        assert kinds == ["nan_grad", "crash", "ckpt_io_error", "stall"]
+        assert specs[0].step == 50 and specs[0].repeat == 1
+        assert specs[1].repeat == 2
+        assert specs[2].p == 0.5 and specs[2].seed == 7 and specs[2].repeat == 4
+        assert specs[3].secs == 2.5
+        # p faults default to unlimited budget
+        assert faults.parse_spec("ckpt_io_error@p=0.1")[0].repeat == -1
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("nan_grad")
+        with pytest.raises(ValueError):
+            faults.parse_spec("nan_grad@step=5:bogus")
+        with pytest.raises(ValueError):
+            faults.parse_spec("nan_grad@step=5:p=0.5")  # two triggers
+
+    def test_registry_claims_once_per_step(self):
+        """Two hook layers asking about the same step index (FleetEngine
+        delegating to DistributedTrainStep) must not double-fire."""
+        reg = faults.FaultRegistry()
+        reg.configure("stall@step=3:repeat=1")
+        assert reg.take("stall", 3) is not None   # outer hook claims it
+        assert reg.take("stall", 3) is None       # inner hook: no-op
+        assert reg.take("stall", 4) is None       # budget spent
+        reg.configure("")
+
+    def test_exhausted_fault_stays_quiet_on_replay(self):
+        reg = faults.FaultRegistry()
+        reg.configure("nan_grad@step=5:repeat=2")
+        assert reg.take("nan_grad", 5) is not None
+        assert reg.take("nan_grad", 6) is not None
+        # rollback replays steps 5..6 — budget is spent, so they run clean
+        assert reg.take("nan_grad", 5) is None
+        assert reg.take("nan_grad", 6) is None
+        reg.configure("")
+
+    def test_p_fault_deterministic(self):
+        a = faults.FaultRegistry()
+        b = faults.FaultRegistry()
+        a.configure("ckpt_io_error@p=0.5:seed=11")
+        b.configure("ckpt_io_error@p=0.5:seed=11")
+        seq_a = [a.chance("ckpt_io_error") is not None for _ in range(20)]
+        seq_b = [b.chance("ckpt_io_error") is not None for _ in range(20)]
+        assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+
+    def test_set_flags_reconfigures_registry(self):
+        paddle.set_flags({"FLAGS_fault_inject": "crash@step=9"})
+        assert faults.ENABLED[0]
+        assert [f.kind for f in FAULTS.faults] == ["crash"]
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+        assert not faults.ENABLED[0] and FAULTS.faults == []
+
+
+class TestSentinelMath:
+    def test_nonfinite_trips_and_spares_ema(self):
+        import jax.numpy as jnp
+
+        cfg = sentinel.default_config(warmup=2)
+        st = sentinel.init_state()
+        for _ in range(3):
+            st = sentinel.update(st, jnp.float32(1.0), jnp.float32(2.0), cfg)
+        assert not bool(st["last_trip"]) and int(st["trips"]) == 0
+        mean_before = float(st["mean"])
+        st = sentinel.update(st, jnp.float32(float("nan")),
+                             jnp.float32(float("nan")), cfg)
+        assert bool(st["last_trip"]) and int(st["trips"]) == 1
+        # the EMA baseline must not absorb the poisoned sample
+        assert float(st["mean"]) == mean_before
+
+    def test_zscore_spike_trips_after_warmup(self):
+        import jax.numpy as jnp
+
+        cfg = sentinel.default_config(z_thresh=6.0, warmup=5)
+        st = sentinel.init_state()
+        for _ in range(10):
+            st = sentinel.update(st, jnp.float32(1.0), jnp.float32(1.0), cfg)
+        assert int(st["trips"]) == 0
+        st = sentinel.update(st, jnp.float32(1.0), jnp.float32(1e6), cfg)
+        assert bool(st["last_trip"]) and int(st["trips"]) == 1
+
+
+class TestNanSkip:
+    def test_trip_skips_update_gradscaler_style(self):
+        """The in-jit gate leaves params/slots untouched on a NaN step."""
+        net, opt, step = _build_mlp(0, sentinel_cfg=True)
+        float(step(*_mlp_batch(0)))
+        configure_faults("nan_grad@step=1:repeat=1")
+        before = _params_np(net)
+        loss = step(*_mlp_batch(1))
+        assert isinstance(loss, AsyncLoss)
+        assert loss.health is not None and bool(loss.health["trip"])
+        assert not np.isfinite(float(loss))
+        for k, p in net.named_parameters():
+            np.testing.assert_array_equal(before[k], np.asarray(p._data),
+                                          err_msg=k)
+        # next step is healthy again and params move
+        loss2 = step(*_mlp_batch(2))
+        assert np.isfinite(float(loss2))
+        assert any(not np.array_equal(before[k], np.asarray(p._data))
+                   for k, p in net.named_parameters())
+        assert int(step.sentinel_state["trips"]) == 1
+
+
+def _run_clean(n_steps, seed=0):
+    net, _, step = _build_mlp(seed, sentinel_cfg=True)
+    losses = [float(step(*_mlp_batch(i))) for i in range(n_steps)]
+    return _params_np(net), losses
+
+
+class TestRollback:
+    def test_repeated_nan_rolls_back_and_replays_exact(self, tmp_path):
+        """ISSUE 5 acceptance shape (MLP tier-1 twin of the LeNet run):
+        nan_grad@step=5:repeat=3 -> 2 skips, then a rollback to the step-4
+        snapshot, then a clean replay whose final params match a
+        fault-free run."""
+        n_steps = 10
+        clean_params, clean_losses = _run_clean(n_steps)
+
+        net, _, step = _build_mlp(0, sentinel_cfg=True)
+        g = TrainGuardian(step, snapshot_every=2, skip_limit=2,
+                          max_rollbacks=2)
+        trips0 = monitor.stat_get("sentinel_trips")
+        rb0 = monitor.stat_get("rollbacks")
+        configure_faults("nan_grad@step=5:repeat=3")
+        actions = _guardian_loop(step, g, _mlp_batch, n_steps)
+        g.close()
+
+        kinds = [a for _, a in actions]
+        assert kinds.count("skip") == 2
+        assert kinds.count("rollback") == 1
+        assert monitor.stat_get("sentinel_trips") - trips0 >= 3
+        assert monitor.stat_get("rollbacks") - rb0 == 1
+        # trips at 5/6 were skipped, the third (step 7) rewound to the
+        # step-4 snapshot and steps 5..9 replayed clean
+        assert [i for i, _ in actions] == [0, 1, 2, 3, 4, 5, 6, 7,
+                                           5, 6, 7, 8, 9]
+        assert g.data_seed == 1
+        # final params match the fault-free trajectory exactly on CPU
+        faulty = _params_np(net)
+        for k in clean_params:
+            np.testing.assert_allclose(faulty[k], clean_params[k],
+                                       rtol=1e-6, atol=1e-7, err_msg=k)
+
+    def test_abort_after_max_rollbacks(self):
+        net, _, step = _build_mlp(0, sentinel_cfg=True)
+        g = TrainGuardian(step, snapshot_every=1, skip_limit=0,
+                          max_rollbacks=1)
+        # every step from 2 on is poisoned — rollback budget runs out
+        configure_faults("nan_grad@step=2:repeat=100")
+        with pytest.raises(TrainingAborted):
+            _guardian_loop(step, g, _mlp_batch, 50)
+        g.close()
+
+
+class TestCrashResume:
+    def test_crash_then_auto_resume_from_latest(self, tmp_path):
+        n_steps = 6
+        clean_params, _ = _run_clean(n_steps)
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        net, _, step = _build_mlp(0, sentinel_cfg=True)
+        g = TrainGuardian(step, ckpt_dir=ckpt_dir, snapshot_every=2)
+        configure_faults("crash@step=3")
+        with pytest.raises(InjectedCrash):
+            _guardian_loop(step, g, _mlp_batch, n_steps)
+        g.close()
+
+        # "relaunch": fresh process state, auto-resume from the newest
+        # intact checkpoint (steps 0..2 were saved; crash hit step 3)
+        net2, _, step2 = _build_mlp(1, sentinel_cfg=True)  # different init
+        g2 = TrainGuardian(step2, ckpt_dir=ckpt_dir, snapshot_every=2)
+        start = g2.restore_latest()
+        assert start == 3
+        _guardian_loop(step2, g2,
+                       lambda i: _mlp_batch(i + start), n_steps - start)
+        g2.close()
+        resumed = _params_np(net2)
+        for k in clean_params:
+            np.testing.assert_allclose(resumed[k], clean_params[k],
+                                       rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+class TestPreemption:
+    def test_sigterm_priority_save_and_elastic_restart_mark(self, tmp_path):
+        from paddle_tpu.distributed.elastic import (ElasticManager,
+                                                    ElasticStatus,
+                                                    FileKVStore)
+
+        kv = FileKVStore(str(tmp_path / "kv"))
+        em = ElasticManager(kv, "job", min_np=1)
+        ckpt_dir = str(tmp_path / "ckpt")
+        net, _, step = _build_mlp(0, sentinel_cfg=True)
+        g = TrainGuardian(step, ckpt_dir=ckpt_dir, snapshot_every=100,
+                          elastic=em)
+        assert g.install_preemption_handler()
+        saves0 = monitor.stat_get("preempt_saves")
+        configure_faults("preempt@step=2")
+        actions = _guardian_loop(step, g, _mlp_batch, 10)
+        assert actions[-1] == (2, "preempt")
+        assert g.preempted
+        assert monitor.stat_get("preempt_saves") - saves0 == 1
+        assert em.status() == ElasticStatus.RESTART
+        # the priority checkpoint is on disk and restorable
+        net2, _, step2 = _build_mlp(1, sentinel_cfg=True)
+        g2 = TrainGuardian(step2, ckpt_dir=ckpt_dir)
+        assert g2.restore_latest() == 3
+        for k, p in net2.named_parameters():
+            np.testing.assert_array_equal(np.asarray(p._data),
+                                          np.asarray(
+                                              dict(net.named_parameters())[k]
+                                              ._data), err_msg=k)
+        g.close()
+        g2.close()
+
+
+class TestWatchdog:
+    def test_stalled_step_fires_watchdog_and_dumps(self, tmp_path):
+        ckpt_dir = str(tmp_path / "wd")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        net, _, step = _build_mlp(0)
+        g = TrainGuardian(step, ckpt_dir=None, snapshot_every=1000,
+                          sentinel=False, watchdog_timeout=0.15)
+        g.ckpt_dir = ckpt_dir   # dump target without orbax setup cost
+        g._start_watchdog()
+        stalls0 = monitor.stat_get("watchdog_stalls")
+        float(step(*_mlp_batch(0)))
+        g.after_step(0)
+        with pytest.warns(UserWarning, match="watchdog"):
+            time.sleep(0.6)     # the "stalled step"
+        assert monitor.stat_get("watchdog_stalls") - stalls0 >= 1
+        dump = os.path.join(ckpt_dir, "watchdog_stall.txt")
+        assert os.path.exists(dump)
+        assert "watchdog stall" in open(dump).read()
+        g.close()
+
+    def test_input_stall_hook_fires_in_prefetcher(self):
+        from paddle_tpu.io.prefetch import DevicePrefetcher
+
+        configure_faults("input_stall@step=1:repeat=1:secs=0.05")
+        fired0 = monitor.stat_get("faults_injected")
+        batches = [np.ones((2, 2), np.float32) * i for i in range(3)]
+        out = list(DevicePrefetcher(batches, size=2))
+        assert len(out) == 3
+        assert monitor.stat_get("faults_injected") - fired0 == 1
+
+
+class TestCheckpointRobustness:
+    class _Obj:
+        def __init__(self, val):
+            import jax.numpy as jnp
+
+            self.params = {"w": jnp.full((4,), float(val))}
+            self.opt_state = {"count": jnp.zeros((), "int32")}
+            self._step_count = 0
+
+    def test_restore_latest_skips_corrupt_step(self, tmp_path):
+        from paddle_tpu.framework.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d, save_interval_steps=1, async_save=False)
+        mgr.save(0, self._Obj(1.0))
+        mgr.save(1, self._Obj(2.0))
+        # corrupt the newest step dir (a crash mid-write)
+        step_dir = os.path.join(d, "1")
+        for root, _, files in os.walk(step_dir):
+            for f in files:
+                with open(os.path.join(root, f), "wb") as fh:
+                    fh.write(b"garbage")
+        obj = self._Obj(0.0)
+        with pytest.warns(UserWarning, match="skipping unreadable"):
+            start = mgr.restore_latest(obj)
+        assert start == 1  # fell back to intact step 0
+        np.testing.assert_allclose(np.asarray(obj.params["w"]), 1.0)
+        mgr.close()
+
+    def test_save_retries_injected_io_errors(self, tmp_path):
+        from paddle_tpu.framework.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "r"), save_interval_steps=1,
+                                async_save=False)
+        fired0 = monitor.stat_get("faults_injected")
+        configure_faults("ckpt_io_error@p=1:repeat=2")
+        with pytest.warns(UserWarning, match="transient OSError"):
+            assert mgr.save(0, self._Obj(3.0))
+        assert monitor.stat_get("faults_injected") - fired0 == 2
+        obj = self._Obj(0.0)
+        assert mgr.restore_latest(obj) == 1
+        np.testing.assert_allclose(np.asarray(obj.params["w"]), 3.0)
+        mgr.close()
+
+    def test_save_checkpoint_atomic_no_tmp_leftovers(self, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework.checkpoint import (load_checkpoint,
+                                                     save_checkpoint)
+
+        path = str(tmp_path / "atomic")
+        save_checkpoint(path, {"w": jnp.ones((2,))})
+        save_checkpoint(path, {"w": jnp.full((2,), 5.0)})  # overwrite
+        got = load_checkpoint(path)
+        np.testing.assert_allclose(np.asarray(got["w"]), 5.0)
+        leftovers = [n for n in os.listdir(str(tmp_path))
+                     if ".tmp-" in n]
+        assert leftovers == []
+
+
+class TestElasticHardening:
+    def test_kv_put_retries_transient_oserror(self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed import elastic as el
+
+        kv = el.FileKVStore(str(tmp_path))
+        real_replace = os.replace
+        fails = {"n": 2}
+
+        def flaky_replace(src, dst):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError("ESTALE: NFS hiccup")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(el.os, "replace", flaky_replace)
+        kv.put("jobs/j/nodes/n0", b"ok")
+        assert kv.get("jobs/j/nodes/n0") == b"ok"
+        assert fails["n"] == 0
+
+        fails["n"] = 10  # beyond the budget -> surfaces
+        with pytest.raises(OSError):
+            kv.put("jobs/j/nodes/n1", b"x")
+
+    def test_staleness_is_monotonic_not_wallclock(self, tmp_path):
+        """A heartbeat ts written with a skewed clock (far future) must
+        still expire after ttl of LOCAL monotonic time."""
+        import json
+
+        from paddle_tpu.distributed.elastic import ElasticManager, FileKVStore
+
+        kv = FileKVStore(str(tmp_path))
+        mgr = ElasticManager(kv, "job", min_np=1, heartbeat_ttl=0.2)
+        kv.put("jobs/job/nodes/skewed", json.dumps(
+            {"host": "skewed", "status": "alive",
+             "ts": time.time() + 1e6}))  # clock from the future
+        assert mgr.alive_hosts() == ["skewed"]  # first observation
+        time.sleep(0.3)
+        # same payload observed past the ttl -> stale, despite the raw
+        # wall-clock delta claiming it is a million seconds "fresh"
+        assert mgr.alive_hosts() == []
+        # a real heartbeat (new payload) revives it
+        mgr.heartbeat("skewed")
+        assert mgr.alive_hosts() == ["skewed"]
+
+
+class TestFlagUnsetBitForBit:
+    def test_unset_flag_is_bit_for_bit_identical(self):
+        """FLAGS_fault_inject unset must leave training byte-identical:
+        the hook is one list-index check and touches nothing."""
+        n = 5
+        net1, _, s1 = _build_mlp(0)
+        l1 = [float(s1(*_mlp_batch(i))) for i in range(n)]
+        # exercise the configure/clear path, then train again
+        paddle.set_flags({"FLAGS_fault_inject": "crash@step=999"})
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+        net2, _, s2 = _build_mlp(0)
+        l2 = [float(s2(*_mlp_batch(i))) for i in range(n)]
+        assert l1 == l2  # bit-for-bit, not allclose
+        for (k, p1), (_, p2) in zip(net1.named_parameters(),
+                                    net2.named_parameters()):
+            np.testing.assert_array_equal(np.asarray(p1._data),
+                                          np.asarray(p2._data), err_msg=k)
+
+    def test_sentinel_adds_no_host_syncs(self):
+        """The verdict rides device state; the guarded loop must not
+        materialize the AsyncLoss (step_async_syncs stays flat)."""
+        net, _, step = _build_mlp(0, sentinel_cfg=True)
+        g = TrainGuardian(step, snapshot_every=100)
+        mark = monitor.stat_get("step_async_syncs")
+        _guardian_loop(step, g, _mlp_batch, 5)
+        assert monitor.stat_get("step_async_syncs") == mark
+        g.close()
+
+    def test_sentinel_matches_plain_losses(self):
+        """Sentinel on (healthy run) is numerically identical to off."""
+        n = 5
+        _, _, s_plain = _build_mlp(0)
+        l_plain = [float(s_plain(*_mlp_batch(i))) for i in range(n)]
+        _, _, s_sent = _build_mlp(0, sentinel_cfg=True)
+        l_sent = [float(s_sent(*_mlp_batch(i))) for i in range(n)]
+        np.testing.assert_allclose(l_sent, l_plain, rtol=0, atol=0)
+
+
+class TestDistributedSentinel:
+    def test_distributed_step_trips_and_skips(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.parallel import (DistributedTrainStep, create_mesh,
+                                         set_mesh)
+
+        try:
+            mesh = create_mesh(dp=2, devices=jax.devices()[:2])
+
+            def loss_fn(params, batch):
+                x, y = batch
+                return jnp.mean((x @ params["w"] - y) ** 2)
+
+            params = {"w": jnp.ones((4, 2))}
+            step = DistributedTrainStep(loss_fn, params, {"w": P()},
+                                        optimizer="sgd", lr=0.1, mesh=mesh,
+                                        sentinel=True)
+            rng = np.random.default_rng(0)
+            batch = (rng.normal(size=(8, 4)).astype(np.float32),
+                     rng.normal(size=(8, 2)).astype(np.float32))
+            loss = step(batch)
+            assert loss.health is not None
+            assert not bool(loss.health["trip"])
+            w_before = np.asarray(step.params["w"]).copy()
+            configure_faults("nan_grad@step=1:repeat=1")
+            loss2 = step(batch)
+            assert bool(loss2.health["trip"])
+            assert int(step.sentinel_state["trips"]) == 1
+            np.testing.assert_array_equal(np.asarray(step.params["w"]),
+                                          w_before)
+        finally:
+            set_mesh(None)
+
+
+class TestFleetGuardian:
+    def test_guardian_rolls_back_fleet_engine_and_eager_mirror(self):
+        from paddle_tpu.distributed import env, fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.engine import build_engine
+        from paddle_tpu.parallel.mesh import set_mesh
+
+        try:
+            s = DistributedStrategy()
+            s.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                "pp_degree": 1, "sharding_degree": 1}
+            fleet.init(is_collective=True, strategy=s)
+            paddle.seed(5)
+            net = paddle.nn.Linear(4, 4)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            eng = build_engine(
+                net, opt, s,
+                loss_fn=lambda o, y: paddle.mean((o - y) ** 2),
+                sentinel=True)
+            g = TrainGuardian(eng, snapshot_every=1, skip_limit=0,
+                              max_rollbacks=2)
+            rng = np.random.default_rng(0)
+            batch = (rng.normal(size=(8, 4)).astype("float32"),
+                     rng.normal(size=(8, 4)).astype("float32"))
+            eng.step(batch)
+            assert g.after_step(0) == "ok"     # snapshot after step 0
+            w_snap = np.asarray(
+                dict(net.named_parameters())["weight"]._data).copy()
+            configure_faults("nan_grad@step=1:repeat=1")
+            eng.step(batch)
+            assert g.after_step(1) == "rollback"
+            # the eager Layer mirrors the restored device params
+            np.testing.assert_array_equal(
+                np.asarray(dict(net.named_parameters())["weight"]._data),
+                w_snap)
+            # training continues healthy after the rewind
+            loss = eng.step(batch)
+            assert g.after_step(2) == "ok"
+            assert np.isfinite(float(loss))
+            g.close()
+        finally:
+            set_mesh(None)
+            env.set_state(initialized=False, hcg=None, topology=None,
+                          mesh=None)
+
+
+class TestHapiResilience:
+    class _DS:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(i)
+            x = rng.normal(size=(8,)).astype("float32")
+            return x, np.array(int(x[0] > 0), dtype="int64")
+
+    def _model(self, seed=1):
+        from paddle_tpu.hapi import Model
+
+        paddle.seed(seed)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 2))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        m = Model(net)
+        m.prepare(optimizer=opt, loss=paddle.nn.CrossEntropyLoss())
+        return m
+
+    def test_fit_resilience_survives_nan_burst(self):
+        rb0 = monitor.stat_get("rollbacks")
+        configure_faults("nan_grad@step=2:repeat=2")
+        m = self._model()
+        m.fit(self._DS(), batch_size=8, epochs=2, verbose=0,
+              resilience={"snapshot_every": 1, "skip_limit": 0,
+                          "max_rollbacks": 3})
+        assert monitor.stat_get("rollbacks") - rb0 >= 1
+        # training completed with finite params
+        for _, p in m.network.named_parameters():
+            assert np.all(np.isfinite(np.asarray(p._data)))
+
+    def test_fit_resilience_flag_unset_matches_plain_fit(self):
+        recorded = {}
+        from paddle_tpu.hapi import callbacks as cbks
+
+        for key, resilience in (("plain", None), ("guarded", True)):
+            losses = []
+
+            class Rec(cbks.Callback):
+                def on_train_batch_end(self, step, logs=None):
+                    losses.append(logs["loss"])
+
+            m = self._model(seed=3)
+            m.fit(self._DS(), batch_size=8, epochs=1, verbose=0,
+                  log_freq=1, shuffle=False,
+                  callbacks=[Rec()], resilience=resilience)
+            recorded[key] = losses
+        assert recorded["plain"] == recorded["guarded"]
+
+
+class TestTraceReportResilience:
+    def test_resilience_verdict_from_spans(self, capsys):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import trace_report
+
+        monitor.start_tracing()
+        net, _, step = _build_mlp(0, sentinel_cfg=True)
+        g = TrainGuardian(step, snapshot_every=2, skip_limit=0,
+                          max_rollbacks=2)
+        configure_faults("nan_grad@step=3:repeat=1")
+        _guardian_loop(step, g, _mlp_batch, 6)
+        g.close()
+        writer = monitor.stop_tracing()
+        events = writer.events()
+        rows = trace_report.aggregate(events)
+        out = trace_report.resilience_report(
+            events, rows, gauges=monitor.stat_snapshot())
+        assert out["counts"].get("snapshot", 0) >= 1
+        assert out["counts"].get("rollback", 0) == 1
+        assert "unhealthy" in out["verdict"]
+        timeline_events = [t["event"] for t in out["timeline"]]
+        assert "rollback" in timeline_events and "trip" in timeline_events
+        writer.clear()
+
+    def test_healthy_run_verdict(self):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import trace_report
+
+        events = [{"name": "resilience.snapshot", "ph": "X", "ts": 10,
+                   "dur": 5, "args": {"step": 0}}]
+        out = trace_report.resilience_report(events, [])
+        assert "healthy" in out["verdict"]
+
+
+@pytest.mark.slow
+class TestLeNetAcceptance:
+    """ISSUE 5 acceptance: LeNet on CPU with
+    FLAGS_fault_inject="nan_grad@step=5:repeat=3" completes, params
+    allclose to a fault-free trajectory restarted from the rollback
+    point, sentinel_trips>=3 and rollbacks>=1."""
+
+    def _build(self, seed=0):
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(seed)
+        net = LeNet(num_classes=10)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+
+        def loss_fn(run_model, x, y):
+            return paddle.nn.functional.cross_entropy(run_model(x), y)
+
+        return net, TrainStep(net, loss_fn, opt, sentinel=True)
+
+    @staticmethod
+    def _batch(i, n=8):
+        rng = np.random.default_rng(1000 + i)
+        x = paddle.to_tensor(rng.normal(size=(n, 1, 28, 28))
+                             .astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 10, (n,)).astype("int64"))
+        return x, y
+
+    def test_lenet_nan_burst_rolls_back_to_clean_trajectory(self):
+        n_steps = 10
+        clean_net, clean_step = self._build(0)
+        for i in range(n_steps):
+            float(clean_step(*self._batch(i)))
+        clean = _params_np(clean_net)
+
+        net, step = self._build(0)
+        g = TrainGuardian(step, snapshot_every=2, skip_limit=2,
+                          max_rollbacks=2)
+        trips0 = monitor.stat_get("sentinel_trips")
+        rb0 = monitor.stat_get("rollbacks")
+        paddle.set_flags(
+            {"FLAGS_fault_inject": "nan_grad@step=5:repeat=3"})
+        _guardian_loop(step, g, self._batch, n_steps)
+        g.close()
+        assert monitor.stat_get("sentinel_trips") - trips0 >= 3
+        assert monitor.stat_get("rollbacks") - rb0 >= 1
+        faulty = _params_np(net)
+        for k in clean:
+            np.testing.assert_allclose(faulty[k], clean[k],
+                                       rtol=1e-5, atol=1e-6, err_msg=k)

@@ -323,6 +323,27 @@ class TestEngine:
         with pytest.raises(RuntimeError):
             eng.submit(_prompt(4))
 
+    def test_submit_after_scheduler_crash_fails_fast(self, engine):
+        """ISSUE 5 satellite: a dead scheduler must not let submit()
+        enqueue requests that hang forever — it fails fast with the
+        stored crash cause."""
+        eng = engine()
+        boom = RuntimeError("device wedged")
+
+        def crash(*a, **kw):
+            raise boom
+
+        eng._prefill = crash
+        victim = eng.submit(_prompt(4), max_new_tokens=4)
+        with pytest.raises(RuntimeError):
+            victim.result(timeout=120)
+        assert victim.finish_reason == "error"
+        eng._thread.join(timeout=120)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="device wedged"):
+            eng.submit(_prompt(4), max_new_tokens=4)
+        assert time.monotonic() - t0 < 1.0  # fail-fast, not a queue hang
+
     def test_shutdown_without_drain_evicts(self, engine):
         eng = engine(n_slots=1)
         a = eng.submit(_prompt(4), max_new_tokens=58)

@@ -136,6 +136,70 @@ def serving_report(rows: list, file=None) -> dict:
     return out
 
 
+def resilience_report(events: list, rows: list, file=None,
+                      gauges: dict | None = None) -> dict:
+    """Self-healing verdict from the resilience spans (ISSUE 5).
+
+    TrainGuardian emits ``resilience.snapshot`` / ``resilience.rollback``
+    / ``resilience.preempt_save`` spans and ``resilience.trip`` instants.
+    This prints the trip/rollback/preemption timeline and a one-line
+    verdict: a healthy run snapshots and nothing else; trips without
+    rollbacks mean the in-jit gate absorbed them; rollbacks/preemption
+    are the events an on-call human wants timestamped. ``gauges`` (a
+    stat_snapshot dict) adds the counter view when provided."""
+    res = [e for e in events
+           if str(e.get("name", "")).startswith("resilience.")]
+    if not res and not gauges:
+        return {}
+    counts: dict = {}
+    timeline = []
+    for e in sorted(res, key=lambda e: float(e.get("ts", 0))):
+        name = e["name"].split(".", 1)[1]
+        counts[name] = counts.get(name, 0) + 1
+        if name != "snapshot":  # snapshots are cadence noise on the timeline
+            entry = {"t_ms": float(e.get("ts", 0)) / 1e3, "event": name}
+            entry.update(e.get("args") or {})
+            timeline.append(entry)
+    out = {"counts": counts, "timeline": timeline}
+    if gauges:
+        out["gauges"] = {k: gauges[k] for k in
+                         ("faults_injected", "sentinel_trips", "rollbacks",
+                          "preempt_saves", "watchdog_stalls")
+                         if k in gauges}
+    # spans are authoritative (scoped to this trace); gauges are process-
+    # cumulative, so they only speak when the trace has no spans at all
+    src = counts if res else {
+        "trip": (gauges or {}).get("sentinel_trips", 0),
+        "rollback": (gauges or {}).get("rollbacks", 0),
+        "preempt_save": (gauges or {}).get("preempt_saves", 0)}
+    trips = src.get("trip", 0)
+    rollbacks = src.get("rollback", 0)
+    preempts = src.get("preempt_save", 0)
+    if preempts:
+        out["verdict"] = ("preempted: a priority checkpoint was forced — "
+                         "expect a relaunch resuming from it")
+    elif rollbacks:
+        out["verdict"] = (f"unhealthy: {trips} sentinel trip(s) escalated "
+                          f"to {rollbacks} rollback(s) — inspect the data/"
+                          "lr around the rollback timestamps")
+    elif trips:
+        out["verdict"] = (f"recovered: {trips} sentinel trip(s) absorbed "
+                          "by the in-jit skip gate, no rollback needed")
+    else:
+        out["verdict"] = "healthy: snapshots only, no trips"
+    print("\nResilience:", file=file)
+    for k, v in counts.items():
+        print(f"  {k:<22}{v:>12}", file=file)
+    for g, v in out.get("gauges", {}).items():
+        print(f"  gauge {g:<16}{v:>12}", file=file)
+    for entry in timeline:
+        extra = {k: v for k, v in entry.items() if k not in ("t_ms", "event")}
+        print(f"  t={entry['t_ms']:>12.3f}ms  {entry['event']}"
+              + (f"  {extra}" if extra else ""), file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
 def report(rows: list, top: int = 20, file=None) -> list:
     rows = rows[:top]
     if not rows:
@@ -156,10 +220,12 @@ def main(argv=None):
     ap.add_argument("--top", type=int, default=20,
                     help="number of spans to print (by total time)")
     args = ap.parse_args(argv)
-    rows = aggregate(load_events(args.trace))
+    events = load_events(args.trace)
+    rows = aggregate(events)
     report(rows, args.top)
     input_pipeline_report(rows)
     serving_report(rows)
+    resilience_report(events, rows)
     return rows
 
 
