@@ -128,6 +128,35 @@ fast_step = [_truthy(os.environ.get("FLAGS_fast_step", "1"))]
 # sampling) — the numerics escape hatch for debugging cache bugs.
 serving_jit = [_truthy(os.environ.get("FLAGS_serving_jit", "1"))]
 
+# Fast-path mirror of FLAGS_fused_optimizer (ISSUE 6 — the reference's
+# operators/fused/ fused Adam/LAMB kernels): flatten the param/moment/grad
+# pytrees into a few contiguous dtype-homogeneous buffers and run the
+# whole optimizer update as ONE pass (a Pallas kernel on TPU, a single
+# fused XLA program elsewhere) instead of a per-leaf tree_map. Opt-in on
+# Adam/AdamW/Lamb eager ``step()`` and on jit.TrainStep /
+# DistributedTrainStep. Default OFF; the unfused path is pinned
+# bit-for-bit while unset.
+fused_optimizer = [_truthy(os.environ.get("FLAGS_fused_optimizer", "0"))]
+
+# Fast-path mirror of FLAGS_fused_kernels (ISSUE 6): fused
+# residual+layernorm and GeLU/SwiGLU-MLP Pallas kernels in the
+# transformer block hot path (ops/fused_kernels.py, wired through
+# ops/fused.py and models/gpt.py). Off-TPU the "fused" entry points fall
+# back to the identical composed jnp math, so flipping the flag on CPU
+# changes nothing; interpret-mode parity tests cover the kernels
+# themselves. Default OFF.
+fused_kernels = [_truthy(os.environ.get("FLAGS_fused_kernels", "0"))]
+
+# Fast-path mirror of FLAGS_overlap_grads (ISSUE 6): latency-hiding
+# gradient collectives — DistributedTrainStep computes grads under
+# shard_map with a per-bucket pmean issued INSIDE the backward (a
+# custom-vjp identity on each param bucket), so the dp-grad all-reduce
+# for layer N overlaps the backward compute of layers < N instead of
+# serializing after the full backward. Default OFF; requires a pure
+# data/sharding mesh (model/pipe degree 1) and replicated params — other
+# topologies keep the GSPMD path.
+overlap_grads = [_truthy(os.environ.get("FLAGS_overlap_grads", "0"))]
+
 # FLAGS_fault_inject (ISSUE 5): deterministic fault-injection spec string
 # (e.g. "nan_grad@step=50:repeat=3,crash@step=120"); empty = no faults.
 # The resilience.faults registry registers a watcher here so set_flags
@@ -149,6 +178,12 @@ def set_flag(name: str, value) -> None:
         fast_step[0] = _truthy(value)
     elif name.endswith("serving_jit"):
         serving_jit[0] = _truthy(value)
+    elif name.endswith("fused_optimizer"):
+        fused_optimizer[0] = _truthy(value)
+    elif name.endswith("fused_kernels"):
+        fused_kernels[0] = _truthy(value)
+    elif name.endswith("overlap_grads"):
+        overlap_grads[0] = _truthy(value)
     elif name.endswith("fault_inject"):
         fault_inject[0] = str(value)
         for watcher in fault_inject_watchers:

@@ -255,6 +255,22 @@ class TrainStep:
 
         sentinel_cfg = self._sentinel_cfg
 
+        # FLAGS_fused_optimizer (read at build time): run the whole
+        # Adam/AdamW update as one flat-buffer pass per dtype bucket
+        # (ops/fused_optimizer.py) instead of the per-param loop below —
+        # same slot layout, same checkpoint shape, fused execution.
+        from ..core.native import fused_optimizer as _fused_opt_flag
+        from ..monitor.stats import FUSED_OPTIMIZER_STEPS as _fused_gauge
+
+        use_fused = (_fused_opt_flag[0]
+                     and type(opt).__name__ in ("Adam", "AdamW")
+                     and opt._slot_names() == ["moment1", "moment2",
+                                               "beta1_pow", "beta2_pow"])
+        self._use_fused = use_fused
+        self._fused_gauge = _fused_gauge
+        if use_fused:
+            from ..ops.fused_optimizer import fused_update_from_slots
+
         # loss_fn contract: loss_fn(run_model, *batch_tensors) -> loss Tensor,
         # where run_model(*model_inputs) executes the params-bound model.
         def step_impl(params, slots, buffers, lr, batch, sent_state):
@@ -274,16 +290,22 @@ class TrainStep:
             (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             if grad_post is not None:
                 grads = grad_post(grads)
-            new_params = {}
-            new_slots = {}
-            for k in param_names:
-                h = dict(hyper[k])
-                out = pure_update(params[k], grads[k].astype(params[k].dtype),
-                                  jnp.asarray(lr, jnp.float32), *slots[k], **h)
-                if not isinstance(out, tuple):
-                    out = (out,)
-                new_params[k] = out[0]
-                new_slots[k] = list(out[1:])
+            if use_fused:
+                new_params, new_slots = fused_update_from_slots(
+                    opt, param_names, params, grads, slots, lr, hyper)
+            else:
+                new_params = {}
+                new_slots = {}
+                for k in param_names:
+                    h = dict(hyper[k])
+                    out = pure_update(params[k],
+                                      grads[k].astype(params[k].dtype),
+                                      jnp.asarray(lr, jnp.float32),
+                                      *slots[k], **h)
+                    if not isinstance(out, tuple):
+                        out = (out,)
+                    new_params[k] = out[0]
+                    new_slots[k] = list(out[1:])
             if sent_state is not None:
                 # in-jit health verdict + GradScaler-style skip gate
                 # (resilience.sentinel): a tripped step is a no-op
@@ -317,6 +339,8 @@ class TrainStep:
             # resilience.faults; one list-index check when idle
             batch = _faults.FAULTS.on_train_step(self._step_count, batch)
         self._step_count += 1
+        if getattr(self, "_use_fused", False):
+            self._fused_gauge.add()
         if _fast_step[0]:
             return self._call_fast(batch)
         params = {k: self._params[k]._data for k in self._param_names}

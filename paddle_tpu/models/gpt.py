@@ -32,11 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..core import native as _native
 from ..ops.flash_attention import NEG_INF, _attention_reference, _on_tpu
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
            "gpt_param_specs", "gpt_tiny", "gpt_small", "gpt_1p3b",
-           "bert_base_config", "gpt_prefill", "gpt_decode_step"]
+           "bert_base_config", "gpt_prefill", "gpt_decode_step",
+           "quantize_gpt_weights"]
 
 
 @dataclasses.dataclass
@@ -62,6 +64,11 @@ class GPTConfig:
     # (context parallelism — new capability vs the reference, SURVEY.md §5)
     ring_attention: bool = False
     seq_axis: str = "sharding"
+    # fused residual+LN+MLP block half (ops/fused_kernels.py Pallas
+    # kernels with custom-VJP backward). None = follow
+    # FLAGS_fused_kernels at trace time; off-TPU the fused entry runs the
+    # identical composed math, so this is numerics-neutral on CPU.
+    fused_mlp: Optional[bool] = None
 
     @property
     def head_dim(self):
@@ -213,9 +220,19 @@ def _block_kv(cfg: GPTConfig, p, x):
     o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
     x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
 
-    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
-    h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
-    x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+    fused = (cfg.fused_mlp if cfg.fused_mlp is not None
+             else _native.fused_kernels[0])
+    if fused:
+        from ..ops.fused_kernels import fused_ln_mlp
+
+        x = fused_ln_mlp(x, p["fc_w"].astype(cd), p["fc_b"].astype(cd),
+                         p["out_w"].astype(cd), p["out_b"].astype(cd),
+                         ln_scale=p["ln2_s"], ln_bias=p["ln2_b"],
+                         residual=True, act="gelu")
+    else:
+        h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+        h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
+        x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
     return x, (kh, vh)
 
 
@@ -378,18 +395,52 @@ def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1,
 # param trees are a training layout; serving expects the flat (L, ...)
 # blocks gpt_init produces.
 
+def quantize_gpt_weights(params, names=("qkv_w", "proj_w", "fc_w",
+                                        "out_w")):
+    """Per-channel int8 weight quantization of the block matmuls.
+
+    Each named (L, K, N) block weight becomes ``{"q": int8 (L, K, N),
+    "s": f32 (L, N)}`` (s is the dequant multiplier absmax/127, reduced
+    over the contraction dim). The resulting tree feeds
+    :func:`gpt_decode_step` — ``_block_decode`` routes dict-typed
+    weights through the Pallas int8 matmul with dynamic per-tensor
+    activation quantization (ops/int8_matmul.py). Embedding/logits stay
+    fp (the tied wte doubles as the lookup table). First consumer:
+    ``serving.InferenceEngine(int8_weights=True)``."""
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name in names:
+        w = jnp.asarray(blocks[name], jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=1), 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w / s[:, None, :]), -127, 127)
+        blocks[name] = {"q": q.astype(jnp.int8), "s": s}
+    out["blocks"] = blocks
+    return out
+
+
+def _dec_mm(x, w, cd):
+    """x @ w for a maybe-int8-quantized decode weight (see
+    quantize_gpt_weights)."""
+    if isinstance(w, dict):
+        from ..ops.int8_matmul import dynamic_int8_matmul
+
+        return dynamic_int8_matmul(x, w["q"], w["s"]).astype(cd)
+    return x @ w.astype(cd)
+
+
 def _block_decode(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
     """One-token block step against one layer's cache slice.
 
     x (B, 1, H); kc_l/vc_l (B, nh, max_len, hd) — this layer's cache for
     every slot; positions (B,) int32 — where each slot's incoming token
-    lands. Returns (x, updated kc_l, updated vc_l)."""
+    lands. Block weights may be int8-quantized dicts (see
+    quantize_gpt_weights). Returns (x, updated kc_l, updated vc_l)."""
     B = x.shape[0]
     nh, hd = cfg.n_heads, cfg.head_dim
     cd = cfg.dtype
 
     h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
-    qkv = h @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
+    qkv = _dec_mm(h, p["qkv_w"], cd) + p["qkv_b"].astype(cd)
     q, k, v = jnp.split(qkv, 3, axis=-1)         # each (B, 1, H)
     to_heads = lambda t: t.reshape(B, nh, hd)
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
@@ -409,10 +460,10 @@ def _block_decode(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
     w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     o = jnp.einsum("bhk,bhkd->bhd", w, vc_l).reshape(B, 1, nh * hd)
 
-    x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
+    x = x + _dec_mm(o, p["proj_w"], cd) + p["proj_b"].astype(cd)
     h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
-    h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
-    x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+    h = jax.nn.gelu(_dec_mm(h, p["fc_w"], cd) + p["fc_b"].astype(cd))
+    x = x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
     return x, kc_l, vc_l
 
 

@@ -171,6 +171,11 @@ DEFAULT_STATS = (
     "preempt_saves",          # SIGTERM-forced priority checkpoint saves
     "watchdog_stalls",        # stalled-step detections by the watchdog thread
     "guardian_heartbeat_ms",  # gauge: monotonic ms of the last guarded step
+    # Pallas kernel library + comm/compute overlap (ISSUE 6)
+    "fused_optimizer_steps",  # fused (flat-buffer) optimizer steps taken
+    "fused_kernel_calls",     # fused LN/MLP kernel dispatches (eager surface)
+    "int8_matmul_calls",      # int8 weight-quantized matmul dispatches
+    "grad_overlap_buckets",   # grad all-reduce buckets issued inside backward
 )
 
 for _n in DEFAULT_STATS:
@@ -205,6 +210,10 @@ ROLLBACKS = _registry.get_stat("rollbacks")
 PREEMPT_SAVES = _registry.get_stat("preempt_saves")
 WATCHDOG_STALLS = _registry.get_stat("watchdog_stalls")
 GUARDIAN_HEARTBEAT_MS = _registry.get_stat("guardian_heartbeat_ms")
+FUSED_OPTIMIZER_STEPS = _registry.get_stat("fused_optimizer_steps")
+FUSED_KERNEL_CALLS = _registry.get_stat("fused_kernel_calls")
+INT8_MATMUL_CALLS = _registry.get_stat("int8_matmul_calls")
+GRAD_OVERLAP_BUCKETS = _registry.get_stat("grad_overlap_buckets")
 
 
 # per-mesh-axis device-memory gauges published by the last

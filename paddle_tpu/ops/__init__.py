@@ -1,10 +1,24 @@
 """paddle_tpu.ops — Pallas TPU kernels and fused ops.
 
 The analog of the reference's operators/fused/ (fused_transformer_op.cu,
-fmha_ref.h) and the fusion_group runtime codegen — except on TPU, XLA
-already fuses elementwise chains, so hand-written kernels are reserved for
-the cases XLA can't do: flash attention (online softmax tiling) and
-ring attention (overlapping ICI permutes with compute).
+fused_feedforward_op.cc, fused Adam) and the fusion_group runtime codegen.
+Hand-written kernels are reserved for what XLA can't do by itself:
+
+- flash attention (online-softmax tiling; ops/flash_attention.py)
+- fused residual+layernorm and GeLU/SwiGLU MLP blocks with custom-VJP
+  backward kernels (ops/fused_kernels.py, FLAGS_fused_kernels)
+- one-pass flat-buffer AdamW/LAMB updates (ops/fused_optimizer.py,
+  FLAGS_fused_optimizer)
+- int8 weight-quantized matmul with in-epilogue per-channel dequant
+  (ops/int8_matmul.py, routed through quantization.quantized_linear and
+  the serving engine's int8 decode)
+
+Every kernel follows the same contract: jnp reference math off-TPU,
+``interpret=True`` for CPU parity tests (pytest -m kernels), a
+FLAGS_benchmark row and a ``kernel.*`` trace span at its eager surface.
 """
 from .flash_attention import flash_attention  # noqa: F401
 from .fused import fused_multi_head_attention, fused_feedforward  # noqa: F401
+from .fused_kernels import fused_ln_mlp, fused_add_layernorm  # noqa: F401
+from .fused_optimizer import fused_adamw_update, fused_lamb_update  # noqa: F401
+from .int8_matmul import int8_matmul_arrays, dynamic_int8_matmul  # noqa: F401

@@ -10,7 +10,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.native import fused_kernels as _fused_kernels_flag
 from ..framework.core import Tensor, apply_op
+from ..monitor.stats import FUSED_KERNEL_CALLS
 from .flash_attention import flash_attention_arrays
 
 
@@ -50,6 +52,19 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight, linear_bi
 
 
 def _fused_ffn(x, w1, b1, w2, b2, ln_w, ln_b, pre_ln, act, eps):
+    if _fused_kernels_flag[0]:
+        # FLAGS_fused_kernels: the Pallas fused LN/MLP library
+        # (ops/fused_kernels.py). Off-TPU these entries run the identical
+        # composed math below, so the flag is numerics-neutral on CPU.
+        from .fused_kernels import fused_add_layernorm, fused_ln_mlp
+
+        if pre_ln:
+            return fused_ln_mlp(x, w1, b1, w2, b2, ln_scale=ln_w,
+                                ln_bias=ln_b, residual=True, act=act,
+                                eps=eps)
+        mlp = fused_ln_mlp(x, w1, b1, w2, b2, ln_scale=None,
+                           residual=False, act=act, eps=eps)
+        return fused_add_layernorm(x, mlp, ln_w, ln_b, eps=eps)
     residual = x
     if pre_ln:
         mu = jnp.mean(x, -1, keepdims=True)
@@ -68,6 +83,8 @@ def _fused_ffn(x, w1, b1, w2, b2, ln_w, ln_b, pre_ln, act, eps):
 def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight, linear2_bias,
                       ln_scale, ln_bias, pre_layer_norm=False, activation="relu",
                       epsilon=1e-5, name=None):
+    if _fused_kernels_flag[0]:
+        FUSED_KERNEL_CALLS.add()
     return apply_op(_fused_ffn, x, linear1_weight, linear1_bias, linear2_weight,
                     linear2_bias, ln_scale, ln_bias, pre_ln=bool(pre_layer_norm),
                     act=activation, eps=float(epsilon))

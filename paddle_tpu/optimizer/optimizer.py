@@ -95,7 +95,14 @@ class Optimizer:
     def _decoupled_wd(self):
         return False
 
+    def _fused_supported(self) -> bool:
+        """Does this optimizer have a flat-buffer fused step
+        (ops/fused_optimizer.py)? Opt-in via FLAGS_fused_optimizer."""
+        return False
+
     def step(self):
+        from ..core.native import fused_optimizer as _fused_flag
+
         params_grads = []
         for p in self._parameter_list or []:
             if p.grad is None or not getattr(p, "trainable", True):
@@ -104,6 +111,16 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
+        if _fused_flag[0] and self._fused_supported():
+            # FLAGS_fused_optimizer: ONE device dispatch over flat
+            # dtype-homogeneous buckets (persistent flat m/v) instead of
+            # a per-parameter jit call each — falls through to the
+            # unfused loop when the param set isn't coverable
+            from ..ops.fused_optimizer import fused_eager_step
+
+            if fused_eager_step(self, params_grads, lr):
+                self._post_step()
+                return
         for p, g in params_grads:
             garr = g._data if isinstance(g, Tensor) else g
             garr = self._regularized_grad(p, garr.astype(p._data.dtype))
@@ -161,6 +178,12 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def state_dict(self):
+        fused = getattr(self, "_fused_state", None)
+        if fused is not None and getattr(self, "_slots_stale", False):
+            # flush the fused path's flat m/v buffers back into the
+            # per-param slot mirrors so checkpoints see current state
+            fused.sync_slots(self)
+            self._slots_stale = False
         state = {}
         for name, store in self._accumulators.items():
             for p in self._parameter_list or []:
@@ -184,6 +207,9 @@ class Optimizer:
                     store[id(p)] = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        # loaded slots supersede any fused flat buffers; rebuild lazily
+        self._fused_state = None
+        self._slots_stale = False
 
     @property
     def _param_groups(self):
@@ -258,6 +284,7 @@ class Adam(Optimizer):
         self._beta1 = float(beta1 if not isinstance(beta1, Tensor) else beta1.item())
         self._beta2 = float(beta2 if not isinstance(beta2, Tensor) else beta2.item())
         self._epsilon = float(epsilon)
+        self._multi_precision = bool(multi_precision)
 
     def _slot_names(self):
         return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
@@ -267,7 +294,16 @@ class Adam(Optimizer):
             return jnp.asarray(self._beta1, dtype=jnp.float32)
         if name == "beta2_pow":
             return jnp.asarray(self._beta2, dtype=jnp.float32)
+        # multi_precision (reference adam_op MultiPrecision path): fp32
+        # master moments for low-precision params — zeros_like would
+        # silently give bf16/fp16 params bf16/fp16 moments, losing the
+        # fp32 accumulation multi_precision=True asks for
+        if self._multi_precision and jnp.dtype(p._data.dtype).itemsize < 4:
+            return jnp.zeros(p._data.shape, jnp.float32)
         return jnp.zeros_like(p._data)
+
+    def _fused_supported(self):
+        return type(self) in (Adam, AdamW)
 
     def _hyper(self, p):
         return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon}
@@ -421,6 +457,9 @@ class Lamb(Optimizer):
         self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
         self._lamb_wd = float(lamb_weight_decay)
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _fused_supported(self):
+        return type(self) is Lamb
 
     def _slot_names(self):
         return ["moment1", "moment2", "beta1_pow", "beta2_pow"]

@@ -21,13 +21,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import native as _native
 from ..core.native import fast_step as _fast_step
 from ..framework.core import AsyncLoss as _AsyncLoss
+from ..monitor import benchmark as _bench
 from ..monitor import stats as _mstats
 from ..monitor.trace import span as _trace_span
 from ..resilience import faults as _faults
 from ..resilience import sentinel as _sentinel
 from .mesh import get_mesh, mesh_shape
+from .ring_attention import _shard_map_call
 from .sharding import zero_shard_specs
 
 __all__ = ["DistributedTrainStep", "pure_adamw_init", "pure_adamw_update",
@@ -243,6 +246,28 @@ _OPTS = {
 }
 
 
+def _pmean_in_bwd(axes):
+    """Identity whose BACKWARD all-reduces the cotangent over ``axes`` —
+    applied per param bucket inside shard_map, it issues the dp-grad
+    pmean at the exact point the backward produces that bucket's grad,
+    so XLA's async collectives overlap it with the REMAINING backward
+    compute (the ring-attention per-hop overlap idea applied to the
+    gradient all-reduce; FLAGS_overlap_grads)."""
+
+    @jax.custom_vjp
+    def ident(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (jax.lax.pmean(g, axes),)
+
+    ident.defvjp(fwd, bwd)
+    return ident
+
+
 class DistributedTrainStep:
     """jit(value_and_grad(loss) + clip + optimizer) with Fleet shardings.
 
@@ -300,6 +325,15 @@ class DistributedTrainStep:
                                "(parallel.create_mesh)")
         if isinstance(optimizer, str):
             init_fn, update_fn = _OPTS[optimizer]
+            if _native.fused_optimizer[0] and optimizer in ("adamw",
+                                                            "lamb"):
+                # FLAGS_fused_optimizer: same init/state layout, the
+                # update math as flat-bucket passes (Pallas on TPU)
+                from ..ops.fused_optimizer import (fused_adamw_update,
+                                                   fused_lamb_update)
+
+                update_fn = (fused_adamw_update if optimizer == "adamw"
+                             else fused_lamb_update)
         else:
             init_fn, update_fn = optimizer
         self._update_fn = update_fn
@@ -355,6 +389,7 @@ class DistributedTrainStep:
             self.aux = None
 
         batch_sh = NamedSharding(self.mesh, batch_spec)
+        self._batch_sh = batch_sh
 
         self._dyn = dict(dynamic_scale) if dynamic_scale else None
         if self._dyn is not None:
@@ -371,28 +406,73 @@ class DistributedTrainStep:
         self.sentinel_state = (_sentinel.init_state()
                                if self._sentinel_cfg is not None else None)
 
+        # FLAGS_overlap_grads (read at construction): grads computed
+        # under shard_map with a per-bucket pmean issued INSIDE the
+        # backward (_pmean_in_bwd), overlapping the dp all-reduce with
+        # the remaining backward compute. Only sound when every param is
+        # replicated (pure data/sharding mesh, no aux) — other
+        # topologies keep the GSPMD path.
+        self._overlap_axes = None
+        if _native.overlap_grads[0]:
+            shape = mesh_shape(self.mesh)
+            spec_leaves = jax.tree_util.tree_leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, P))
+            replicated = all(
+                isinstance(s, P) and all(e is None for e in tuple(s))
+                for s in spec_leaves)
+            if (shape.get("model", 1) == 1 and shape.get("pipe", 1) == 1
+                    and not self._has_aux and replicated):
+                self._overlap_axes = tuple(
+                    a for a in ("data", "sharding") if shape.get(a, 1) > 0)
+                n_buckets = len(jax.tree_util.tree_leaves(params))
+                _mstats.GRAD_OVERLAP_BUCKETS.add(n_buckets)
+
         def step(params, opt_state, aux, batch, lr, scaler_state,
                  sent_state):
             scale = (scaler_state["scale"] if scaler_state is not None
                      else jnp.float32(1.0))
 
-            def run_loss(p):
-                if self._has_aux:
-                    loss, new_aux = self._loss_fn(p, aux, batch)
-                else:
-                    loss, new_aux = self._loss_fn(p, batch), aux
-                return loss * scale.astype(loss.dtype), (loss, new_aux)
+            if self._overlap_axes is not None:
+                axes = self._overlap_axes
+                ident = _pmean_in_bwd(axes)
 
-            (_, (loss, new_aux)), grads = jax.value_and_grad(
-                run_loss, has_aux=True)(params)
-            # pin grads to the PARAM layout: the ZeRO reshard (m/v carry
-            # the "sharding" axis) then happens at this boundary as a
-            # reduce-scatter, instead of GSPMD propagating the opt-state
-            # sharding backward through the loss (which forces
-            # replicate-and-repartition inside the pipeline scan)
-            grads = jax.tree_util.tree_map(
-                lambda g, s: jax.lax.with_sharding_constraint(g, s),
-                grads, self._param_sh)
+                def local_step(p, b, sc):
+                    def run_local(pp):
+                        # per-bucket in-backward pmean: each leaf's grad
+                        # all-reduce launches as soon as the backward
+                        # produces it
+                        pp = jax.tree_util.tree_map(ident, pp)
+                        loss = self._loss_fn(pp, b)
+                        return loss * sc.astype(loss.dtype), loss
+
+                    (_, loss), g = jax.value_and_grad(
+                        run_local, has_aux=True)(p)
+                    return jax.lax.pmean(loss, axes), g
+
+                loss, grads = _shard_map_call(
+                    local_step, self.mesh,
+                    in_specs=(P(), self._batch_spec, P()),
+                    out_specs=(P(), P()))(params, batch, scale)
+                new_aux = aux
+            else:
+                def run_loss(p):
+                    if self._has_aux:
+                        loss, new_aux = self._loss_fn(p, aux, batch)
+                    else:
+                        loss, new_aux = self._loss_fn(p, batch), aux
+                    return loss * scale.astype(loss.dtype), (loss, new_aux)
+
+                (_, (loss, new_aux)), grads = jax.value_and_grad(
+                    run_loss, has_aux=True)(params)
+                # pin grads to the PARAM layout: the ZeRO reshard (m/v
+                # carry the "sharding" axis) then happens at this
+                # boundary as a reduce-scatter, instead of GSPMD
+                # propagating the opt-state sharding backward through
+                # the loss (which forces replicate-and-repartition
+                # inside the pipeline scan)
+                grads = jax.tree_util.tree_map(
+                    lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                    grads, self._param_sh)
             if scaler_state is not None:
                 inv = (1.0 / scale)
                 grads = jax.tree_util.tree_map(
@@ -533,3 +613,82 @@ class DistributedTrainStep:
         return self._step.lower(self.params, self.opt_state, self.aux, batch,
                                 jnp.float32(self.current_lr()),
                                 self.scaler_state, self.sentinel_state)
+
+    def measure_overlap(self, batch, reps: int = 2) -> dict:
+        """Comm-vs-compute overlap diagnostic (FLAGS_overlap_grads).
+
+        Times three programs over the real mesh/batch: (a) the full
+        loss+grads including the dp all-reduce, (b) backward COMPUTE
+        only (shard_map local grads, no grad collective), (c) the grad
+        all-reduce COMM alone over grad-shaped buffers. Overlap quality
+        = how much of (c) hides inside (a):
+        ``hidden_frac = clamp((compute + comm - step) / comm, 0, 1)``.
+        Emits ``overlap.step`` / ``overlap.compute`` / ``overlap.comm``
+        trace spans (tools/trace_report.py turns them into a verdict)
+        and FLAGS_benchmark rows. Does NOT touch training state."""
+        import time as _time
+
+        axes = self._overlap_axes or tuple(
+            a for a in ("data", "sharding")
+            if mesh_shape(self.mesh).get(a, 1) > 0)
+        loss_fn = self._loss_fn
+        if self._has_aux:
+            aux = self.aux
+            loss_fn = lambda p, b: self._loss_fn(p, aux, b)[0]  # noqa: E731
+
+        def full(p, b):
+            return jax.grad(lambda pp: loss_fn(pp, b))(p)
+
+        def compute_only(p, b):
+            g = jax.grad(lambda pp: loss_fn(pp, b))(p)
+            # cheap scalar reduce so nothing is all-gathered: the grad
+            # collectives themselves are what (c) measures
+            return jax.lax.pmean(
+                sum(jnp.sum(jnp.abs(t.astype(jnp.float32)))
+                    for t in jax.tree_util.tree_leaves(g)), axes)
+
+        def comm_only(g):
+            return jax.tree_util.tree_map(
+                lambda t: jax.lax.pmean(t, axes), g)
+
+        param_sh = self._param_sh
+        full_j = jax.jit(full, in_shardings=(param_sh, self._batch_sh),
+                         out_shardings=param_sh)
+        comp_j = jax.jit(_shard_map_call(
+            compute_only, self.mesh, in_specs=(P(), self._batch_spec),
+            out_specs=P()))
+        comm_j = jax.jit(_shard_map_call(
+            comm_only, self.mesh, in_specs=(P(),), out_specs=P()))
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), self.params)
+
+        from ..monitor import trace as _trace
+
+        def timed(name, fn, *args):
+            with self.mesh:
+                jax.block_until_ready(fn(*args))          # compile+warm
+                best = float("inf")
+                for _ in range(max(1, reps)):
+                    t0 = _time.perf_counter()
+                    jax.block_until_ready(fn(*args))
+                    best = min(best, _time.perf_counter() - t0)
+            if _trace.is_tracing():
+                # span duration == measured device time (not re-run)
+                _trace.get_writer().add_complete(
+                    "overlap.%s" % name, _time.perf_counter() - best,
+                    best, cat="overlap", args={"ms": best * 1e3})
+            if _bench.enabled():
+                _bench.record_op("grad_overlap@%s" % name, best)
+            return best * 1e3
+
+        step_ms = timed("step", full_j, self.params, batch)
+        compute_ms = timed("compute", comp_j, self.params, batch)
+        comm_ms = timed("comm", comm_j, zeros)
+        out = {"step_ms": step_ms, "compute_ms": compute_ms,
+               "comm_ms": comm_ms, "buckets": len(
+                   jax.tree_util.tree_leaves(self.params)),
+               "overlap_enabled": self._overlap_axes is not None}
+        if comm_ms > 0:
+            out["hidden_frac"] = max(
+                0.0, min(1.0, (compute_ms + comm_ms - step_ms) / comm_ms))
+        return out

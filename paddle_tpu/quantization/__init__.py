@@ -11,9 +11,15 @@ TPU-native redesign:
   registration serves eager, to_static and the compiled train step; the
   reference needed separate fake_quantize_* CUDA ops + grad ops.
 - int8 inference is REAL int8: v5e's MXU runs int8 at 2x the bf16 rate
-  (394 vs 197 TOPS), so ``quantized_linear`` lowers to an int8
-  lax.dot_general with int32 accumulation and per-channel rescale —
-  the analog of the reference's cuDNN int8 conv path.
+  (394 vs 197 TOPS), so ``quantized_linear`` lowers to an int8 dot with
+  int32 accumulation and per-channel rescale — the analog of the
+  reference's cuDNN int8 conv path.
+- on TPU that dot runs through the Pallas fused int8 kernel
+  (``ops/int8_matmul.py``): the per-channel dequant and bias add execute
+  in the kernel epilogue, so the int32 accumulator never round-trips
+  HBM. Off-TPU the identical XLA math runs. The serving engine's
+  weight-only int8 decode (``serving.InferenceEngine(int8_weights=True)``
+  over ``models.gpt.quantize_gpt_weights``) is the first consumer.
 """
 from __future__ import annotations
 
@@ -93,15 +99,15 @@ def quantize_weight(w, bits=8, per_channel_axis=1):
 def _int8_linear(x, wq, wscale, xscale, bias):
     # quantize activation with the calibrated scale, int8 matmul with
     # int32 accumulation (MXU int8 path), dequantize with the product of
-    # scales (wscale broadcasts over the trailing out-features dim)
+    # scales (wscale broadcasts over the trailing out-features dim). On
+    # TPU the dot + per-channel dequant + bias run as one Pallas kernel
+    # (ops/int8_matmul.py, dequant fused into the epilogue); elsewhere
+    # the identical XLA dot_general math.
+    from ..ops.int8_matmul import int8_matmul_arrays
+
     xq = jnp.clip(jnp.round(x / xscale), -127, 127).astype(jnp.int8)
-    acc = jax.lax.dot_general(
-        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    out = acc.astype(jnp.float32) * (xscale * wscale)
-    if bias is not None:
-        out = out + bias
-    return out.astype(x.dtype)
+    return int8_matmul_arrays(xq, wq, wscale, xscale, bias=bias,
+                              out_dtype=x.dtype)
 
 
 def quantized_linear(x, wq, wscale, xscale, bias=None):

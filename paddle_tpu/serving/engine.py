@@ -171,13 +171,33 @@ class InferenceEngine:
 
     ``params`` is a gpt_init-layout pytree (flat blocks — stage-stacked
     training layouts must be unstacked first).
+
+    ``int8_weights=True`` quantizes the block matmul weights to int8
+    per-channel (models.gpt.quantize_gpt_weights) for the DECODE step —
+    the steady-state batched tick runs through the Pallas fused int8
+    matmul (ops/int8_matmul.py; dequant in the kernel epilogue, int8 at
+    2x the bf16 MXU rate on v5e). Prefill and the FLAGS_serving_jit=0
+    reference decode keep the fp weights, so admission numerics are
+    unchanged; decode tokens are near-greedy-identical but not pinned
+    bit-for-bit (weight rounding). Default off.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
                  max_len: Optional[int] = None, queue_size: int = 64,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 int8_weights: bool = False):
         self.cfg = cfg
         self._params = jax.device_put(params)
+        self.int8_weights = bool(int8_weights)
+        if int8_weights:
+            from ..models.gpt import quantize_gpt_weights
+            from ..monitor.stats import INT8_MATMUL_CALLS
+
+            self._decode_params = jax.device_put(
+                quantize_gpt_weights(params))
+            INT8_MATMUL_CALLS.add()
+        else:
+            self._decode_params = self._params
         self.cache = KVCache(cfg, n_slots, max_len)
         self.n_slots = self.cache.n_slots
         self.max_len = self.cache.max_len
@@ -451,7 +471,8 @@ class InferenceEngine:
                   args={"batch": len(active)}):
             if native.serving_jit[0]:
                 out, self.cache.k, self.cache.v = self._decode_jit(
-                    self._params, self.cache.k, self.cache.v, positions,
+                    self._decode_params, self.cache.k, self.cache.v,
+                    positions,
                     tokens, self._next_key(), temps, top_ks, top_ps)
                 out = np.asarray(out)
             else:

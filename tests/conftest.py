@@ -27,6 +27,10 @@ def pytest_configure(config):
         "markers",
         "slow: long-running tests excluded from the tier-1 `-m 'not slow'` "
         "budget (full fault matrices, big-model benches)")
+    config.addinivalue_line(
+        "markers",
+        "kernels: Pallas kernel parity suite (interpret mode on CPU) — "
+        "select with `pytest -m kernels` after touching ops/ kernels")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,384 @@
+"""Pallas kernel-library parity suite (ISSUE 6).
+
+Every kernel in ops/fused_kernels.py, ops/fused_optimizer.py and
+ops/int8_matmul.py runs here through the Pallas INTERPRETER against the
+composed jnp reference math, so tier-1 exercises the kernel bodies on
+CPU (select with ``pytest -m kernels``). Plus: flash-attention block
+picker edge shapes, and the bit-for-bit pins for the default-off flags.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.flash_attention import (_attention_reference,
+                                            _auto_block, _pick_block_b,
+                                            flash_attention_arrays)
+from paddle_tpu.ops.fused_kernels import (fused_add_layernorm,
+                                          fused_ln_mlp)
+from paddle_tpu.ops.fused_optimizer import adamw_flat, lamb_moments_flat
+from paddle_tpu.ops.int8_matmul import (dynamic_int8_matmul,
+                                        int8_matmul_arrays)
+
+pytestmark = pytest.mark.kernels
+
+RNG = np.random.default_rng(7)
+
+
+def _arr(shape, dtype=jnp.float32, scale=1.0, seed=None):
+    rng = RNG if seed is None else np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=shape) * scale, dtype)
+
+
+# -- fused optimizer kernels -------------------------------------------------
+
+@pytest.mark.parametrize("n", [1000, 16384, 40001])
+@pytest.mark.parametrize("mdt", [jnp.float32, jnp.bfloat16])
+def test_adamw_flat_interpret_parity(n, mdt):
+    p = _arr(n)
+    g = _arr(n)
+    m = _arr(n, mdt, 0.1)
+    v = jnp.abs(_arr(n, mdt, 0.1))
+    kw = dict(b1=0.9, b2=0.999, eps=1e-8, wd=0.01, l2=0.1)
+    ref = adamw_flat(p, g, m, v, 1e-3, 0.1, 0.001, **kw)
+    ker = adamw_flat(p, g, m, v, 1e-3, 0.1, 0.001, interpret=True, **kw)
+    tol = 1e-6 if mdt == jnp.float32 else 4e-6   # bf16 rounding ties
+    for a, b in zip(ref, ker):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a, np.float32),
+                                   atol=tol, rtol=1e-4)
+
+
+def test_adamw_flat_eager_form_matches_pure_update():
+    # the eager_form algebra must reproduce Adam._pure_update exactly
+    from paddle_tpu.optimizer.optimizer import Adam
+
+    n = 2048
+    p, g = _arr(n), _arr(n)
+    m = _arr(n, scale=0.1)
+    v = jnp.abs(_arr(n, scale=0.1))
+    b1p, b2p = jnp.float32(0.9 ** 3), jnp.float32(0.999 ** 3)
+    ref = Adam._pure_update(p, g, jnp.float32(1e-3), m, v, b1p, b2p,
+                            0.9, 0.999, 1e-8)
+    out = adamw_flat(p, g, m, v, 1e-3, 1.0 - b1p, 1.0 - b2p,
+                     b1=0.9, b2=0.999, eps=1e-8, eager_form=True)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               atol=1e-7, rtol=1e-6)
+
+
+def test_lamb_flat_interpret_parity():
+    n = 5000
+    p, g = _arr(n), _arr(n)
+    m = _arr(n, scale=0.1)
+    v = jnp.abs(_arr(n, scale=0.1))
+    ref = lamb_moments_flat(p, g, m, v, 0.1, 0.001, wd=0.01)
+    ker = lamb_moments_flat(p, g, m, v, 0.1, 0.001, wd=0.01,
+                            interpret=True)
+    for a, b in zip(ref, ker):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-6, rtol=1e-5)
+
+
+# -- fused LN/MLP kernels ----------------------------------------------------
+
+def _mlp_weights(H, M, dtype=jnp.float32):
+    return (_arr((H, M), dtype, 0.05), _arr((M,), dtype, 0.01),
+            _arr((M, H), dtype, 0.05), _arr((H,), dtype, 0.01))
+
+
+@pytest.mark.parametrize("act", ["gelu", "relu", "swiglu"])
+@pytest.mark.parametrize("has_ln,residual", [(True, True), (False, False)])
+def test_fused_ln_mlp_forward_parity(act, has_ln, residual):
+    H, M = 128, 256
+    x = _arr((2, 16, H))
+    w1, b1, w2, b2 = _mlp_weights(H, M)
+    s = _arr((H,), scale=0.1) + 1.0
+    b = _arr((H,), scale=0.1)
+    kw = dict(residual=residual, act=act,
+              ln_scale=s if has_ln else None,
+              ln_bias=b if has_ln else None)
+    if act == "swiglu":
+        kw["w_gate"] = _arr((H, M), scale=0.05)
+        kw["b_gate"] = _arr((M,), scale=0.01)
+    ref = fused_ln_mlp(x, w1, b1, w2, b2, **kw)
+    ker = fused_ln_mlp(x, w1, b1, w2, b2, interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("act", ["gelu", "swiglu"])
+def test_fused_ln_mlp_grad_parity(act):
+    H, M = 128, 256
+    x = _arr((1, 32, H), seed=3)
+    w1, b1, w2, b2 = _mlp_weights(H, M)
+    s = _arr((H,), scale=0.1) + 1.0
+    b = _arr((H,), scale=0.1)
+    wg = _arr((H, M), scale=0.05)
+    bg = _arr((M,), scale=0.01)
+
+    def loss(interp):
+        def f(x, w1, b1, w2, b2, s, b, wg, bg):
+            kw = dict(ln_scale=s, ln_bias=b, act=act, interpret=interp)
+            if act == "swiglu":
+                kw.update(w_gate=wg, b_gate=bg)
+            return jnp.sum(jnp.sin(fused_ln_mlp(x, w1, b1, w2, b2, **kw)))
+        return f
+
+    args = (x, w1, b1, w2, b2, s, b, wg, bg)
+    g_ref = jax.grad(loss(None), argnums=tuple(range(9)))(*args)
+    g_ker = jax.grad(loss(True), argnums=tuple(range(9)))(*args)
+    for i, (a, k) in enumerate(zip(g_ref, g_ker)):
+        np.testing.assert_allclose(np.asarray(k), np.asarray(a),
+                                   atol=1e-3, rtol=1e-3,
+                                   err_msg=f"grad arg {i}")
+
+
+def test_fused_ln_mlp_untileable_falls_back():
+    # H=96 (not a lane multiple) must still be correct via the fallback
+    H, M = 96, 192
+    x = _arr((2, 8, H))
+    w1, b1, w2, b2 = _mlp_weights(H, M)
+    out = fused_ln_mlp(x, w1, b1, w2, b2, ln_scale=jnp.ones(H),
+                       ln_bias=jnp.zeros(H))
+    assert out.shape == x.shape
+
+
+def test_fused_add_layernorm_parity_and_grads():
+    H = 256
+    x = _arr((2, 16, H))
+    y = _arr((2, 16, H), seed=5)
+    s = _arr((H,), scale=0.1) + 1.0
+    b = _arr((H,), scale=0.1)
+    ref = fused_add_layernorm(x, y, s, b)
+    ker = fused_add_layernorm(x, y, s, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gr = jax.grad(lambda *a: jnp.sum(jnp.cos(fused_add_layernorm(*a))),
+                  argnums=(0, 1, 2, 3))(x, y, s, b)
+    gk = jax.grad(lambda *a: jnp.sum(jnp.cos(
+        fused_add_layernorm(*a, interpret=True))),
+        argnums=(0, 1, 2, 3))(x, y, s, b)
+    for a, k in zip(gr, gk):
+        np.testing.assert_allclose(np.asarray(k), np.asarray(a),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_fused_feedforward_flag_neutral_on_cpu():
+    # FLAGS_fused_kernels on CPU routes to the identical composed math
+    from paddle_tpu.ops.fused import fused_feedforward
+
+    H, M = 64, 128
+    x = paddle.to_tensor(np.asarray(_arr((2, 8, H))))
+    w1 = paddle.to_tensor(np.asarray(_arr((H, M), scale=0.05)))
+    b1 = paddle.to_tensor(np.zeros(M, np.float32))
+    w2 = paddle.to_tensor(np.asarray(_arr((M, H), scale=0.05)))
+    b2 = paddle.to_tensor(np.zeros(H, np.float32))
+    s = paddle.to_tensor(np.ones(H, np.float32))
+    b = paddle.to_tensor(np.zeros(H, np.float32))
+    for pre_ln in (True, False):
+        off = fused_feedforward(x, w1, b1, w2, b2, s, b,
+                                pre_layer_norm=pre_ln, activation="gelu")
+        paddle.set_flags({"FLAGS_fused_kernels": 1})
+        try:
+            on = fused_feedforward(x, w1, b1, w2, b2, s, b,
+                                   pre_layer_norm=pre_ln,
+                                   activation="gelu")
+        finally:
+            paddle.set_flags({"FLAGS_fused_kernels": 0})
+        np.testing.assert_allclose(np.asarray(on._data),
+                                   np.asarray(off._data),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_gpt_block_flag_bit_identity_on_cpu():
+    from paddle_tpu.models import gpt_forward, gpt_init, gpt_tiny
+
+    cfg = gpt_tiny(dtype=jnp.float32)
+    params = gpt_init(cfg, seed=0)
+    tok = jnp.asarray(RNG.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    base = gpt_forward(cfg, params, tok)
+    paddle.set_flags({"FLAGS_fused_kernels": 1})
+    try:
+        on = gpt_forward(cfg, params, tok)
+    finally:
+        paddle.set_flags({"FLAGS_fused_kernels": 0})
+    assert np.array_equal(np.asarray(base), np.asarray(on))
+
+
+# -- int8 matmul kernel ------------------------------------------------------
+
+def test_int8_matmul_interpret_parity():
+    K, N = 256, 128
+    xq = jnp.asarray(RNG.integers(-127, 128, (48, K)), jnp.int8)
+    wq = jnp.asarray(RNG.integers(-127, 128, (K, N)), jnp.int8)
+    ws = jnp.asarray(RNG.random(N) * 0.01 + 1e-3, jnp.float32)
+    bias = _arr((N,))
+    ref = int8_matmul_arrays(xq, wq, ws, 0.02, bias=bias)
+    ker = int8_matmul_arrays(xq, wq, ws, 0.02, bias=bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               atol=1e-4, rtol=1e-5)
+
+
+def test_int8_matmul_row_padding_and_3d():
+    # M=3 rows pad to the 32-sublane int8 tile; 3-D activations reshape
+    K, N = 128, 128
+    xq = jnp.asarray(RNG.integers(-127, 128, (1, 3, K)), jnp.int8)
+    wq = jnp.asarray(RNG.integers(-127, 128, (K, N)), jnp.int8)
+    ws = jnp.full((N,), 0.005, jnp.float32)
+    ref = int8_matmul_arrays(xq, wq, ws, 0.01)
+    ker = int8_matmul_arrays(xq, wq, ws, 0.01, interpret=True)
+    assert ker.shape == (1, 3, N)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               atol=1e-4, rtol=1e-5)
+
+
+def test_dynamic_int8_matmul_close_to_fp():
+    K, N = 256, 128
+    x = _arr((8, K), scale=0.5)
+    w = _arr((K, N), scale=0.05)
+    s = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8) / 127.0
+    wq = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    out = dynamic_int8_matmul(x, wq, s)
+    ref = x @ w
+    # int8 weight+activation quantization error, not kernel error
+    assert np.median(np.abs(np.asarray(out) - np.asarray(ref))) < 0.05
+
+
+def test_quantized_linear_reference_math_unchanged():
+    # the routed quantized_linear must still equal the hand-written
+    # int8 dequant math it historically lowered to
+    from paddle_tpu.quantization import quantize_weight, quantized_linear
+
+    w = _arr((256, 128), scale=0.1)
+    wq, ws = quantize_weight(paddle.to_tensor(np.asarray(w)))
+    x = np.asarray(_arr((4, 256)), np.float32)
+    xscale = np.float32(0.05)
+    out = quantized_linear(paddle.to_tensor(x), paddle.to_tensor(wq),
+                           paddle.to_tensor(ws),
+                           paddle.to_tensor(xscale))
+    xq = np.clip(np.round(x / xscale), -127, 127).astype(np.int8)
+    acc = xq.astype(np.int32) @ np.asarray(wq, np.int32)
+    ref = acc.astype(np.float32) * (xscale * np.asarray(ws))
+    np.testing.assert_allclose(np.asarray(out._data), ref,
+                               atol=1e-4, rtol=1e-5)
+
+
+def test_int8_gpt_decode_matches_fp_argmax():
+    from paddle_tpu.models import gpt_init, gpt_tiny
+    from paddle_tpu.models.gpt import (gpt_decode_step, gpt_prefill,
+                                       quantize_gpt_weights)
+
+    cfg = gpt_tiny(dtype=jnp.float32)
+    params = gpt_init(cfg, seed=0)
+    qparams = quantize_gpt_weights(params)
+    tok = jnp.asarray(RNG.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    logits, (ke, ve) = gpt_prefill(cfg, params, tok)
+    B, L, nh, hd = 2, cfg.n_layers, cfg.n_heads, cfg.head_dim
+    k = jnp.zeros((B, L, nh, 64, hd), cfg.dtype).at[:, :, :, :32].set(ke)
+    v = jnp.zeros((B, L, nh, 64, hd), cfg.dtype).at[:, :, :, :32].set(ve)
+    pos = jnp.full((B,), 32, jnp.int32)
+    nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    lg_fp, _ = gpt_decode_step(cfg, params, (k, v), pos, nxt)
+    lg_q, _ = gpt_decode_step(cfg, qparams, (k, v), pos, nxt)
+    assert np.array_equal(np.argmax(np.asarray(lg_fp), -1),
+                          np.argmax(np.asarray(lg_q), -1))
+
+
+# -- flash-attention block pickers: edge shapes ------------------------------
+
+def test_auto_block_edge_shapes():
+    # power-of-two divisor <= cap when one exists, else the sequence
+    assert _auto_block(2048) == 2048
+    assert _auto_block(4096) == 2048
+    assert _auto_block(1536) == 512
+    assert _auto_block(640) == 128
+    assert _auto_block(384) == 128
+    assert _auto_block(100) == 100       # no divisor -> whole sequence
+    assert _auto_block(96) == 96
+    for s in (128, 256, 384, 640, 896, 1024, 1536, 2048, 4096):
+        b = _auto_block(s)
+        assert s % b == 0 and b <= 2048
+
+
+def test_pick_block_b_edge_shapes():
+    budget = 8 * 1024 * 1024
+    for bh in (1, 2, 3, 6, 8, 48, 96, 128):
+        for bq, bk in ((128, 128), (512, 1024), (2048, 2048)):
+            bb = _pick_block_b(bh, bq, bk)
+            assert bh % bb == 0, (bh, bq, bk, bb)
+            assert bb == 1 or bb * bq * bk * 4 <= budget
+    # tiny batch*heads: never exceeds bh
+    assert _pick_block_b(1, 128, 128) == 1
+    assert _pick_block_b(2, 128, 128) == 2
+    # big score blocks force bb down to the budget
+    assert _pick_block_b(16, 2048, 2048) == 1
+
+
+@pytest.mark.parametrize("b,h,s", [(1, 1, 256), (1, 2, 320), (2, 1, 640)])
+def test_flash_non_pow2_and_tiny_bh(b, h, s):
+    rng = np.random.default_rng(s)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, s, 64)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention_arrays(q, k, v, causal=True, block_q=128,
+                                 block_k=128, interpret=True)
+    ref = _attention_reference(q, k, v, True, 1.0 / math.sqrt(64))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_tree_updates_match_pure():
+    # the in-jit drop-ins (DistributedTrainStep's update_fn when
+    # FLAGS_fused_optimizer is on) vs the unfused tree_map math
+    from paddle_tpu.ops.fused_optimizer import (fused_adamw_update,
+                                                fused_lamb_update)
+    from paddle_tpu.parallel.train_step import (pure_adamw_init,
+                                                pure_adamw_update,
+                                                pure_lamb_init,
+                                                pure_lamb_update)
+
+    params = {"a": _arr((33, 7), seed=1),
+              "b": {"c": _arr((128,), seed=2), "d": _arr((5,), seed=3)}}
+    mask = {"a": True, "b": {"c": False, "d": True}}
+    for pure_init, pure_upd, fused_upd, tol in (
+            (pure_adamw_init, pure_adamw_update, fused_adamw_update, 1e-6),
+            (pure_lamb_init, pure_lamb_update, fused_lamb_update, 1e-6)):
+        sp = pure_init(params)
+        sf = pure_init(params)
+        pp = pf = params
+        for i in range(3):
+            grads = jax.tree_util.tree_map(
+                lambda x: _arr(x.shape, seed=10 + i), params)
+            pp, sp = pure_upd(pp, grads, sp, 1e-3, weight_decay=0.01,
+                              decay_mask=mask)
+            pf, sf = fused_upd(pf, grads, sf, 1e-3, weight_decay=0.01,
+                               decay_mask=mask)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=tol, rtol=1e-5),
+            pp, pf)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=tol, rtol=1e-5),
+            sp["m"], sf["m"])
+
+
+def test_new_flags_default_off():
+    from paddle_tpu.core import native
+
+    assert native.fused_optimizer[0] is False
+    assert native.fused_kernels[0] is False
+    assert native.overlap_grads[0] is False
+    paddle.set_flags({"FLAGS_fused_optimizer": 1,
+                      "FLAGS_fused_kernels": 1,
+                      "FLAGS_overlap_grads": 1})
+    try:
+        assert native.fused_optimizer[0] and native.fused_kernels[0] \
+            and native.overlap_grads[0]
+    finally:
+        paddle.set_flags({"FLAGS_fused_optimizer": 0,
+                          "FLAGS_fused_kernels": 0,
+                          "FLAGS_overlap_grads": 0})

@@ -1,0 +1,287 @@
+"""Fused optimizer + overlapped gradient collectives (ISSUE 6).
+
+Pins: fused AdamW/LAMB trajectories match unfused to fp32 tolerance over
+>=50 eager steps (the acceptance criterion), the TrainStep and
+DistributedTrainStep fused paths match their unfused compiled
+counterparts, FLAGS_overlap_grads reproduces the GSPMD grads on the
+8-device virtual mesh, measure_overlap emits the spans
+tools/trace_report.py turns into a comm-vs-compute verdict, and
+multi_precision=True finally yields fp32 master moments.
+"""
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.models import gpt_init, gpt_loss, gpt_tiny
+from paddle_tpu.parallel.mesh import create_mesh, set_mesh
+from paddle_tpu.parallel.train_step import DistributedTrainStep
+
+
+@pytest.fixture(autouse=True)
+def _flags_off():
+    yield
+    paddle.set_flags({"FLAGS_fused_optimizer": 0,
+                      "FLAGS_overlap_grads": 0,
+                      "FLAGS_fused_kernels": 0})
+    set_mesh(None)
+
+
+def _train_eager(opt_cls, fused, steps=50, **opt_kw):
+    paddle.seed(0)
+    paddle.set_flags({"FLAGS_fused_optimizer": int(fused)})
+    lin1 = paddle.nn.Linear(16, 32)
+    lin2 = paddle.nn.Linear(32, 4)
+    params = list(lin1.parameters()) + list(lin2.parameters())
+    opt = opt_cls(learning_rate=1e-2, parameters=params, **opt_kw)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 4, (8,)).astype("int64"))
+    for _ in range(steps):
+        loss = paddle.nn.functional.cross_entropy(
+            lin2(paddle.nn.functional.relu(lin1(x))), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    paddle.set_flags({"FLAGS_fused_optimizer": 0})
+    return [np.asarray(p._data) for p in params], opt
+
+
+class TestEagerFused:
+    def test_adamw_50_step_trajectory(self):
+        pu, _ = _train_eager(paddle.optimizer.AdamW, False,
+                             weight_decay=0.01)
+        before = paddle.monitor.stat_get("fused_optimizer_steps")
+        pf, _ = _train_eager(paddle.optimizer.AdamW, True,
+                             weight_decay=0.01)
+        assert paddle.monitor.stat_get("fused_optimizer_steps") \
+            - before == 50
+        for a, b in zip(pu, pf):
+            np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-5)
+
+    def test_adam_l2_regularizer_bucket(self):
+        pu, _ = _train_eager(paddle.optimizer.Adam, False,
+                             weight_decay=0.02)
+        pf, _ = _train_eager(paddle.optimizer.Adam, True,
+                             weight_decay=0.02)
+        for a, b in zip(pu, pf):
+            np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-5)
+
+    def test_lamb_trajectory(self):
+        pu, _ = _train_eager(paddle.optimizer.Lamb, False, steps=30)
+        pf, _ = _train_eager(paddle.optimizer.Lamb, True, steps=30)
+        for a, b in zip(pu, pf):
+            np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-4)
+
+    def test_state_dict_synced_after_fused_steps(self):
+        _, ou = _train_eager(paddle.optimizer.AdamW, False, steps=10,
+                             weight_decay=0.01)
+        _, of = _train_eager(paddle.optimizer.AdamW, True, steps=10,
+                             weight_decay=0.01)
+        # state_dict() triggers the lazy flat-buffer -> slot-mirror sync
+        assert len(of.state_dict()) == len(ou.state_dict())
+        # (layer name counters are global, so compare slots by position)
+        for pu, pf in zip(ou._parameter_list, of._parameter_list):
+            for a, b in zip(ou._get_slots(pu), of._get_slots(pf)):
+                np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                           atol=1e-5, rtol=1e-4)
+
+    def test_sgd_falls_through_to_unfused(self):
+        # unsupported optimizer: the flag must be a no-op, not an error
+        pu, _ = _train_eager(paddle.optimizer.SGD, False, steps=5)
+        pf, _ = _train_eager(paddle.optimizer.SGD, True, steps=5)
+        for a, b in zip(pu, pf):
+            np.testing.assert_array_equal(b, a)
+
+
+class TestMultiPrecision:
+    def test_fp32_master_moments_for_bf16_params(self):
+        # regression: bf16 params used to get bf16 moments with
+        # multi_precision=True silently ignored
+        lin = paddle.nn.Linear(8, 8)
+        lin.weight._data = lin.weight._data.astype(jnp.bfloat16)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=[lin.weight],
+                                    multi_precision=True)
+        m1, m2, b1p, b2p = opt._get_slots(lin.weight)
+        assert m1.dtype == jnp.float32
+        assert m2.dtype == jnp.float32
+        # default (multi_precision=False) keeps the historical layout
+        opt2 = paddle.optimizer.Adam(learning_rate=1e-3,
+                                     parameters=[lin.weight])
+        assert opt2._get_slots(lin.weight)[0].dtype == jnp.bfloat16
+
+    def test_fp32_params_unchanged(self):
+        lin = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=[lin.weight],
+                                    multi_precision=True)
+        assert opt._get_slots(lin.weight)[0].dtype == jnp.float32
+
+    def test_multi_precision_moments_accumulate_in_fp32(self):
+        # regression: with multi_precision=True the first moment must be
+        # the EXACT fp32 EMA of the (bf16-cast) grads; bf16 moments
+        # visibly round it away
+        from paddle_tpu.framework.core import Parameter, Tensor
+
+        rng = np.random.default_rng(0)
+        w0 = rng.normal(size=(64,)).astype(np.float32)
+        grads = [rng.normal(size=(64,)).astype(np.float32)
+                 for _ in range(30)]
+
+        def run(mp_):
+            p = Parameter(jnp.asarray(w0, jnp.bfloat16))
+            opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                        parameters=[p],
+                                        multi_precision=mp_)
+            for g in grads:
+                p.grad = Tensor(jnp.asarray(g, jnp.bfloat16))
+                opt.step()
+            return np.asarray(opt._get_slots(p)[0], np.float32)
+
+        # simulate the fp32 EMA with a JITTED step (XLA fuses the bf16
+        # (1-b1)*g intermediate into f32, so an eager sim differs at
+        # bf16 eps); the regression signal is that fp32-STORED moments
+        # track it closely while bf16-stored moments visibly round away
+        sim = jax.jit(lambda m, g: 0.9 * m + (1 - 0.9) * g)
+        m = jnp.zeros(64, jnp.float32)
+        for g in grads:
+            m = sim(m, jnp.asarray(g, jnp.bfloat16))
+        expect = np.asarray(m)
+        m_mp = run(True)
+        m_lp = run(False)
+        np.testing.assert_allclose(m_mp, expect, atol=1e-3, rtol=1e-2)
+        assert np.abs(m_mp - expect).max() < np.abs(m_lp - expect).max()
+
+
+class TestTrainStepFused:
+    def _run(self, fused, steps=20):
+        from paddle_tpu.jit import TrainStep
+
+        paddle.seed(0)
+        paddle.set_flags({"FLAGS_fused_optimizer": int(fused)})
+        model = paddle.nn.Sequential(paddle.nn.Linear(16, 32),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(32, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters(),
+                                     weight_decay=0.01)
+
+        def loss_fn(run_model, x, y):
+            return paddle.nn.functional.cross_entropy(run_model(x), y)
+
+        step = TrainStep(model, loss_fn, opt)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 4, (8,)).astype("int64"))
+        for _ in range(steps):
+            loss = step(x, y)
+        lv = float(loss._data)
+        paddle.set_flags({"FLAGS_fused_optimizer": 0})
+        return ({k: np.asarray(p._data)
+                 for k, p in model.named_parameters()}, lv)
+
+    def test_compiled_fused_matches_unfused(self):
+        pu, lu = self._run(False)
+        pf, lf = self._run(True)
+        assert abs(lu - lf) < 1e-4
+        for k in pu:
+            np.testing.assert_allclose(pf[k], pu[k], atol=1e-5,
+                                       rtol=1e-4, err_msg=k)
+
+
+CFG = gpt_tiny(dtype=jnp.float32)
+RNG = np.random.default_rng(0)
+TOKENS = jnp.asarray(RNG.integers(0, CFG.vocab_size, (8, CFG.seq_len)),
+                     jnp.int32)
+LABELS = jnp.asarray(RNG.integers(0, CFG.vocab_size, (8, CFG.seq_len)),
+                     jnp.int32)
+
+
+def _run_dist(fused=0, overlap=0, steps=5):
+    paddle.set_flags({"FLAGS_fused_optimizer": fused,
+                      "FLAGS_overlap_grads": overlap})
+    create_mesh(dp=8, sharding=1, pp=1, mp=1)
+    params = gpt_init(CFG, seed=0)
+    specs = jax.tree_util.tree_map(lambda _: P(), params)
+    st = DistributedTrainStep(lambda p, b: gpt_loss(CFG, p, b), params,
+                              specs, optimizer="adamw", lr=1e-3,
+                              zero=False)
+    losses = [float(st((TOKENS, LABELS))) for _ in range(steps)]
+    out = jax.tree_util.tree_map(np.asarray, st.params)
+    paddle.set_flags({"FLAGS_fused_optimizer": 0,
+                      "FLAGS_overlap_grads": 0})
+    set_mesh(None)
+    return losses, out, st
+
+
+class TestDistributedFusedAndOverlap:
+    def test_fused_and_overlap_match_gspmd(self):
+        l0, p0, _ = _run_dist(0, 0)
+        before = paddle.monitor.stat_get("grad_overlap_buckets")
+        l1, p1, _ = _run_dist(1, 0)
+        l2, p2, st = _run_dist(0, 1)
+        assert st._overlap_axes is not None
+        assert paddle.monitor.stat_get("grad_overlap_buckets") > before
+        for la, lb in zip(l0, l1):
+            assert abs(la - lb) < 1e-3
+        for la, lb in zip(l0, l2):
+            assert abs(la - lb) < 1e-3
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(b, a, atol=1e-4,
+                                                    rtol=1e-3), p0, p1)
+        # the overlap path re-orders the cross-device reduction, so its
+        # fp32 drift over 5 adam steps is larger than the fused path's
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(b, a, atol=2e-3,
+                                                    rtol=3e-2), p0, p2)
+
+    def test_overlap_requires_replicated_params(self):
+        # model-sharded specs keep the GSPMD path even with the flag on
+        paddle.set_flags({"FLAGS_overlap_grads": 1})
+        create_mesh(dp=4, sharding=1, pp=1, mp=2)
+        params = gpt_init(CFG, seed=0)
+        from paddle_tpu.models import gpt_param_specs
+
+        st = DistributedTrainStep(lambda p, b: gpt_loss(CFG, p, b),
+                                  params, gpt_param_specs(CFG),
+                                  optimizer="adamw", lr=1e-3, zero=False)
+        assert st._overlap_axes is None
+        paddle.set_flags({"FLAGS_overlap_grads": 0})
+        set_mesh(None)
+
+    def test_measure_overlap_spans_and_report(self):
+        from paddle_tpu.monitor.trace import start_tracing, stop_tracing
+        from tools.trace_report import aggregate, overlap_report
+
+        paddle.set_flags({"FLAGS_overlap_grads": 1})
+        create_mesh(dp=8, sharding=1, pp=1, mp=1)
+        params = gpt_init(CFG, seed=0)
+        specs = jax.tree_util.tree_map(lambda _: P(), params)
+        st = DistributedTrainStep(lambda p, b: gpt_loss(CFG, p, b),
+                                  params, specs, optimizer="adamw",
+                                  lr=1e-3, zero=False)
+        w = start_tracing()
+        rep = st.measure_overlap((TOKENS, LABELS), reps=1)
+        stop_tracing()
+        assert rep["step_ms"] > 0 and rep["comm_ms"] >= 0
+        assert "hidden_frac" in rep
+        names = {e["name"] for e in w.events()}
+        assert {"overlap.step", "overlap.compute",
+                "overlap.comm"} <= names
+        rows = aggregate(w.events())
+        buf = io.StringIO()
+        out = overlap_report(rows, file=buf)
+        assert "verdict" in out
+        assert "Comm/compute overlap" in buf.getvalue()
+        paddle.set_flags({"FLAGS_overlap_grads": 0})
+        set_mesh(None)
+
+    def test_overlap_report_empty_without_spans(self):
+        from tools.trace_report import overlap_report
+
+        assert overlap_report([]) == {}

@@ -99,6 +99,44 @@ def input_pipeline_report(rows: list, file=None) -> dict:
     return out
 
 
+def overlap_report(rows: list, file=None) -> dict:
+    """Comm-vs-compute overlap verdict from the overlap spans (ISSUE 6).
+
+    ``DistributedTrainStep.measure_overlap`` emits ``overlap.step`` (full
+    loss+grads including the dp all-reduce), ``overlap.compute``
+    (backward compute only) and ``overlap.comm`` (the grad all-reduce
+    alone). The share of comm hidden inside the step —
+    ``(compute + comm - step) / comm`` — answers whether the gradient
+    all-reduce overlaps the backward (FLAGS_overlap_grads working) or
+    serializes after it, mirroring the input-vs-compute verdict."""
+    def total(name):
+        return sum(r["total_us"] for r in rows if r["name"] == name)
+
+    step = total("overlap.step")
+    compute = total("overlap.compute")
+    comm = total("overlap.comm")
+    if step == 0 and comm == 0:
+        return {}
+    out = {"step_ms": step / 1e3, "compute_ms": compute / 1e3,
+           "comm_ms": comm / 1e3}
+    if comm > 0:
+        hidden = max(0.0, min(1.0, (compute + comm - step) / comm))
+        out["hidden_comm_frac"] = hidden
+        out["verdict"] = (
+            "overlapped: the gradient all-reduce is mostly hidden behind "
+            "backward compute" if hidden >= 0.5 else
+            "serialized: the gradient all-reduce adds mostly un-hidden "
+            "time after the backward — enable FLAGS_overlap_grads / "
+            "check bucket sizes")
+    print("\nComm/compute overlap:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<22}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def serving_report(rows: list, file=None) -> dict:
     """Prefill-vs-decode verdict from the serving spans (ISSUE 4).
 
@@ -224,6 +262,7 @@ def main(argv=None):
     rows = aggregate(events)
     report(rows, args.top)
     input_pipeline_report(rows)
+    overlap_report(rows)
     serving_report(rows)
     resilience_report(events, rows)
     return rows
