@@ -157,6 +157,15 @@ fused_kernels = [_truthy(os.environ.get("FLAGS_fused_kernels", "0"))]
 # topologies keep the GSPMD path.
 overlap_grads = [_truthy(os.environ.get("FLAGS_overlap_grads", "0"))]
 
+# Fast-path mirror of FLAGS_paged_kv (ISSUE 7): the serving engine's
+# paged KV cache — a block pool (n_blocks, layers, heads, block_size,
+# head_dim) with per-slot block tables instead of one contiguous
+# max_len buffer per slot, chunked prefill interleaved with decode
+# ticks, and the Pallas paged-attention decode kernel
+# (ops/paged_attention.py) on TPU. Default OFF; the PR-4 fixed-slot
+# path is pinned bit-for-bit while unset.
+paged_kv = [_truthy(os.environ.get("FLAGS_paged_kv", "0"))]
+
 # FLAGS_fault_inject (ISSUE 5): deterministic fault-injection spec string
 # (e.g. "nan_grad@step=50:repeat=3,crash@step=120"); empty = no faults.
 # The resilience.faults registry registers a watcher here so set_flags
@@ -184,6 +193,8 @@ def set_flag(name: str, value) -> None:
         fused_kernels[0] = _truthy(value)
     elif name.endswith("overlap_grads"):
         overlap_grads[0] = _truthy(value)
+    elif name.endswith("paged_kv"):
+        paged_kv[0] = _truthy(value)
     elif name.endswith("fault_inject"):
         fault_inject[0] = str(value)
         for watcher in fault_inject_watchers:

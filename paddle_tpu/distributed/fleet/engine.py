@@ -480,25 +480,25 @@ class FleetEngine:
                     by_key[key][s] = p
                 asp_masks = {}
                 for key, stage_of in by_key.items():
-                    if not any(id(p) in ASPHelper._masks
+                    if not any(ASPHelper.mask_for(p) is not None
                                for p in stage_of.values()):
                         continue
                     rows = []
                     for s in range(params[key].shape[0]):
                         p = stage_of.get(s)
-                        m = (ASPHelper._masks.get(id(p))
+                        m = (ASPHelper.mask_for(p)
                              if p is not None else None)
                         rows.append(m if m is not None else
                                     jnp.ones(params[key].shape[1:],
                                              params[key].dtype))
                     asp_masks[key] = jnp.stack(rows)
                 for key, p in getattr(self, "_pp_outer", {}).items():
-                    if id(p) in ASPHelper._masks:
-                        asp_masks[key] = ASPHelper._masks[id(p)]
+                    m = ASPHelper.mask_for(p)
+                    if m is not None:
+                        asp_masks[key] = m
             else:
-                asp_masks = {k: ASPHelper._masks[id(p)]
-                             for k, p in self._param_objs.items()
-                             if id(p) in ASPHelper._masks}
+                asp_masks = {k: m for k, p in self._param_objs.items()
+                             if (m := ASPHelper.mask_for(p)) is not None}
             if not asp_masks:
                 warnings.warn(
                     "strategy.asp=True but no ASP masks found — call "

@@ -13,7 +13,8 @@ ASPHelper._insert_sparse_mask_ops.
 """
 from __future__ import annotations
 
-from typing import Dict, List
+import weakref
+from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -58,7 +59,12 @@ def _prunable(model: Layer):
 
 
 class ASPHelper:
-    _masks: Dict[int, jnp.ndarray] = {}
+    # id(param) -> (weakref to the param, mask). The weakref does double
+    # duty: its callback drops the entry when the param dies, and lookups
+    # validate identity — a raw id() key alone can ALIAS a dead param's
+    # recycled id to an unrelated new parameter (CPython reuses ids), which
+    # would silently mask a never-pruned weight.
+    _masks: Dict[int, Tuple[weakref.ref, jnp.ndarray]] = {}
 
     @classmethod
     def prune_model(cls, model: Layer, n=2, m=4):
@@ -68,14 +74,25 @@ class ASPHelper:
         for name, p in _prunable(model):
             mask = calculate_mask(p, n, m)
             p._data = p._data * mask
-            cls._masks[id(p)] = mask
+            key = id(p)
+            cls._masks[key] = (
+                weakref.ref(p, lambda _, k=key: cls._masks.pop(k, None)),
+                mask)
             pruned.append(name)
         return pruned
 
     @classmethod
+    def mask_for(cls, p):
+        """The mask pruned onto THIS parameter object, else None."""
+        entry = cls._masks.get(id(p))
+        if entry is not None and entry[0]() is p:
+            return entry[1]
+        return None
+
+    @classmethod
     def reapply(cls, params):
         for p in params:
-            mask = cls._masks.get(id(p))
+            mask = cls.mask_for(p)
             if mask is not None:
                 p._data = p._data * mask
 

@@ -14,7 +14,9 @@ from .gpt import (
     gpt_loss,
     gpt_param_specs,
     gpt_prefill,
+    gpt_prefill_chunk,
     gpt_decode_step,
+    gpt_decode_step_paged,
     gpt_tiny,
     gpt_small,
     gpt_1p3b,
@@ -23,6 +25,7 @@ from .gpt import (
 
 __all__ = [
     "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
-    "gpt_prefill", "gpt_decode_step",
+    "gpt_prefill", "gpt_prefill_chunk",
+    "gpt_decode_step", "gpt_decode_step_paged",
     "gpt_tiny", "gpt_small", "gpt_1p3b", "bert_base_config",
 ]

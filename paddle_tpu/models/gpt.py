@@ -38,6 +38,7 @@ from ..ops.flash_attention import NEG_INF, _attention_reference, _on_tpu
 __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
            "gpt_param_specs", "gpt_tiny", "gpt_small", "gpt_1p3b",
            "bert_base_config", "gpt_prefill", "gpt_decode_step",
+           "gpt_decode_step_paged", "gpt_prefill_chunk",
            "quantize_gpt_weights"]
 
 
@@ -514,3 +515,173 @@ def gpt_decode_step(cfg: GPTConfig, params, cache, positions, tokens):
     (x, k_cache, v_cache), _ = jax.lax.scan(
         step, (x, k_cache, v_cache), (params["blocks"], jnp.arange(L)))
     return _head(cfg, params, x)[:, 0], (k_cache, v_cache)
+
+
+# --------------------------------------------------------------------------
+# Paged KV cache variants (serving.PagedKVCache, ISSUE 7)
+# --------------------------------------------------------------------------
+#
+# Same contract as gpt_prefill/gpt_decode_step, but the cache is a shared
+# BLOCK POOL (n_blocks, L, nh, block_size, hd) addressed through per-slot
+# block tables instead of one contiguous max_len strip per slot, so cache
+# memory is proportional to live tokens. Pool block 0 is reserved as the
+# garbage sink: table padding (and whole tables of unoccupied slots)
+# point at it, so stale batch lanes scatter their garbage K/V somewhere
+# no live slot ever reads.
+
+def _block_decode_paged(cfg: GPTConfig, p, x, kb_l, vb_l, tables, positions):
+    """One-token block step against one layer's slice of the block pool.
+
+    x (B, 1, H); kb_l/vb_l (n_blocks, nh, block_size, hd); tables (B, W)
+    int32; positions (B,) int32 — where each slot's incoming token
+    lands. Attention routes through ops.paged_attention (Pallas kernel
+    on TPU, identical composed gather elsewhere)."""
+    B = x.shape[0]
+    nh, hd = cfg.n_heads, cfg.head_dim
+    bs = kb_l.shape[2]
+    cd = cfg.dtype
+
+    h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
+    qkv = _dec_mm(h, p["qkv_w"], cd) + p["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)         # each (B, 1, H)
+    to_heads = lambda t: t.reshape(B, nh, hd)
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+
+    # scatter each slot's new K/V into (its block, its offset); slots own
+    # their blocks exclusively so the only index collisions are stale
+    # lanes colliding on garbage block 0
+    blk = jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
+    off = positions % bs
+    kb_l = kb_l.at[blk, :, off, :].set(k.astype(kb_l.dtype))
+    vb_l = vb_l.at[blk, :, off, :].set(v.astype(vb_l.dtype))
+
+    from ..ops.paged_attention import paged_attention_arrays
+    o = paged_attention_arrays(q, kb_l, vb_l, tables, positions + 1,
+                               scale=1.0 / math.sqrt(hd))
+    o = o.reshape(B, 1, nh * hd)
+
+    x = x + _dec_mm(o, p["proj_w"], cd) + p["proj_b"].astype(cd)
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(_dec_mm(h, p["fc_w"], cd) + p["fc_b"].astype(cd))
+    x = x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
+    return x, kb_l, vb_l
+
+
+def gpt_decode_step_paged(cfg: GPTConfig, params, pool, tables, positions,
+                          tokens):
+    """Batched one-token decode against a paged block pool.
+
+    pool = (kb, vb), each (n_blocks, L, nh, block_size, hd); tables
+    (B, W) int32 per-slot block tables (padding/stale rows point at
+    reserved block 0); positions/tokens (B,) int32. Returns
+    (logits (B, V) fp32, new pool) with the new tokens' K/V written at
+    block ``tables[b, positions[b] // block_size]``, offset
+    ``positions[b] % block_size``. Numerics match gpt_decode_step over
+    the same live positions."""
+    kb, vb = pool
+    cd = cfg.dtype
+    L = kb.shape[1]
+    x = (params["wte"].astype(cd)[tokens]
+         + params["wpe"].astype(cd)[positions])[:, None, :]   # (B, 1, H)
+
+    def step(carry, inp):
+        x, kb, vb = carry
+        layer_p, li = inp
+        kb_l = jnp.take(kb, li, axis=1)
+        vb_l = jnp.take(vb, li, axis=1)
+        x, kb_l, vb_l = _block_decode_paged(cfg, layer_p, x, kb_l, vb_l,
+                                            tables, positions)
+        kb = jax.lax.dynamic_update_index_in_dim(kb, kb_l, li, 1)
+        vb = jax.lax.dynamic_update_index_in_dim(vb, vb_l, li, 1)
+        return (x, kb, vb), None
+
+    (x, kb, vb), _ = jax.lax.scan(
+        step, (x, kb, vb), (params["blocks"], jnp.arange(L)))
+    return _head(cfg, params, x)[:, 0], (kb, vb)
+
+
+def _block_chunk(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):
+    """One transformer block over one prefill CHUNK against the pool.
+
+    x (1, C, H) — C is the block_size-padded chunk length; kb_l/vb_l
+    (n_blocks, nh, block_size, hd); table_row (W,) int32 — this slot's
+    table; start — tokens already cached (block-aligned, traced). The
+    chunk's K/V are written into the pool FIRST, then chunk queries
+    attend over every cached position (previous chunks + the chunk
+    itself) under the global causal mask, so the math equals one whole
+    causal pass over the same prefix."""
+    _, C, H = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    bs = kb_l.shape[2]
+    cd = cfg.dtype
+    W = table_row.shape[0]
+
+    h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
+    qkv = h @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    to_heads = lambda t: t[0].reshape(C, nh, hd).transpose(1, 0, 2)
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)   # (nh, C, hd)
+
+    for j in range(C // bs):
+        bid = jnp.take(table_row, start // bs + j)
+        kb_l = jax.lax.dynamic_update_slice(
+            kb_l, k[None, :, j * bs:(j + 1) * bs].astype(kb_l.dtype),
+            (bid, 0, 0, 0))
+        vb_l = jax.lax.dynamic_update_slice(
+            vb_l, v[None, :, j * bs:(j + 1) * bs].astype(vb_l.dtype),
+            (bid, 0, 0, 0))
+
+    kg = kb_l[table_row].transpose(1, 0, 2, 3).reshape(nh, W * bs, hd)
+    vg = vb_l[table_row].transpose(1, 0, 2, 3).reshape(nh, W * bs, hd)
+    s = jnp.einsum("hqd,hkd->hqk", q, kg.astype(q.dtype)) \
+        * (1.0 / math.sqrt(hd))
+    live = jnp.arange(W * bs)[None, :] <= (start + jnp.arange(C))[:, None]
+    s = jnp.where(live[None], s, NEG_INF)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("hqk,hkd->hqd", w, vg.astype(q.dtype))
+    o = o.transpose(1, 0, 2).reshape(1, C, H)
+
+    x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
+    x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+    return x, kb_l, vb_l
+
+
+def gpt_prefill_chunk(cfg: GPTConfig, params, pool, table_row, tokens,
+                      start):
+    """One chunk of a paged, chunked prefill.
+
+    tokens (1, C) int32 — the next C prompt tokens, end-padded to a
+    multiple of block_size (one compile per padded chunk length); start
+    — tokens already cached for this slot, a block_size multiple (the
+    engine chunks at prefill_chunk % block_size == 0 boundaries);
+    table_row (W,) int32 must already cover positions < start + C.
+    Returns (logits (1, C, V) fp32, updated pool): logits at position i
+    equal gpt_prefill's at global position start + i, because every
+    chunk attends over the full cached prefix (padded tail positions
+    produce garbage nobody reads — decode overwrites them before ever
+    attending)."""
+    kb, vb = pool
+    cd = cfg.dtype
+    C = tokens.shape[1]
+    L = kb.shape[1]
+
+    pos_emb = jax.lax.dynamic_slice(
+        params["wpe"], (start, 0), (C, params["wpe"].shape[1]))
+    x = params["wte"].astype(cd)[tokens] + pos_emb.astype(cd)[None]
+
+    def step(carry, inp):
+        x, kb, vb = carry
+        layer_p, li = inp
+        kb_l = jnp.take(kb, li, axis=1)
+        vb_l = jnp.take(vb, li, axis=1)
+        x, kb_l, vb_l = _block_chunk(cfg, layer_p, x, kb_l, vb_l, table_row,
+                                     start)
+        kb = jax.lax.dynamic_update_index_in_dim(kb, kb_l, li, 1)
+        vb = jax.lax.dynamic_update_index_in_dim(vb, vb_l, li, 1)
+        return (x, kb, vb), None
+
+    (x, kb, vb), _ = jax.lax.scan(
+        step, (x, kb, vb), (params["blocks"], jnp.arange(L)))
+    return _head(cfg, params, x), (kb, vb)

@@ -164,6 +164,11 @@ DEFAULT_STATS = (
     "serving_decode_ms",       # cumulative batched decode-tick wall time (ms)
     "serving_tokens_per_s",    # gauge: recent generation rate (tokens/s)
     "serving_evictions",       # sequences evicted from slots (eos/len/deadline/cancel)
+    # paged KV cache (ISSUE 7)
+    "kv_blocks_free",          # gauge: pool blocks on the free list
+    "kv_blocks_used",          # gauge: pool blocks owned by live slots
+    "kv_fragmentation",        # gauge: % of used-block capacity holding no live token
+    "serving_preemptions",     # slots preempted back to the queue on pool exhaustion
     # self-healing training (ISSUE 5)
     "faults_injected",        # FLAGS_fault_inject faults actually fired
     "sentinel_trips",         # in-jit health verdict trips observed by the guardian
@@ -204,6 +209,10 @@ SERVING_PREFILL_MS = _registry.get_stat("serving_prefill_ms")
 SERVING_DECODE_MS = _registry.get_stat("serving_decode_ms")
 SERVING_TOKENS_PER_S = _registry.get_stat("serving_tokens_per_s")
 SERVING_EVICTIONS = _registry.get_stat("serving_evictions")
+KV_BLOCKS_FREE = _registry.get_stat("kv_blocks_free")
+KV_BLOCKS_USED = _registry.get_stat("kv_blocks_used")
+KV_FRAGMENTATION = _registry.get_stat("kv_fragmentation")
+SERVING_PREEMPTIONS = _registry.get_stat("serving_preemptions")
 FAULTS_INJECTED = _registry.get_stat("faults_injected")
 SENTINEL_TRIPS = _registry.get_stat("sentinel_trips")
 ROLLBACKS = _registry.get_stat("rollbacks")

@@ -1,0 +1,166 @@
+"""Paged-attention decode kernel — Pallas TPU, block-table gather.
+
+The serving engine's paged KV cache (ISSUE 7) keeps each layer's K/V in
+a shared block pool ``(n_blocks, n_heads, block_size, head_dim)``; a
+slot's tokens live in the blocks its block table names, in table order.
+The batched one-token decode step then needs attention of a single query
+per slot over that slot's *scattered* blocks — this module provides it:
+
+- :func:`paged_attention_arrays` — the routed entry every caller uses.
+  On TPU with tileable shapes it runs the Pallas kernel; anywhere else
+  (CPU/GPU, or untileable shapes) it runs the IDENTICAL composed jnp
+  math (gather blocks by table, mask, softmax) — the same fallback
+  contract as ops/flash_attention.py, pinned by interpret-mode parity
+  tests (tests/test_paged_attention.py, ``-m kernels``).
+
+Kernel design (mirrors the flash forward):
+- grid ``(batch, max_blocks_per_slot)``, kv-block innermost so the VMEM
+  scratch (m, l, acc) carries across one slot's block sweep;
+- the block table and per-slot lengths ride as SCALAR PREFETCH
+  (pltpu.PrefetchScalarGridSpec): the K/V BlockSpec index_map reads
+  ``tables[b, i]`` to DMA pool block ``tables[b, i]`` directly — no
+  gather materialization, HBM traffic is exactly the live blocks;
+- blocks past a slot's length are skipped with ``pl.when`` (their table
+  entries point at reserved garbage block 0, so the dead DMA is safe);
+- scores/softmax statistics in f32, accumulator f32, output cast back.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import NEG_INF, _compiler_params, _on_tpu
+
+__all__ = ["paged_attention_arrays"]
+
+
+def _paged_attention_reference(q, kb, vb, tables, lengths, scale):
+    """Composed jnp fallback: gather each slot's blocks into a contiguous
+    (nh, W*bs, hd) view, mask positions >= length, softmax in f32.
+
+    q (B, nh, hd); kb/vb (n_blocks, nh, bs, hd); tables (B, W) int32;
+    lengths (B,) int32 — live tokens per slot (including the token whose
+    K/V was just written). Returns (B, nh, hd) in q.dtype."""
+    B, nh, hd = q.shape
+    bs = kb.shape[2]
+    W = tables.shape[1]
+    k = kb[tables].transpose(0, 2, 1, 3, 4).reshape(B, nh, W * bs, hd)
+    v = vb[tables].transpose(0, 2, 1, 3, 4).reshape(B, nh, W * bs, hd)
+    s = jnp.einsum("bhd,bhkd->bhk", q, k.astype(q.dtype)) * scale
+    live = jnp.arange(W * bs)[None, :] < lengths[:, None]
+    s = jnp.where(live[:, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bhkd->bhd", w, v.astype(q.dtype))
+
+
+def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_s, l_s, acc_s, *, block_size, n_blocks, scale):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    ln = lengths_ref[b]
+
+    @pl.when(i * block_size < ln)
+    def _compute():
+        q = q_ref[0]                                   # (nh, hd)
+        k = k_ref[0]                                   # (nh, bs, hd)
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32) * scale
+        pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ln, s, NEG_INF)            # (nh, bs) f32
+        m_prev = m_s[:, 0:1]
+        l_prev = l_s[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_s[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, -1, keepdims=True), l_s.shape)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_blocks - 1)
+    def _finalize():
+        l = l_s[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _paged_decode(q, kb, vb, tables, lengths, scale, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, nh, hd = q.shape
+    bs = kb.shape[2]
+    W = tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, W),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda b, i, tbl, ln: (b, 0, 0)),
+            pl.BlockSpec((1, nh, bs, hd),
+                         lambda b, i, tbl, ln: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, nh, bs, hd),
+                         lambda b, i, tbl, ln: (tbl[b, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd), lambda b, i, tbl, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),   # running max
+            pltpu.VMEM((nh, 128), jnp.float32),   # running sum
+            pltpu.VMEM((nh, hd), jnp.float32),    # output accumulator
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_size=bs, n_blocks=W,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, hd), q.dtype),
+        compiler_params=_compiler_params(
+            pltpu, vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(tables, lengths, q, kb, vb)
+
+
+def paged_attention_arrays(q, kb, vb, tables, lengths, scale=None,
+                           interpret=None):
+    """Single-token paged attention over a block pool (routed entry).
+
+    q (B, nh, hd) — one query per slot; kb/vb (n_blocks, nh, bs, hd) —
+    one LAYER's slice of the pool; tables (B, W) int32 block tables
+    (entries past a slot's live blocks must point at a safe block, the
+    engine reserves pool block 0); lengths (B,) int32 live tokens.
+
+    Same contract as flash_attention_arrays: off-TPU (unless
+    ``interpret=True`` is forced) and on untileable shapes this returns
+    the identical composed jnp math, so callers never branch.
+    """
+    B, nh, hd = q.shape
+    bs = kb.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = False
+        if not _on_tpu():
+            return _paged_attention_reference(q, kb, vb, tables, lengths,
+                                              scale)
+    if not interpret and ((hd % 128 != 0 and hd != 64) or bs % 8 != 0
+                          or nh % 8 != 0):
+        return _paged_attention_reference(q, kb, vb, tables, lengths, scale)
+    return _paged_decode(q, kb, vb, jnp.asarray(tables, jnp.int32),
+                         jnp.asarray(lengths, jnp.int32), float(scale),
+                         interpret=bool(interpret))

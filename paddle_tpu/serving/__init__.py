@@ -1,4 +1,4 @@
-"""paddle_tpu.serving — continuous-batching inference engine (ISSUE 4).
+"""paddle_tpu.serving — continuous-batching inference engine (ISSUE 4/7).
 
 The generation-side counterpart of ``paddle_tpu.inference``: where the
 Predictor serves one compiled program per call (the reference's
@@ -8,27 +8,42 @@ Orca-style continuous batching instead of request-at-a-time.
 
 Layers:
 
-- :mod:`kv_cache` — fixed-slot donated device cache
-  ``(slots, layers, heads, max_len, head_dim)`` + host-side slot
-  accounting;
+- :mod:`kv_cache` — two cache shapes. :class:`KVCache`: fixed-slot
+  donated device buffers ``(slots, layers, heads, max_len, head_dim)``.
+  :class:`PagedKVCache` (``FLAGS_paged_kv=1``): a shared block pool
+  ``(n_blocks, layers, heads, block_size, head_dim)`` + per-slot block
+  tables and a host-side free list — slot memory proportional to LIVE
+  tokens, admission gated on free blocks instead of a fixed ``max_len``,
+  with ``kv_blocks_free`` / ``kv_blocks_used`` / ``kv_fragmentation``
+  gauges and loud ``AssertionError`` on free-list double-frees;
 - :func:`paddle_tpu.models.gpt_prefill` /
   :func:`paddle_tpu.models.gpt_decode_step` — the cache-aware forward
-  variants (they live with the model);
+  variants (they live with the model); paged mode adds
+  :func:`~paddle_tpu.models.gpt_prefill_chunk` (one prompt chunk
+  appended through the block table) and
+  :func:`~paddle_tpu.models.gpt_decode_step_paged`, whose attention is
+  the Pallas paged-attention kernel (ops/paged_attention.py) on TPU and
+  the identical composed gather elsewhere;
 - :mod:`sampling` — fused greedy/temperature/top-k/top-p with per-slot
   parameters;
 - :mod:`engine` — the scheduler: bounded queue with backpressure,
-  prefill-and-insert admission, one batched decode step per tick,
-  eviction without draining, deadlines/cancellation, graceful shutdown,
-  and the serving_* gauges + trace spans.
+  prefill-and-insert admission (paged: CHUNKED prefill, at most
+  ``prefill_chunk`` tokens per tick, interleaved with decode so long
+  prompts never stall open streams; pool-exhaustion preemption requeues
+  the youngest slot), one batched decode step per tick, eviction
+  without draining, deadlines/cancellation, graceful shutdown, and the
+  serving_* gauges + trace spans.
 
-Escape hatch: ``paddle.set_flags({"FLAGS_serving_jit": 0})`` swaps the
-jitted cache path for an un-jitted full-recompute reference decode.
+Escape hatches: ``paddle.set_flags({"FLAGS_serving_jit": 0})`` swaps the
+jitted cache path for an un-jitted full-recompute reference decode;
+``FLAGS_paged_kv=0`` (default) keeps the fixed-slot cache, pinned
+bit-identical to the pre-paging engine.
 """
 from .engine import GenerationRequest, InferenceEngine, QueueFull
-from .kv_cache import KVCache, cache_insert
+from .kv_cache import KVCache, PagedKVCache, cache_insert
 from .sampling import sample_tokens
 
 __all__ = [
     "InferenceEngine", "GenerationRequest", "QueueFull",
-    "KVCache", "cache_insert", "sample_tokens",
+    "KVCache", "PagedKVCache", "cache_insert", "sample_tokens",
 ]

@@ -26,11 +26,33 @@ nothing per token. ``FLAGS_serving_jit=0`` swaps in an un-jitted
 full-recompute reference decode (same scheduler, same sampling) as the
 numerics escape hatch.
 
+Paged mode (``FLAGS_paged_kv=1`` or ``InferenceEngine(paged=True)``,
+ISSUE 7) replaces the fixed per-slot buffers with a
+:class:`~paddle_tpu.serving.kv_cache.PagedKVCache` block pool and
+changes the tick loop in two ways:
+
+- **chunked prefill**: admission no longer runs the whole prompt in one
+  stalling pass — each tick advances every admitted-but-unprefilled
+  slot by at most ``prefill_chunk`` tokens (``serving.prefill_chunk``
+  spans), THEN runs the batched decode step, so a long prompt delays
+  open streams by one chunk's work per tick instead of its full length;
+- **block-capacity admission**: the ``prompt >= max_len`` hard reject
+  is gone — a prompt up to ``cfg.seq_len - 1`` tokens is admitted
+  whenever enough free blocks exist, and otherwise waits at the head of
+  the queue until evictions free blocks (queue-until-available
+  backpressure). If generation outruns the pool, the youngest slot is
+  preempted back to the queue (``serving_preemptions`` gauge) and later
+  resumes by re-prefilling its prompt + generated prefix — output
+  streams are unaffected.
+
 Observability: gauges serving_queue_depth / serving_slot_occupancy /
-serving_prefill_ms / serving_decode_ms / serving_tokens_per_s /
-serving_evictions, plus ``serving.prefill`` / ``serving.decode_step``
-trace spans that ``tools/trace_report.py`` turns into a prefill-vs-decode
-verdict.
+serving_prefill_ms / serving_decode_ms / serving_tokens_per_s (sliding
+window over the last N ticks) / serving_evictions /
+serving_preemptions, kv_blocks_free / kv_blocks_used /
+kv_fragmentation from the block pool, plus ``serving.prefill`` /
+``serving.prefill_chunk`` / ``serving.decode_step`` trace spans that
+``tools/trace_report.py`` turns into prefill-vs-decode and
+prefill-starvation verdicts.
 """
 from __future__ import annotations
 
@@ -44,12 +66,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import native
-from ..models.gpt import gpt_decode_step, gpt_forward, gpt_prefill
+from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
+                          gpt_forward, gpt_prefill, gpt_prefill_chunk)
 from ..monitor.stats import (SERVING_DECODE_MS, SERVING_EVICTIONS,
-                             SERVING_PREFILL_MS, SERVING_QUEUE_DEPTH,
-                             SERVING_SLOT_OCCUPANCY, SERVING_TOKENS_PER_S)
+                             SERVING_PREEMPTIONS, SERVING_PREFILL_MS,
+                             SERVING_QUEUE_DEPTH, SERVING_SLOT_OCCUPANCY,
+                             SERVING_TOKENS_PER_S)
 from ..monitor.trace import span
-from .kv_cache import KVCache, cache_insert
+from .kv_cache import KVCache, PagedKVCache, cache_insert
 from .sampling import sample_tokens
 
 __all__ = ["InferenceEngine", "GenerationRequest", "QueueFull"]
@@ -90,6 +114,9 @@ class GenerationRequest:
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self._cancelled = False
+        # paged-mode preemption: (cached-prefix tokens, last token) to
+        # re-prefill from when the request is re-admitted
+        self._resume = None
         self._cv = threading.Condition()
 
     # -- scheduler side ------------------------------------------------------
@@ -150,13 +177,17 @@ class GenerationRequest:
 class _Slot:
     """Host-side state of one occupied cache slot."""
 
-    __slots__ = ("req", "length", "last_token", "generated")
+    __slots__ = ("req", "length", "last_token", "generated", "pending",
+                 "resume_last", "admit_order")
 
     def __init__(self, req: GenerationRequest, length: int, last_token: int):
         self.req = req
         self.length = length          # tokens whose K/V are in the cache
         self.last_token = last_token  # input of the next decode step
         self.generated = 1            # prefill already streamed one token
+        self.pending = None           # paged: prompt tokens not yet prefilled
+        self.resume_last = None       # paged: last token of a preempted run
+        self.admit_order = 0          # paged: preemption picks the youngest
 
 
 class InferenceEngine:
@@ -180,12 +211,24 @@ class InferenceEngine:
     reference decode keep the fp weights, so admission numerics are
     unchanged; decode tokens are near-greedy-identical but not pinned
     bit-for-bit (weight rounding). Default off.
+
+    ``paged`` (None = follow FLAGS_paged_kv) swaps the fixed-slot cache
+    for a PagedKVCache block pool: per-slot memory proportional to live
+    tokens, admission gated on free BLOCKS instead of ``max_len``
+    (``max_len`` is ignored; the per-slot ceiling is ``cfg.seq_len``),
+    prompt prefill chunked at ``prefill_chunk`` tokens per tick and
+    interleaved with decode, and the Pallas paged-attention kernel on
+    TPU. ``block_size`` tokens per pool block; ``n_blocks`` defaults to
+    worst-case (every slot at seq_len) — size it smaller to actually
+    overcommit. Greedy output is token-identical to paged=False.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
                  max_len: Optional[int] = None, queue_size: int = 64,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 int8_weights: bool = False):
+                 int8_weights: bool = False, paged: Optional[bool] = None,
+                 block_size: int = 16, n_blocks: Optional[int] = None,
+                 prefill_chunk: int = 64, tps_window_ticks: int = 64):
         self.cfg = cfg
         self._params = jax.device_put(params)
         self.int8_weights = bool(int8_weights)
@@ -198,9 +241,26 @@ class InferenceEngine:
             INT8_MATMUL_CALLS.add()
         else:
             self._decode_params = self._params
-        self.cache = KVCache(cfg, n_slots, max_len)
+        self.paged = native.paged_kv[0] if paged is None else bool(paged)
+        if self.paged:
+            self.cache = PagedKVCache(cfg, n_slots, n_blocks=n_blocks,
+                                      block_size=block_size)
+            self.block_size = self.cache.block_size
+            self.max_len = cfg.seq_len   # positional table = per-slot cap
+            if prefill_chunk % self.block_size != 0:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} must be a multiple of "
+                    f"block_size={self.block_size} (chunks must start "
+                    "block-aligned)")
+            self.prefill_chunk = int(prefill_chunk)
+            self._decode_paged_jit = jax.jit(self._decode_paged_fn,
+                                             donate_argnums=(1, 2))
+            self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
+        else:
+            self.cache = KVCache(cfg, n_slots, max_len)
+            self.max_len = self.cache.max_len
+            self.prefill_chunk = None
         self.n_slots = self.cache.n_slots
-        self.max_len = self.cache.max_len
         self.eos_id = eos_id
         self._queue: collections.deque = collections.deque()
         self._queue_size = int(queue_size)
@@ -211,11 +271,17 @@ class InferenceEngine:
         self._error: Optional[BaseException] = None  # scheduler crash cause
         self._base_key = jax.random.key(seed)
         self._tick = 0
+        self._ticks = 0          # scheduler loop iterations (span tagging)
+        self._admit_seq = 0
         # float running totals behind the int ms gauges (prefetch.py idiom:
         # sub-ms ticks still accumulate)
         self._prefill_ms = 0.0
         self._decode_ms = 0.0
-        self._window: collections.deque = collections.deque()  # (t, n_tokens)
+        # tokens/s: sliding window over the last N tick completions, so a
+        # load spike/dip shows in trace reports instead of being averaged
+        # into the engine's lifetime
+        self._window: collections.deque = collections.deque(
+            maxlen=max(2, int(tps_window_ticks)))  # (t, n_tokens)
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1, 2))
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
         self._thread = threading.Thread(target=self._run,
@@ -242,6 +308,20 @@ class InferenceEngine:
                             top_p[None])[0]
         return tok, k, v
 
+    def _decode_paged_fn(self, params, kb, vb, tables, positions, tokens,
+                         key, temps, top_ks, top_ps):
+        logits, (kb, vb) = gpt_decode_step_paged(
+            self.cfg, params, (kb, vb), tables, positions, tokens)
+        toks = sample_tokens(logits, key, temps, top_ks, top_ps)
+        return toks, kb, vb
+
+    def _chunk_fn(self, params, kb, vb, table_row, tokens, start):
+        # one prefill chunk: writes the chunk's K/V into the pool, returns
+        # the chunk logits (only the final chunk's last live row is read)
+        logits, (kb, vb) = gpt_prefill_chunk(
+            self.cfg, params, (kb, vb), table_row, tokens, start)
+        return logits, kb, vb
+
     # -- public API ----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
@@ -260,9 +340,18 @@ class InferenceEngine:
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
         if prompt.size >= self.max_len:
+            # paged mode lifts this to the positional table (cfg.seq_len):
+            # block capacity is checked at admission, not here
             raise ValueError(
                 f"prompt length {prompt.size} leaves no room to generate "
-                f"(cache max_len={self.max_len})")
+                + (f"(positional table seq_len={self.max_len})" if self.paged
+                   else f"(cache max_len={self.max_len})"))
+        if self.paged and \
+                self.cache.blocks_for(prompt.size + 1) > self.cache.n_blocks - 1:
+            raise ValueError(
+                f"prompt length {prompt.size} can never fit the block pool "
+                f"({self.cache.n_blocks - 1} blocks x "
+                f"{self.block_size} tokens)")
         req = GenerationRequest(
             prompt, max_new_tokens, temperature, top_k, top_p,
             self.eos_id if eos_id is None else eos_id,
@@ -320,7 +409,10 @@ class InferenceEngine:
                     if not busy:
                         self._cv.wait(0.05)
                         continue
+                self._ticks += 1
                 self._admit()
+                if self.paged and native.serving_jit[0]:
+                    self._prefill_chunk_tick()
                 if any(s is not None for s in self._slots):
                     self._decode_tick()
         except BaseException as e:  # noqa: BLE001 — fail every request, not silently
@@ -368,11 +460,22 @@ class InferenceEngine:
             req._finish(ERROR, err)
 
     def _admit(self) -> None:
-        """Move queued requests into free slots (prefill-and-insert)."""
+        """Move queued requests into free slots. Fixed mode: prefill-and-
+        insert on the spot. Paged mode: capacity-check the head of the
+        queue against the FREE BLOCK pool (queue-until-available — a
+        too-long prompt waits for evictions instead of being rejected),
+        then park the prompt on the slot for the chunked-prefill tick."""
+        paged = self.paged and native.serving_jit[0]
         while self.cache.free_count > 0:
             with self._cv:
                 if not self._queue:
                     break
+                if paged:
+                    head = self._queue[0]
+                    seq = head._resume[0] if head._resume is not None \
+                        else head.prompt
+                    if not self.cache.can_admit(seq.size + 1):
+                        break   # head-of-line waits for blocks to free up
                 req = self._queue.popleft()
                 SERVING_QUEUE_DEPTH.set(len(self._queue))
                 self._cv.notify_all()   # wake submitters blocked on full
@@ -383,6 +486,18 @@ class InferenceEngine:
                 req._finish(DEADLINE)
                 continue
             slot = self.cache.alloc()
+            if paged:
+                st = _Slot(req, length=0, last_token=-1)
+                st.generated = len(req.tokens)   # nonzero on resume
+                self._admit_seq += 1
+                st.admit_order = self._admit_seq
+                if req._resume is not None:
+                    st.pending, st.resume_last = req._resume
+                    req._resume = None
+                else:
+                    st.pending = req.prompt
+                self._slots[slot] = st
+                continue
             try:
                 self._prefill(req, slot)
             except BaseException as e:  # noqa: BLE001
@@ -400,6 +515,12 @@ class InferenceEngine:
         while b < n:
             b *= 2
         return min(b, self.max_len)
+
+    def _width_bucket(self, n_blocks: int) -> int:
+        b = 1
+        while b < n_blocks:
+            b *= 2
+        return min(b, self.cache.table_width)
 
     def _next_key(self):
         key = jax.random.fold_in(self._base_key, self._tick)
@@ -440,6 +561,138 @@ class InferenceEngine:
         if reason is not None:
             self._evict(slot, reason)
 
+    # -- paged mode: chunked prefill + preemption ----------------------------
+    def _open_decode_streams(self) -> int:
+        return sum(1 for st in self._slots
+                   if st is not None and st.pending is None)
+
+    def _prefill_chunk_tick(self) -> None:
+        """Advance every mid-prefill slot by at most one prefill_chunk —
+        the decode tick follows in the same scheduler iteration, so open
+        streams never wait more than a chunk's work per tick."""
+        for slot in range(self.n_slots):
+            st = self._slots[slot]
+            if st is None or st.pending is None:
+                continue
+            if st.req._cancelled:
+                self._evict(slot, CANCELLED)
+            elif st.req.deadline is not None \
+                    and time.monotonic() > st.req.deadline:
+                self._evict(slot, DEADLINE)
+            else:
+                self._prefill_one_chunk(slot, st)
+
+    def _prefill_one_chunk(self, slot: int, st: _Slot) -> None:
+        pending = st.pending
+        c_true = min(int(pending.size), self.prefill_chunk)
+        bs = self.block_size
+        c_pad = -(-c_true // bs) * bs    # one compile per padded length
+        while not self.cache.grow(slot, st.length + c_pad):
+            # pool exhausted: preempt strictly-younger work, else wait for
+            # an eviction (the oldest slot is never preempted, so the
+            # engine always makes progress — no preemption livelock)
+            victim = self._youngest_slot(exclude=slot)
+            if victim is None \
+                    or self._slots[victim].admit_order <= st.admit_order:
+                return
+            self._preempt(victim)
+        last = c_true == pending.size
+        t0 = time.perf_counter()
+        with span("serving.prefill_chunk", cat="serving",
+                  args={"slot": slot, "start": st.length, "chunk": c_true,
+                        "tick": self._ticks,
+                        "open_streams": self._open_decode_streams()}):
+            toks = np.zeros((1, c_pad), np.int32)
+            toks[0, :c_true] = pending[:c_true]
+            row = self.cache.table_row(slot)[:self._width_bucket(
+                self.cache.blocks_for(st.length + c_pad))]
+            logits, self.cache.kb, self.cache.vb = self._chunk_jit(
+                self._params, self.cache.kb, self.cache.vb,
+                jnp.asarray(row), jnp.asarray(toks),
+                np.int32(st.length))
+        self._note_ms(SERVING_PREFILL_MS, "_prefill_ms",
+                      (time.perf_counter() - t0) * 1e3)
+        st.length += c_true
+        self.cache.lengths[slot] = st.length
+        st.pending = None if last else pending[c_true:]
+        self.cache.update_gauges()
+        if not last:
+            return
+        if st.resume_last is not None:
+            # resumed after preemption: the "next" token was already
+            # streamed before the preemption — just rebuild decode state
+            st.last_token = st.resume_last
+            st.resume_last = None
+            return
+        tok = int(sample_tokens(
+            logits[0:1, c_true - 1], self._next_key(),
+            jnp.float32(st.req.temperature)[None],
+            jnp.int32(st.req.top_k)[None],
+            jnp.float32(st.req.top_p)[None])[0])
+        st.last_token = tok
+        st.generated = 1
+        st.req._push(tok)
+        self._note_tokens(1)
+        reason = self._finish_reason(st, tok)
+        if reason is not None:
+            self._evict(slot, reason)
+
+    def _youngest_slot(self, exclude: int) -> Optional[int]:
+        best = None
+        for s, st in enumerate(self._slots):
+            if st is None or s == exclude:
+                continue
+            if best is None \
+                    or st.admit_order > self._slots[best].admit_order:
+                best = s
+        return best
+
+    def _preempt(self, slot: int) -> None:
+        """Return a slot's blocks to the pool and its request to the HEAD
+        of the queue; it resumes later by re-prefilling prompt+generated
+        (recompute preemption — tokens already streamed are unaffected)."""
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self.cache.release(slot)
+        SERVING_PREEMPTIONS.add(1)
+        if st.req.tokens:
+            # decode state: cache held prompt + tokens[:-1]; tokens[-1] is
+            # the next decode input
+            seq = np.concatenate(
+                [st.req.prompt,
+                 np.asarray(st.req.tokens[:-1], np.int32)]).astype(np.int32)
+            st.req._resume = (seq, int(st.req.tokens[-1]))
+        else:
+            st.req._resume = None       # mid-prefill: just start over
+        with self._cv:
+            self._queue.appendleft(st.req)
+            SERVING_QUEUE_DEPTH.set(len(self._queue))
+        SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
+
+    def _grow_for_decode(self, active: List[int]) -> List[int]:
+        """Ensure each decoding slot's table covers its next write
+        position, preempting the youngest slot when the pool runs dry.
+        Oldest slots get blocks first (FIFO fairness)."""
+        ready = []
+        for s in sorted(active, key=lambda s: self._slots[s].admit_order):
+            st = self._slots[s]
+            if st is None:       # preempted as a victim earlier this tick
+                continue
+            while not self.cache.grow(s, st.length + 1):
+                victim = self._youngest_slot(exclude=s)
+                if victim is None:
+                    # alone and the pool is spent: nothing will ever free
+                    # a block — cache capacity reached, same terminal
+                    # condition as the fixed engine's full slot
+                    self._evict(s, LENGTH)
+                    break
+                if self._slots[victim].admit_order <= st.admit_order:
+                    break        # only younger work is preemptible: stall
+                self._preempt(victim)
+            else:
+                ready.append(s)
+        return [s for s in ready if self._slots[s] is not None]
+
     def _decode_tick(self) -> None:
         now = time.monotonic()
         for s, st in enumerate(self._slots):
@@ -449,9 +702,15 @@ class InferenceEngine:
                 self._evict(s, CANCELLED)
             elif st.req.deadline is not None and now > st.req.deadline:
                 self._evict(s, DEADLINE)
-        active = [s for s in range(self.n_slots) if self._slots[s] is not None]
+        active = [s for s in range(self.n_slots)
+                  if self._slots[s] is not None
+                  and self._slots[s].pending is None]
         if not active:
             return
+        if self.paged and native.serving_jit[0]:
+            active = self._grow_for_decode(active)
+            if not active:
+                return
 
         positions = np.zeros(self.n_slots, np.int32)
         tokens = np.zeros(self.n_slots, np.int32)
@@ -468,12 +727,27 @@ class InferenceEngine:
 
         t0 = time.perf_counter()
         with span("serving.decode_step", cat="serving",
-                  args={"batch": len(active)}):
+                  args={"batch": len(active), "tick": self._ticks}):
             if native.serving_jit[0]:
-                out, self.cache.k, self.cache.v = self._decode_jit(
-                    self._decode_params, self.cache.k, self.cache.v,
-                    positions,
-                    tokens, self._next_key(), temps, top_ks, top_ps)
+                if self.paged:
+                    # table width bucketed to the live maximum (next pow2):
+                    # attention/gather work tracks LIVE tokens, not the
+                    # worst-case table — one compile per width bucket,
+                    # log2(table_width) programs total
+                    tables = self.cache.tables_array(active)
+                    tables = tables[:, :self._width_bucket(
+                        max(len(self.cache.block_tables[s])
+                            for s in active))]
+                    out, self.cache.kb, self.cache.vb = \
+                        self._decode_paged_jit(
+                            self._decode_params, self.cache.kb,
+                            self.cache.vb, tables, positions, tokens,
+                            self._next_key(), temps, top_ks, top_ps)
+                else:
+                    out, self.cache.k, self.cache.v = self._decode_jit(
+                        self._decode_params, self.cache.k, self.cache.v,
+                        positions,
+                        tokens, self._next_key(), temps, top_ks, top_ps)
                 out = np.asarray(out)
             else:
                 # reference decode: full recompute per sequence, no cache
@@ -504,6 +778,8 @@ class InferenceEngine:
                 self._evict(s, reason)
         self._note_tokens(len(active))
         SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
+        if self.paged:
+            self.cache.update_gauges()   # refresh kv_fragmentation vs lengths
 
     def _finish_reason(self, st: _Slot, tok: int) -> Optional[str]:
         if st.req.eos_id is not None and tok == st.req.eos_id:
@@ -530,11 +806,13 @@ class InferenceEngine:
         gauge.add(int(new) - int(old))
 
     def _note_tokens(self, n: int) -> None:
+        # sliding window over the last N tick completions (deque maxlen):
+        # the gauge tracks RECENT rate, so a load spike or an idle dip is
+        # visible in trace reports instead of being flattened into a
+        # lifetime average
         now = time.monotonic()
         self._window.append((now, n))
-        while self._window and now - self._window[0][0] > 2.0:
-            self._window.popleft()
-        total = sum(c for _, c in self._window)
         window_span = now - self._window[0][0]
-        if window_span > 0:
+        if len(self._window) >= 2 and window_span > 0:
+            total = sum(c for _, c in self._window)
             SERVING_TOKENS_PER_S.set(max(1, int(total / window_span)))

@@ -21,13 +21,16 @@ device state keeps the decode step free of host syncs.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["KVCache", "cache_insert"]
+from ..monitor.stats import (KV_BLOCKS_FREE, KV_BLOCKS_USED,
+                             KV_FRAGMENTATION)
+
+__all__ = ["KVCache", "PagedKVCache", "cache_insert"]
 
 
 def cache_insert(k_cache, v_cache, slot, k_new, v_new):
@@ -98,3 +101,176 @@ class KVCache:
     def __repr__(self):
         return (f"KVCache(slots={self.n_slots}, max_len={self.max_len}, "
                 f"occupied={self.occupancy}, {self.nbytes / 1e6:.1f}MB)")
+
+
+class PagedKVCache:
+    """Paged decode cache (FLAGS_paged_kv, ISSUE 7): a shared block pool
+    plus per-slot block tables — vLLM-style PagedAttention memory, TPU
+    shaped.
+
+    Device side: ONE pair of donated pool buffers
+
+        kb, vb : (n_blocks, n_layers, n_heads, block_size, head_dim)
+
+    Unlike :class:`KVCache`, a slot does not own a contiguous max_len
+    strip — it owns however many ``block_size``-token blocks its prompt
+    and generation have actually filled, named in order by its block
+    table. Cache memory is therefore proportional to LIVE tokens, and a
+    prompt is admissible whenever enough free blocks exist, regardless
+    of any per-slot length budget (up to ``cfg.seq_len``, the positional
+    table).
+
+    Pool block 0 is RESERVED as the garbage sink: it is never allocated,
+    table padding entries (and the all-zero tables of unoccupied batch
+    lanes) point at it, so the batched decode step's stale-lane scatter
+    writes land where no live slot ever reads.
+
+    Host side: the free list, per-slot tables and lengths — request/
+    block-granularity bookkeeping kept out of the jitted step, exactly
+    like KVCache's slot accounting. Double-frees in the block free list
+    raise ``AssertionError`` (a corrupted free list silently cross-wires
+    two requests' caches — fail loudly instead). The pool exports
+    ``kv_blocks_free`` / ``kv_blocks_used`` gauges and a
+    ``kv_fragmentation`` percentage (share of used-block capacity not
+    holding a live token) through the StatRegistry.
+    """
+
+    def __init__(self, cfg, n_slots: int, n_blocks: Optional[int] = None,
+                 block_size: int = 16, dtype=None):
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be >= 1")
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.block_size = int(block_size)
+        # widest table any slot can need: the positional table is the
+        # per-slot length ceiling
+        self.table_width = -(-cfg.seq_len // self.block_size)
+        if n_blocks is None:
+            # worst case every slot runs to seq_len, +1 for the sink
+            n_blocks = 1 + self.n_slots * self.table_width
+        self.n_blocks = int(n_blocks)
+        if self.n_blocks < 2:
+            raise ValueError(
+                f"n_blocks={self.n_blocks} must be >= 2 (block 0 is the "
+                "reserved garbage sink)")
+        self.dtype = cfg.dtype if dtype is None else dtype
+        shape = (self.n_blocks, cfg.n_layers, cfg.n_heads, self.block_size,
+                 cfg.head_dim)
+        self.kb = jnp.zeros(shape, self.dtype)
+        self.vb = jnp.zeros(shape, self.dtype)
+        self.lengths = np.zeros(self.n_slots, np.int32)
+        self.block_tables: List[List[int]] = [[] for _ in range(self.n_slots)]
+        self._free: List[int] = list(range(1, self.n_blocks))  # 0 = sink
+        self._free_set = set(self._free)
+        self._slot_free: List[int] = list(range(self.n_slots))
+        self._update_gauges()
+
+    # -- slot accounting (same surface as KVCache) ---------------------------
+    def alloc(self) -> Optional[int]:
+        if not self._slot_free:
+            return None
+        slot = self._slot_free.pop(0)
+        self.lengths[slot] = 0
+        self.block_tables[slot] = []
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot in self._slot_free:
+            raise ValueError(f"slot {slot} is already free")
+        self.free_blocks(self.block_tables[slot])
+        self.block_tables[slot] = []
+        self.lengths[slot] = 0
+        self._slot_free.append(slot)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._slot_free)
+
+    @property
+    def occupancy(self) -> int:
+        return self.n_slots - len(self._slot_free)
+
+    # -- block accounting ----------------------------------------------------
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """Enough free blocks to cache ``n_tokens``? (The admission gate —
+        replaces the fixed engine's ``prompt >= max_len`` hard reject.)"""
+        return self.blocks_for(n_tokens) <= len(self._free)
+
+    @property
+    def free_blocks_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks_count(self) -> int:
+        return self.n_blocks - 1 - len(self._free)
+
+    def grow(self, slot: int, n_tokens: int) -> bool:
+        """Extend ``slot``'s table to cover positions < n_tokens.
+        All-or-nothing: returns False (allocating nothing) when the free
+        list cannot supply every needed block."""
+        need = self.blocks_for(n_tokens)
+        table = self.block_tables[slot]
+        extra = need - len(table)
+        if extra <= 0:
+            return True
+        if extra > len(self._free):
+            return False
+        for _ in range(extra):
+            b = self._free.pop(0)
+            self._free_set.discard(b)
+            table.append(b)
+        self._update_gauges()
+        return True
+
+    def free_blocks(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b in self._free_set:
+                raise AssertionError(
+                    f"KV block {b} double-freed (free-list corruption)")
+            if not 1 <= b < self.n_blocks:
+                raise AssertionError(f"KV block {b} outside pool "
+                                     f"[1, {self.n_blocks})")
+            self._free.append(b)
+            self._free_set.add(b)
+        self._update_gauges()
+
+    def table_row(self, slot: int) -> np.ndarray:
+        """This slot's table as a fixed-width int32 row, sink-padded."""
+        row = np.zeros(self.table_width, np.int32)
+        table = self.block_tables[slot]
+        row[:len(table)] = table
+        return row
+
+    def tables_array(self, slots=None) -> np.ndarray:
+        """(n_slots, table_width) int32 for the batched decode step; rows
+        not in ``slots`` stay all-zero (= the garbage sink)."""
+        out = np.zeros((self.n_slots, self.table_width), np.int32)
+        for s in (range(self.n_slots) if slots is None else slots):
+            table = self.block_tables[s]
+            out[s, :len(table)] = table
+        return out
+
+    # -- gauges --------------------------------------------------------------
+    def _update_gauges(self) -> None:
+        used = self.used_blocks_count
+        KV_BLOCKS_FREE.set(len(self._free))
+        KV_BLOCKS_USED.set(used)
+        cap = used * self.block_size
+        live = int(self.lengths.sum())
+        KV_FRAGMENTATION.set(
+            0 if cap == 0 else int(round(100.0 * (1.0 - min(1.0, live / cap)))))
+
+    update_gauges = _update_gauges
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.kb.nbytes) + int(self.vb.nbytes)
+
+    def __repr__(self):
+        return (f"PagedKVCache(slots={self.n_slots}, "
+                f"blocks={self.n_blocks}x{self.block_size}, "
+                f"used={self.used_blocks_count}, occupied={self.occupancy}, "
+                f"{self.nbytes / 1e6:.1f}MB)")

@@ -1,0 +1,160 @@
+"""Pallas paged-attention decode kernel (ISSUE 7): interpret-mode parity
+vs the composed jnp reference, block-table gather correctness vs plain
+contiguous attention, garbage-sink/zero-length safety, fallback routing,
+and model-level agreement between the paged and contiguous decode steps.
+Registered under the ``-m kernels`` marker with the other Pallas parity
+suites."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.flash_attention import _attention_reference
+from paddle_tpu.ops.paged_attention import (_paged_attention_reference,
+                                            _paged_decode,
+                                            paged_attention_arrays)
+
+pytestmark = pytest.mark.kernels
+
+RNG = np.random.default_rng(0)
+
+
+def _pool(nb, nh, bs, hd, dtype=jnp.float32):
+    kb = jnp.asarray(RNG.normal(size=(nb, nh, bs, hd)), dtype)
+    vb = jnp.asarray(RNG.normal(size=(nb, nh, bs, hd)), dtype)
+    return kb, vb
+
+
+def _tables(rows, W):
+    out = np.zeros((len(rows), W), np.int32)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return jnp.asarray(out)
+
+
+class TestPagedReference:
+    def test_matches_contiguous_attention(self):
+        """Gathering blocks in table order must equal plain attention
+        over the contiguous K/V those blocks hold."""
+        nh, hd, bs, W = 4, 16, 8, 4
+        kb, vb = _pool(10, nh, bs, hd)
+        tables = _tables([[3, 7, 1, 9]], W)
+        length = 27
+        q = jnp.asarray(RNG.normal(size=(1, nh, hd)), jnp.float32)
+        k = kb[tables[0]].transpose(1, 0, 2, 3).reshape(nh, W * bs, hd)
+        v = vb[tables[0]].transpose(1, 0, 2, 3).reshape(nh, W * bs, hd)
+        want = _attention_reference(q[:, :, None], k[None, :, :length],
+                                    v[None, :, :length], causal=False,
+                                    scale=0.25)[:, :, 0]
+        got = _paged_attention_reference(q, kb, vb, tables,
+                                         jnp.asarray([length]), 0.25)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-6),
+                                           (jnp.bfloat16, 2e-2)])
+    def test_interpret_parity(self, dtype, tol):
+        """The kernel (interpret mode on CPU) must reproduce the composed
+        reference over mixed-depth slots and sink-padded tables."""
+        nh, hd, bs, W, nb, B = 8, 64, 16, 4, 12, 3
+        kb, vb = _pool(nb, nh, bs, hd, dtype)
+        q = jnp.asarray(RNG.normal(size=(B, nh, hd)), dtype)
+        tables = _tables([[5, 2, 9], [1, 7, 3, 11], [4]], W)
+        lengths = jnp.asarray([37, 64, 1], jnp.int32)
+        want = _paged_attention_reference(q, kb, vb, tables, lengths,
+                                          0.125)
+        got = _paged_decode(q, kb, vb, tables, lengths, 0.125,
+                            interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=tol, atol=tol)
+
+    def test_single_block_and_partial_length(self):
+        nh, hd, bs = 8, 64, 16
+        kb, vb = _pool(4, nh, bs, hd)
+        q = jnp.asarray(RNG.normal(size=(1, nh, hd)), jnp.float32)
+        tables = _tables([[2]], 1)
+        for length in (1, 7, 16):
+            want = _paged_attention_reference(
+                q, kb, vb, tables, jnp.asarray([length]), 0.125)
+            got = _paged_decode(q, kb, vb, tables,
+                                jnp.asarray([length], jnp.int32), 0.125,
+                                interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-6, atol=2e-6)
+
+    def test_zero_length_slot_is_finite(self):
+        """Unoccupied batch lanes (length 0, all-sink table) must come
+        back finite, never NaN — the engine discards them host-side."""
+        nh, hd, bs = 8, 64, 16
+        kb, vb = _pool(4, nh, bs, hd)
+        q = jnp.asarray(RNG.normal(size=(2, nh, hd)), jnp.float32)
+        tables = _tables([[], [1, 2]], 2)
+        lengths = jnp.asarray([0, 20], jnp.int32)
+        got = _paged_decode(q, kb, vb, tables, lengths, 0.125,
+                            interpret=True)
+        assert np.isfinite(np.asarray(got)).all()
+        want = _paged_attention_reference(q, kb, vb, tables, lengths, 0.125)
+        np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_entry_routes_to_reference_off_tpu(self):
+        """The routed entry must be the composed reference bit-for-bit on
+        CPU (the fallback contract every caller relies on), including
+        gpt_tiny's untileable head_dim."""
+        for nh, hd in ((8, 64), (4, 16)):
+            kb, vb = _pool(6, nh, 8, hd)
+            q = jnp.asarray(RNG.normal(size=(1, nh, hd)), jnp.float32)
+            tables = _tables([[1, 4]], 3)
+            lengths = jnp.asarray([11], jnp.int32)
+            want = _paged_attention_reference(q, kb, vb, tables, lengths,
+                                              1.0 / np.sqrt(hd))
+            got = paged_attention_arrays(q, kb, vb, tables, lengths)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestPagedDecodeStep:
+    def test_paged_decode_step_matches_contiguous(self):
+        """gpt_decode_step_paged over a chunk-prefilled block pool must
+        match gpt_decode_step over the contiguous cache, logits-exact to
+        fp tolerance."""
+        from paddle_tpu.models import (gpt_decode_step,
+                                       gpt_decode_step_paged, gpt_init,
+                                       gpt_prefill, gpt_prefill_chunk,
+                                       gpt_tiny)
+        from paddle_tpu.serving import KVCache, PagedKVCache, cache_insert
+
+        cfg = gpt_tiny(dtype=jnp.float32, seq_len=64)
+        params = gpt_init(cfg, seed=3)
+        prompt = RNG.integers(0, cfg.vocab_size, 9).astype(np.int32)
+        S = prompt.size
+
+        # contiguous: whole-prompt prefill + one decode step
+        logits, (ke, ve) = gpt_prefill(cfg, params, jnp.asarray(prompt[None]))
+        cache = KVCache(cfg, n_slots=2)
+        k, v = cache_insert(cache.k, cache.v, 0, ke[0], ve[0])
+        tok = int(jnp.argmax(logits[0, S - 1]))
+        want, _ = gpt_decode_step(
+            cfg, params, (k, v), jnp.asarray([S, 0], jnp.int32),
+            jnp.asarray([tok, 0], jnp.int32))
+
+        # paged: chunked prefill into the block pool + one paged step
+        paged = PagedKVCache(cfg, n_slots=2, block_size=8)
+        assert paged.grow(0, 16)
+        row = jnp.asarray(paged.table_row(0))
+        toks = np.zeros((1, 16), np.int32)
+        toks[0, :S] = prompt
+        lg, (kb, vb) = gpt_prefill_chunk(
+            cfg, params, (paged.kb, paged.vb), row, jnp.asarray(toks),
+            jnp.int32(0))
+        np.testing.assert_allclose(np.asarray(lg[0, :S]),
+                                   np.asarray(logits[0]),
+                                   rtol=2e-5, atol=2e-5)
+        tables = jnp.asarray(paged.tables_array([0]))
+        got, _ = gpt_decode_step_paged(
+            cfg, params, (kb, vb), tables, jnp.asarray([S, 0], jnp.int32),
+            jnp.asarray([tok, 0], jnp.int32))
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                                   rtol=2e-4, atol=2e-4)

@@ -2,7 +2,14 @@
 decode numerics vs full recompute, per-token speedup, continuous-batching
 admission, eviction (eos/max_tokens), deadline/cancellation, queue
 backpressure, the FLAGS_serving_jit=0 escape hatch, and gauge/span
-emission feeding tools/trace_report.py's serving verdict."""
+emission feeding tools/trace_report.py's serving verdict.
+
+Paged mode (ISSUE 7): FLAGS_paged_kv greedy token-identity vs the fixed
+engine, long-prompt admission past the former max_len budget, chunked
+prefill interleaving with open decode streams (no-starvation pin),
+block-pool accounting/gauges/double-free, eviction→reuse of recycled
+blocks, pool-exhaustion preemption with exact resume, and the
+queue-until-blocks-free backpressure path."""
 import importlib.util
 import os
 import time
@@ -16,8 +23,8 @@ import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.models import (gpt_decode_step, gpt_forward, gpt_init,
                                gpt_prefill, gpt_tiny)
-from paddle_tpu.serving import (InferenceEngine, KVCache, QueueFull,
-                                cache_insert, sample_tokens)
+from paddle_tpu.serving import (InferenceEngine, KVCache, PagedKVCache,
+                                QueueFull, cache_insert, sample_tokens)
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -370,6 +377,196 @@ class TestServingJitFlag:
         assert got == want == _ref_greedy(prompt, 6)
 
 
+class TestPagedKVCache:
+    def test_block_pool_accounting_gauges_and_double_free(self):
+        """Satellite: kv_blocks_free/used + kv_fragmentation gauges, and
+        a loud AssertionError on free-list double-free."""
+        cache = PagedKVCache(CFG, n_slots=2, block_size=8, n_blocks=9)
+        assert cache.free_blocks_count == 8          # block 0 = sink
+        assert monitor.stat_get("kv_blocks_used") == 0
+        s = cache.alloc()
+        assert cache.grow(s, 17)                     # 3 blocks
+        assert cache.used_blocks_count == 3
+        assert monitor.stat_get("kv_blocks_used") == 3
+        assert monitor.stat_get("kv_blocks_free") == 5
+        cache.lengths[s] = 17
+        cache.update_gauges()
+        # 3 blocks x 8 = 24 capacity, 17 live -> 29% internal fragmentation
+        assert monitor.stat_get("kv_fragmentation") == 29
+        assert 0 not in cache.block_tables[s]        # sink never allocated
+        blocks = list(cache.block_tables[s])
+        cache.release(s)
+        assert cache.free_blocks_count == 8
+        assert monitor.stat_get("kv_fragmentation") == 0
+        with pytest.raises(AssertionError):
+            cache.free_blocks(blocks[:1])            # double-free trips
+        with pytest.raises(ValueError):
+            cache.release(s)                         # slot double-free too
+        s2 = cache.alloc()
+        assert not cache.grow(s2, 8 * 9)   # needs 9 > 8 free: all-or-nothing
+        assert cache.block_tables[s2] == []
+
+    def test_table_rows_are_sink_padded(self):
+        cache = PagedKVCache(CFG, n_slots=2, block_size=8)
+        s = cache.alloc()
+        cache.grow(s, 20)
+        row = cache.table_row(s)
+        assert row.shape == (cache.table_width,)
+        assert list(row[:3]) == cache.block_tables[s]
+        assert (row[3:] == 0).all()
+        tables = cache.tables_array([s])
+        assert (tables[1 - s] == 0).all()            # inactive row -> sink
+
+
+class TestPagedEngine:
+    def _make(self, engine, **kw):
+        kw.setdefault("paged", True)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prefill_chunk", 16)
+        return engine(**kw)
+
+    def test_paged_flag_greedy_token_identity(self, engine):
+        """Acceptance: FLAGS_paged_kv=1 (chunked prefill + paged decode,
+        CPU composed fallback) greedy output token-identical to
+        flag-off."""
+        prompt = _prompt(9)
+        ref = _ref_greedy(prompt, 20)
+        fixed = engine()
+        got_fixed = fixed.submit(prompt, max_new_tokens=20).result(
+            timeout=120)
+        paddle.set_flags({"FLAGS_paged_kv": 1})
+        try:
+            paged = engine(block_size=8, prefill_chunk=16)
+            assert paged.paged
+            got_paged = paged.submit(prompt, max_new_tokens=20).result(
+                timeout=120)
+        finally:
+            paddle.set_flags({"FLAGS_paged_kv": 0})
+        assert got_fixed == ref
+        assert got_paged == ref
+
+    def test_admits_prompt_longer_than_fixed_budget(self, engine):
+        """Acceptance: paging lifts the per-slot max_len budget — a
+        prompt the fixed engine hard-rejects admits whenever free blocks
+        suffice (up to cfg.seq_len)."""
+        prompt = _prompt(40)
+        fixed = engine(max_len=32)
+        with pytest.raises(ValueError):
+            fixed.submit(prompt, max_new_tokens=4)
+        paged = self._make(engine, max_len=32)       # max_len lifted
+        got = paged.submit(prompt, max_new_tokens=6).result(timeout=120)
+        assert got == _ref_greedy(prompt, 6)
+
+    def test_chunked_prefill_interleaves_with_decode(self, engine):
+        """Acceptance: a long-prompt admission advances at most
+        prefill_chunk tokens per tick, and every tick that did chunk
+        work while a stream was open also ran a decode step — open
+        streams never wait more than one chunk's work."""
+        eng = self._make(engine, n_slots=2)
+        pa, pb = _prompt(4), _prompt(48)             # pb = 3 chunks of 16
+        writer = monitor.start_tracing()
+        try:
+            ra = eng.submit(pa, max_new_tokens=40)
+            sa = ra.stream(timeout=120)
+            for _ in range(3):                       # A is mid-decode
+                next(sa)
+            rb = eng.submit(pb, max_new_tokens=4)
+            got_b = rb.result(timeout=120)
+            got_a = ra.result(timeout=120)
+        finally:
+            monitor.stop_tracing()
+        assert got_a == _ref_greedy(pa, 40)
+        assert got_b == _ref_greedy(pb, 4)
+        evs = writer.events()
+        chunks = [e for e in evs if e["name"] == "serving.prefill_chunk"]
+        b_chunks = [e for e in chunks if e["args"]["start"] > 0]
+        assert len(b_chunks) >= 2                    # really chunked
+        assert all(e["args"]["chunk"] <= 16 for e in chunks)
+        decode_ticks = {e["args"]["tick"] for e in evs
+                        if e["name"] == "serving.decode_step"}
+        waited = [e["args"]["tick"] for e in chunks
+                  if e["args"]["open_streams"] > 0]
+        assert waited and all(t in decode_ticks for t in waited)
+
+    def test_eviction_recycles_blocks_identically(self, engine):
+        """Satellite: eviction returns every block to the pool, and a
+        queued request admitted into recycled blocks generates exactly
+        what a fresh engine would."""
+        p1, p2 = _prompt(7), _prompt(11)
+        want1, want2 = _ref_greedy(p1, 6), _ref_greedy(p2, 8)
+        eng = self._make(engine, n_slots=1, n_blocks=9)
+        r1 = eng.submit(p1, max_new_tokens=6)
+        r2 = eng.submit(p2, max_new_tokens=8)        # queued behind r1
+        assert r1.result(timeout=120) == want1
+        assert r2.result(timeout=120) == want2       # recycled blocks
+        assert eng.cache.used_blocks_count == 0
+        assert eng.cache.free_blocks_count == 8
+        assert monitor.stat_get("kv_blocks_used") == 0
+
+    def test_pool_exhaustion_preempts_and_resumes_exactly(self, engine):
+        """Two streams outgrow a 6-block pool: the youngest is preempted
+        back to the queue and resumes by re-prefilling — both outputs
+        stay token-identical to the reference."""
+        pa, pb = _prompt(9), _prompt(11)
+        ra_ref, rb_ref = _ref_greedy(pa, 20), _ref_greedy(pb, 20)
+        pre0 = monitor.stat_get("serving_preemptions")
+        eng = self._make(engine, n_slots=2, n_blocks=7)
+        ra = eng.submit(pa, max_new_tokens=20)
+        rb = eng.submit(pb, max_new_tokens=20)
+        assert ra.result(timeout=120) == ra_ref
+        assert rb.result(timeout=120) == rb_ref
+        assert monitor.stat_get("serving_preemptions") - pre0 >= 1
+
+    def test_queue_until_blocks_free(self, engine):
+        """Acceptance: the former hard reject is now backpressure — a
+        prompt that does not fit the free pool waits at the head of the
+        queue until evictions free blocks, then completes correctly."""
+        p1, p2 = _prompt(30), _prompt(30)
+        eng = self._make(engine, n_slots=2, n_blocks=7)  # one at a time
+        r1 = eng.submit(p1, max_new_tokens=10)
+        r2 = eng.submit(p2, max_new_tokens=10)
+        assert r1.result(timeout=120) == _ref_greedy(p1, 10)
+        assert r2.result(timeout=120) == _ref_greedy(p2, 10)
+
+    def test_lone_slot_pool_exhaustion_truncates(self, engine):
+        """A lone stream that outgrows the whole pool is evicted with
+        finish_reason='length' (cache capacity), not hung."""
+        p = _prompt(9)
+        eng = self._make(engine, n_slots=1, n_blocks=3)  # 16-token pool
+        r = eng.submit(p, max_new_tokens=30)
+        out = r.result(timeout=120)
+        assert r.finish_reason == "length"
+        assert out == _ref_greedy(p, len(out))
+        assert 0 < len(out) < 30
+
+    def test_reference_decode_matches_paged(self, engine):
+        prompt = _prompt(8)
+        want = _ref_greedy(prompt, 6)
+        paged = self._make(engine)
+        assert paged.submit(prompt, max_new_tokens=6).result(
+            timeout=120) == want
+        paddle.set_flags({"FLAGS_serving_jit": 0})
+        try:
+            ref_eng = self._make(engine)
+            got = ref_eng.submit(prompt, max_new_tokens=6).result(
+                timeout=120)
+        finally:
+            paddle.set_flags({"FLAGS_serving_jit": 1})
+        assert got == want
+
+    def test_tokens_per_s_window_is_tick_scoped(self, engine):
+        """Satellite: tokens/s is a sliding window over the last N ticks
+        (deque maxlen), not a lifetime average."""
+        eng = engine(tps_window_ticks=8)
+        assert eng._window.maxlen == 8
+        eng.submit(_prompt(5), max_new_tokens=12).result(timeout=120)
+        assert monitor.stat_get("serving_tokens_per_s") > 0
+        eng.shutdown(drain=True, timeout=120)
+        for _ in range(20):
+            eng._note_tokens(3)
+        assert len(eng._window) == 8                 # old ticks fell out
+
+
 class TestObservability:
     def _trace_report(self):
         spec = importlib.util.spec_from_file_location(
@@ -400,3 +597,28 @@ class TestObservability:
         assert verdict["prefills"] >= 2
         assert verdict["decode_steps"] >= 1
         assert "verdict" in verdict
+
+    def test_paged_report_learns_chunks_and_starvation(self, engine):
+        """Satellite: serving_report counts serving.prefill_chunk spans
+        and prints the prefill-starvation verdict (max consecutive ticks
+        any open stream waited without a decode step — 0 when chunked
+        prefill interleaves correctly)."""
+        writer = monitor.start_tracing()
+        try:
+            eng = engine(paged=True, block_size=8, prefill_chunk=16)
+            ra = eng.submit(_prompt(4), max_new_tokens=30)
+            next(ra.stream(timeout=120))
+            eng.submit(_prompt(40), max_new_tokens=4).result(timeout=120)
+            ra.result(timeout=120)
+        finally:
+            monitor.stop_tracing()
+        evs = writer.events()
+        tr = self._trace_report()
+        rows = tr.aggregate(evs)
+        verdict = tr.serving_report(rows, file=open(os.devnull, "w"),
+                                    events=evs)
+        assert verdict["prefill_chunks"] >= 3       # 40-token prompt
+        assert verdict["decode_steps"] >= 1
+        assert verdict["max_consecutive_starved_ticks"] == 0
+        assert "no prefill starvation" in verdict["starvation_verdict"]
+        assert monitor.stat_get("kv_blocks_free") >= 0

@@ -137,22 +137,66 @@ def overlap_report(rows: list, file=None) -> dict:
     return out
 
 
-def serving_report(rows: list, file=None) -> dict:
-    """Prefill-vs-decode verdict from the serving spans (ISSUE 4).
+def _prefill_starvation(events: list) -> dict:
+    """Max consecutive scheduler ticks in which chunked prefill ran while
+    open decode streams got no decode step (ISSUE 7).
 
-    The serving engine emits ``serving.prefill`` (one per admitted
-    request) and ``serving.decode_step`` (one per batched decode tick)
-    spans. Their split answers the first question about a slow serving
-    trace: is admission (prompt prefill stalls the decode batch for its
-    duration) or steady-state decode eating the time budget?"""
-    pre = [r for r in rows if r["name"] == "serving.prefill"]
-    dec = [r for r in rows if r["name"] == "serving.decode_step"]
-    if not pre and not dec:
+    The paged engine tags ``serving.prefill_chunk`` spans with
+    ``{tick, open_streams}`` and ``serving.decode_step`` spans with
+    ``{tick}``. A tick that did chunk work with ``open_streams > 0`` but
+    no decode step starved every open stream for that tick; the maximum
+    RUN of such ticks is how long any stream waited. With the chunk loop
+    interleaved correctly this is 0 — a nonzero value means prefill is
+    monopolizing the scheduler (serial-prefill regression)."""
+    chunk_ticks: dict = {}   # tick -> had open streams waiting
+    decode_ticks = set()
+    for e in events:
+        name = e.get("name")
+        args = e.get("args") or {}
+        if "tick" not in args:
+            continue
+        if name == "serving.prefill_chunk":
+            t = int(args["tick"])
+            chunk_ticks[t] = chunk_ticks.get(t, False) \
+                or int(args.get("open_streams", 0)) > 0
+        elif name == "serving.decode_step":
+            decode_ticks.add(int(args["tick"]))
+    if not chunk_ticks:
         return {}
-    pre_us = sum(r["total_us"] for r in pre)
+    starved = sorted(t for t, waiting in chunk_ticks.items()
+                     if waiting and t not in decode_ticks)
+    worst = run = 0
+    prev = None
+    for t in starved:
+        run = run + 1 if prev is not None and t == prev + 1 else 1
+        worst = max(worst, run)
+        prev = t
+    return {"prefill_chunk_ticks": len(chunk_ticks),
+            "starved_ticks": len(starved),
+            "max_consecutive_starved_ticks": worst}
+
+
+def serving_report(rows: list, file=None, events: list | None = None) -> dict:
+    """Prefill-vs-decode verdict from the serving spans (ISSUE 4/7).
+
+    The serving engine emits ``serving.prefill`` (one per whole-prompt
+    admission), ``serving.prefill_chunk`` (one per chunked-prefill tick
+    slice, paged mode) and ``serving.decode_step`` (one per batched
+    decode tick) spans. Their split answers the first question about a
+    slow serving trace: is admission or steady-state decode eating the
+    time budget? When raw ``events`` are passed, paged runs also get a
+    PREFILL STARVATION verdict — the max consecutive ticks any open
+    stream waited behind chunked prefill work."""
+    pre = [r for r in rows if r["name"] == "serving.prefill"]
+    chk = [r for r in rows if r["name"] == "serving.prefill_chunk"]
+    dec = [r for r in rows if r["name"] == "serving.decode_step"]
+    if not pre and not chk and not dec:
+        return {}
+    pre_us = sum(r["total_us"] for r in pre + chk)
     dec_us = sum(r["total_us"] for r in dec)
     out = {"prefill_ms": pre_us / 1e3, "decode_ms": dec_us / 1e3,
            "prefills": sum(r["calls"] for r in pre),
+           "prefill_chunks": sum(r["calls"] for r in chk),
            "decode_steps": sum(r["calls"] for r in dec)}
     total = pre_us + dec_us
     if total > 0:
@@ -160,11 +204,23 @@ def serving_report(rows: list, file=None) -> dict:
         out["verdict"] = (
             "prefill-bound: prompt prefills stall the decode batch for a "
             "significant share of engine time — bucket prompts tighter, "
-            "admit fewer requests per tick, or chunk long prefills"
+            "admit fewer requests per tick, or chunk long prefills "
+            "(FLAGS_paged_kv=1 + prefill_chunk)"
             if pre_us > 0.5 * total else
             "decode-bound: steady-state batched decode dominates — "
             "throughput scales with slot occupancy; raise n_slots or "
             "batch more traffic")
+    if events is not None:
+        starve = _prefill_starvation(events)
+        if starve:
+            out.update(starve)
+            worst = starve["max_consecutive_starved_ticks"]
+            out["starvation_verdict"] = (
+                "no prefill starvation: decode ran every tick that did "
+                "chunked prefill work" if worst == 0 else
+                f"prefill starvation: some stream waited {worst} "
+                "consecutive tick(s) with no decode step — shrink "
+                "prefill_chunk or admit fewer prompts per tick")
     print("\nServing engine:", file=file)
     for k, v in out.items():
         if isinstance(v, float):
@@ -263,7 +319,7 @@ def main(argv=None):
     report(rows, args.top)
     input_pipeline_report(rows)
     overlap_report(rows)
-    serving_report(rows)
+    serving_report(rows, events=events)
     resilience_report(events, rows)
     return rows
 
