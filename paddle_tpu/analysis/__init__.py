@@ -1,0 +1,86 @@
+"""paddle_tpu.analysis — framework-aware static analysis + runtime
+sanitizers (graftlint, ISSUE 8).
+
+The reference Paddle enforces its invariants mechanically — ``enforce.h``
+checks, ProgramDesc IR passes, op-registry validation — so misuse fails
+at build/trace time. This package is the same posture for a Python/jax
+codebase: an AST lint suite (``tools/graftlint.py`` CLI, pinned tier-1 by
+``tests/test_analysis.py``) plus opt-in runtime sanitizers behind
+``FLAGS_sanitize``.
+
+Rule catalogue (stable IDs; suppress via ``tools/graftlint_baseline.json``
+entries carrying a fingerprint AND a reason):
+
+- **GL001 host-sync-in-jit** — ``.item()``/``.numpy()``/``.tolist()``/
+  ``np.asarray``/``float()``/``int()`` on traced values, ``print`` and
+  ``time.*`` inside functions reachable from ``jax.jit``/``custom_vjp``/
+  ``pallas_call``/``shard_map``/``lax`` control flow. Rationale: these
+  run once at trace time (baking a stale observation into the compiled
+  program) or force a device→host round-trip in a hot path — the exact
+  bug class FLAGS_fast_step/AsyncLoss exist to avoid.
+- **GL002 flag-capture-in-jit** — reading a ``core/native.py`` flag cell
+  (``native.fast_step[0]``) inside a to-be-jitted body. Rationale: the
+  cell is read once at trace time, so later ``set_flags`` calls silently
+  do nothing to already-compiled programs; flags must be read at
+  dispatch and passed in, or used to select the program.
+- **GL003 unguarded-shared-write** — a ``self.*``/module-global
+  attribute written from ≥2 thread contexts (``threading.Thread``
+  targets: serving scheduler ``_run``, guardian watchdog, io/prefetch
+  producers — plus the main thread) with no common lock across the write
+  sites. ``__init__`` writes are exempt (happen-before thread start).
+  Rationale: the PR-7 ``id()``-aliasing and PR-5 heartbeat bugs were
+  both silent shared-state hazards found after the fact.
+- **GL004 lock-order-cycle** — the union lock-acquisition graph (lock A
+  held while taking B, followed through calls) has a cycle. Rationale:
+  opposite-order acquisition deadlocks only under load, long after
+  review.
+- **GL005 gauge-unregistered** — a literal gauge name used via
+  ``stat_add``/``get_stat`` that is not in ``monitor/stats.py``
+  DEFAULT_STATS. Rationale: unregistered names are usually typos and
+  never show on the standing dashboard.
+- **GL006 gauge-unused** — a DEFAULT_STATS entry never incremented/set
+  anywhere (by literal or by its UPPERCASE handle). Rationale: a
+  registered-but-dead gauge reads as "this subsystem is idle" instead of
+  "this gauge is unwired".
+- **GL007 env-flag-no-cell** — ``os.environ`` consumption of a
+  ``FLAGS_*`` name outside ``core/native.py``. Rationale:
+  ``paddle.set_flags`` writes cells, not the environment — an env-only
+  flag is unreachable at runtime.
+- **GL008 wallclock-deadline** — ``time.time()`` where deadline/
+  staleness math needs ``time.monotonic()``. Rationale: the PR-5
+  elastic-heartbeat clock-skew bug; NTP steps make wall-clock deadlines
+  fire early/never. Legit wall-clock reads (human log timestamps) are
+  baseline-suppressed with a reason.
+- **GL009 mutable-default-arg** — ``def f(x=[])``-style defaults shared
+  across calls.
+- **GL010 bare-except** — bare ``except:`` (swallows
+  KeyboardInterrupt/SystemExit) anywhere, scheduler/guardian loops
+  especially.
+
+Runtime sanitizers (``FLAGS_sanitize=1``; default 0 is pinned
+bit-for-bit on the fast-step trajectory — the flag-off cost is one list
+index per hook):
+
+- **recompile explainer** — on a grad-jit / TrainStep /
+  DistributedTrainStep cache miss, the new (shape, dtype, weak-type)
+  signature is diffed against the nearest cached entry and a
+  ``sanitize.recompile`` trace span (plus an in-memory ring,
+  :data:`sanitizers.RECENT_RECOMPILES`) names the differing leaf —
+  ``tools/trace_report.py`` aggregates them into a "recompile causes"
+  verdict next to the input-vs-compute and comm-vs-compute verdicts.
+- **donation-after-use guard** — buffers donated by
+  ``TrainStep``/``DistributedTrainStep`` dispatches are tombstoned with
+  their donating call site; a later host read through the Tensor surface
+  raises :class:`sanitizers.DonatedBufferError` naming that site instead
+  of jax's anonymous "Array has been deleted".
+
+Static-analysis entry points (pure stdlib, safe to import without jax):
+
+    from paddle_tpu.analysis import run_lint, lint_source, Baseline
+    findings = run_lint(["paddle_tpu"])
+"""
+from .lint import (ALL_RULES, Baseline, Finding, RULE_DOCS, lint_source,
+                   run_lint)
+
+__all__ = ["ALL_RULES", "Baseline", "Finding", "RULE_DOCS", "lint_source",
+           "run_lint"]

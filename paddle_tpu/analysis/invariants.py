@@ -1,0 +1,227 @@
+"""GL005–GL010 — registry/flag/clock/API invariant lints.
+
+These are the mechanical conventions the codebase already follows by
+agreement; graftlint turns them into checks:
+
+- **GL005/GL006** keep ``monitor/stats.py`` DEFAULT_STATS and the code
+  honest in both directions: a literal gauge name incremented via
+  ``stat_add``/``get_stat`` must be registered, and every registered
+  gauge (through its UPPERCASE handle or its literal name) must be
+  incremented/set somewhere — an unused gauge is a dashboard lie.
+  Dynamically-formatted names (``"collective_" + op``,
+  f-string axis gauges) are out of static reach and skipped.
+- **GL007**: ``FLAGS_*`` env vars must be consumed through a
+  ``core/native.py`` cell, never via ``os.environ`` elsewhere —
+  otherwise ``paddle.set_flags`` silently cannot reach them.
+- **GL008**: ``time.time()`` is wall-clock; NTP steps/skew break
+  deadline and staleness math (the PR-5 elastic heartbeat bug). Use
+  ``time.monotonic()``; genuinely-wanted wall-clock reads (log
+  timestamps) carry a baseline suppression with a reason.
+- **GL009**: mutable default arguments are shared across calls.
+- **GL010**: bare ``except:`` catches KeyboardInterrupt/SystemExit —
+  fatal in scheduler/guardian loops that must stay interruptible.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .lint import Finding, Project
+
+__all__ = ["check", "registered_gauges"]
+
+_STATS_SUFFIX = "monitor/stats.py"
+_NATIVE_SUFFIX = "core/native.py"
+_INC_FUNCS = {"stat_add", "get_stat", "stat_reset", "stat_get"}
+_HANDLE_METHODS = {"add", "set", "increase", "decrease"}
+
+
+def registered_gauges(proj: Project):
+    """(names, handle_map) from monitor/stats.py: DEFAULT_STATS entries
+    plus HANDLE -> name assignments (``X = _registry.get_stat("n")``)."""
+    names: Set[str] = set()
+    handles: Dict[str, str] = {}
+    for relpath, mod in proj.modules.items():
+        if not relpath.endswith(_STATS_SUFFIX):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and t.id == "DEFAULT_STATS" \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            names.add(el.value)
+                elif isinstance(t, ast.Name) and t.id.isupper() \
+                        and isinstance(node.value, ast.Call):
+                    call = node.value
+                    tail = call.func.attr \
+                        if isinstance(call.func, ast.Attribute) \
+                        else getattr(call.func, "id", None)
+                    if tail == "get_stat" and call.args \
+                            and isinstance(call.args[0], ast.Constant):
+                        handles[t.id] = call.args[0].value
+    return names, handles
+
+
+def _qual_of(mod_tree, node) -> str:
+    # cheap enclosing-qualname lookup (line based)
+    best = ""
+    for n in ast.walk(mod_tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.lineno <= node.lineno \
+                and node.lineno <= max(getattr(n, "end_lineno", n.lineno),
+                                       n.lineno):
+            best = n.name
+    return best
+
+
+def _check_gauges(proj: Project, findings: List[Finding]) -> None:
+    registered, handles = registered_gauges(proj)
+    if not registered:
+        return
+    used_names: Set[str] = set()
+    used_handles: Set[str] = set()
+    for relpath, mod in proj.modules.items():
+        in_stats = relpath.endswith(_STATS_SUFFIX)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if tail in _INC_FUNCS and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    name = a.value
+                    used_names.add(name)
+                    if "." not in name and name not in registered \
+                            and not in_stats:
+                        findings.append(Finding(
+                            "GL005", relpath, node.lineno,
+                            _qual_of(mod.tree, node), f"gauge:{name}",
+                            f"gauge '{name}' is used via {tail}() but "
+                            "never registered in monitor/stats.py "
+                            "DEFAULT_STATS — register it (or fix the "
+                            "name typo)"))
+            elif tail in _HANDLE_METHODS and isinstance(f, ast.Attribute):
+                recv = f.value
+                hname = None
+                if isinstance(recv, ast.Name) and recv.id.isupper():
+                    hname = recv.id
+                elif isinstance(recv, ast.Attribute) \
+                        and recv.attr.isupper():
+                    hname = recv.attr
+                if hname in handles:
+                    used_handles.add(hname)
+    incremented = used_names | {handles[h] for h in used_handles}
+    for name in sorted(registered - incremented):
+        findings.append(Finding(
+            "GL006", "paddle_tpu/monitor/stats.py", 1, "DEFAULT_STATS",
+            f"gauge:{name}",
+            f"gauge '{name}' is registered in DEFAULT_STATS but never "
+            "incremented/set anywhere — wire it up or drop it"))
+
+
+def _check_env_flags(proj: Project, findings: List[Finding]) -> None:
+    for relpath, mod in proj.modules.items():
+        if relpath.endswith(_NATIVE_SUFFIX):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_env = False
+            if isinstance(f, ast.Attribute):
+                if f.attr == "get" and isinstance(f.value, ast.Attribute) \
+                        and f.value.attr == "environ":
+                    is_env = True      # os.environ.get(...)
+                elif f.attr == "getenv":
+                    is_env = True      # os.getenv(...)
+            if is_env and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) \
+                        and isinstance(a.value, str) \
+                        and a.value.startswith("FLAGS_"):
+                    findings.append(Finding(
+                        "GL007", relpath, node.lineno,
+                        _qual_of(mod.tree, node), f"envflag:{a.value}",
+                        f"'{a.value}' read from os.environ outside "
+                        "core/native.py — add a shared cell so "
+                        "paddle.set_flags() reaches it"))
+        # os.environ["FLAGS_x"] subscript form
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr == "environ" \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and node.slice.value.startswith("FLAGS_") \
+                    and isinstance(node.ctx, ast.Load):
+                findings.append(Finding(
+                    "GL007", relpath, node.lineno,
+                    _qual_of(mod.tree, node),
+                    f"envflag:{node.slice.value}",
+                    f"'{node.slice.value}' read from os.environ outside "
+                    "core/native.py — add a shared cell so "
+                    "paddle.set_flags() reaches it"))
+
+
+def _check_wallclock(proj: Project, findings: List[Finding]) -> None:
+    for relpath, mod in proj.modules.items():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "time" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "time":
+                qual = _qual_of(mod.tree, node)
+                findings.append(Finding(
+                    "GL008", relpath, node.lineno, qual,
+                    f"walltime:{qual or '<module>'}",
+                    "time.time() is wall-clock — deadlines/staleness "
+                    "need time.monotonic() (NTP steps mis-fire them); "
+                    "suppress with a reason if wall-clock time is "
+                    "genuinely wanted (log timestamps)"))
+
+
+def _check_defaults_and_excepts(proj: Project,
+                                findings: List[Finding]) -> None:
+    for relpath, mod in proj.modules.items():
+        for key, fi in proj.functions.items():
+            if key[0] != relpath:
+                continue
+            args = fi.node.args
+            for a, d in list(zip(
+                    (args.posonlyargs + args.args)[::-1],
+                    args.defaults[::-1])) + [
+                    (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                    if d is not None]:
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set", "bytearray"))
+                if bad:
+                    findings.append(Finding(
+                        "GL009", relpath, d.lineno, fi.qualname,
+                        f"mutdefault:{a.arg}",
+                        f"mutable default for '{a.arg}' in "
+                        f"'{fi.qualname}' is shared across calls — "
+                        "default to None and allocate inside"))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                findings.append(Finding(
+                    "GL010", relpath, node.lineno,
+                    _qual_of(mod.tree, node), "bareexcept",
+                    "bare 'except:' also swallows KeyboardInterrupt/"
+                    "SystemExit — catch Exception (or narrower)"))
+
+
+def check(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_gauges(proj, findings)
+    _check_env_flags(proj, findings)
+    _check_wallclock(proj, findings)
+    _check_defaults_and_excepts(proj, findings)
+    return findings

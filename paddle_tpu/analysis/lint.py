@@ -1,0 +1,413 @@
+"""graftlint core — project model, findings, baseline, rule driver.
+
+Pure-stdlib ``ast`` analysis (no jax import, no runtime side effects): a
+:class:`Project` parses every ``.py`` file under the given roots once and
+builds the shared indexes the rule modules consume — a qualified-name
+function table, per-module import maps (so ``from ..models.gpt import
+gpt_decode_step`` resolves to the defining file), and a best-effort
+call-target resolver. Rules live in :mod:`hotpath` (GL001/GL002),
+:mod:`races` (GL003/GL004) and :mod:`invariants` (GL005–GL010); each
+yields :class:`Finding` rows with a STABLE fingerprint (rule + path +
+symbol + detail, no line numbers) so the checked-in baseline survives
+unrelated edits.
+
+The reference enforces its invariants as C++ build-time machinery
+(enforce.h, ProgramDesc IR passes, op-registry validation); this is the
+same idea applied to a Python/jax codebase, where the hazards are trace
+semantics (host syncs and flag captures baked into compiled programs)
+and free-threaded host code (scheduler/guardian/producer threads).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Finding", "Module", "FuncInfo", "Project", "Baseline",
+    "run_lint", "lint_source", "ALL_RULES", "RULE_DOCS",
+]
+
+
+RULE_DOCS = {
+    "GL001": "host sync inside a jit-traced function (.item()/.numpy()/"
+             "np.asarray/print/time.* on traced values runs at trace time "
+             "or forces a device round-trip)",
+    "GL002": "native flag cell read inside a jit-traced function (the value "
+             "is baked in at trace time; read it at dispatch instead)",
+    "GL003": "attribute written from two threads without a common lock",
+    "GL004": "lock acquisition order cycle (potential deadlock)",
+    "GL005": "gauge name incremented but never registered in "
+             "monitor/stats.py DEFAULT_STATS",
+    "GL006": "gauge registered in DEFAULT_STATS but never incremented "
+             "anywhere",
+    "GL007": "FLAGS_* env var consumed outside core/native.py (no shared "
+             "cell; set_flags cannot reach it)",
+    "GL008": "time.time() used where a deadline/staleness comparison needs "
+             "time.monotonic() (wall-clock steps mis-fire)",
+    "GL009": "mutable default argument (shared across calls)",
+    "GL010": "bare except: swallows KeyboardInterrupt/SystemExit in a "
+             "scheduler/guardian loop",
+}
+
+
+class Finding:
+    """One lint result with a line for humans and a line-free fingerprint
+    for the baseline."""
+
+    __slots__ = ("rule", "path", "line", "symbol", "detail", "message")
+
+    def __init__(self, rule: str, path: str, line: int, symbol: str,
+                 detail: str, message: str):
+        self.rule = rule
+        self.path = path          # repo-relative, '/'-separated
+        self.line = int(line)
+        self.symbol = symbol      # enclosing qualname ('' at module level)
+        self.detail = detail      # rule-specific stable key
+        self.message = message
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.detail}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "detail": self.detail,
+                "message": self.message, "fingerprint": self.fingerprint}
+
+    def __repr__(self):
+        return f"Finding({self.format()})"
+
+
+class Module:
+    """One parsed source file."""
+
+    __slots__ = ("relpath", "tree", "source", "dotted")
+
+    def __init__(self, relpath: str, source: str, dotted: str):
+        self.relpath = relpath
+        self.source = source
+        self.dotted = dotted      # e.g. paddle_tpu.serving.engine
+        self.tree = ast.parse(source, filename=relpath)
+
+
+class FuncInfo:
+    """One function/method (including nested defs), with enough context
+    for call-graph walks."""
+
+    __slots__ = ("module", "qualname", "node", "cls", "self_cls", "params")
+
+    def __init__(self, module: Module, qualname: str, node,
+                 cls: Optional[str], self_cls: Optional[str] = None):
+        self.module = module
+        self.qualname = qualname          # e.g. InferenceEngine._run or
+        #      TrainStep._build.<locals>.step_impl
+        self.node = node
+        self.cls = cls                    # DIRECT enclosing class (methods)
+        # class `self` refers to — inherited by closures nested in methods
+        self.self_cls = self_cls if self_cls is not None else cls
+        self.params = [a.arg for a in node.args.posonlyargs
+                       + node.args.args + node.args.kwonlyargs]
+        if node.args.vararg:
+            self.params.append(node.args.vararg.arg)
+        if node.args.kwarg:
+            self.params.append(node.args.kwarg.arg)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.relpath, self.qualname)
+
+
+def _iter_py_files(roots: Iterable[str]) -> List[str]:
+    out = []
+    for root in roots:
+        if os.path.isfile(root) and root.endswith(".py"):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _dotted_name(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _FuncIndexer(ast.NodeVisitor):
+    def __init__(self, module: Module, project: "Project"):
+        self.module = module
+        self.project = project
+        self.stack: List[str] = []       # qualname parts
+        self.cls_stack: List[Optional[str]] = []
+        self.self_cls_stack: List[Optional[str]] = []
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.cls_stack.append(node.name)
+        self.self_cls_stack.append(node.name)
+        self.generic_visit(node)
+        self.self_cls_stack.pop()
+        self.cls_stack.pop()
+        self.stack.pop()
+
+    def _visit_func(self, node):
+        qual = ".".join(self.stack + [node.name]) if self.stack else node.name
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        self_cls = self.self_cls_stack[-1] if self.self_cls_stack else None
+        info = FuncInfo(self.module, qual, node, cls, self_cls)
+        self.project.functions[info.key] = info
+        self.project.by_module_name.setdefault(
+            self.module.relpath, {}).setdefault(node.name, info)
+        if cls is not None:
+            self.project.methods.setdefault(
+                (self.module.relpath, cls), {})[node.name] = info
+        self.stack.extend([node.name, "<locals>"])
+        self.cls_stack.append(None)      # nested defs are not methods
+        # nested defs keep the enclosing method's `self` binding (closure)
+        self.self_cls_stack.append(self_cls)
+        self.generic_visit(node)
+        self.self_cls_stack.pop()
+        self.cls_stack.pop()
+        self.stack.pop()
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def _resolve_relative(module_dotted: str, level: int,
+                      target: Optional[str]) -> Optional[str]:
+    """``from ..x import y`` inside module_dotted -> absolute dotted path
+    of x (None when the relative import escapes the tree)."""
+    parts = module_dotted.split(".")
+    # level 1 = current package: drop the module name itself
+    if level > len(parts):
+        return None
+    base = parts[:-level] if level else parts
+    if target:
+        base = base + target.split(".")
+    return ".".join(base) if base else None
+
+
+class Project:
+    """Parsed view of the linted tree plus shared indexes."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, Module] = {}           # relpath -> Module
+        self.by_dotted: Dict[str, Module] = {}
+        self.functions: Dict[Tuple[str, str], FuncInfo] = {}
+        self.by_module_name: Dict[str, Dict[str, FuncInfo]] = {}
+        self.methods: Dict[Tuple[str, str], Dict[str, FuncInfo]] = {}
+        # per-module import maps:
+        #   imported_funcs[relpath][local_name] = (target_relpath, name)
+        #   imported_mods[relpath][alias] = target_relpath
+        self.imported_funcs: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self.imported_mods: Dict[str, Dict[str, str]] = {}
+        # names bound (per module) to core.native flag cells:
+        #   flag_cells[relpath][local_name] = canonical flag name
+        self.flag_cells: Dict[str, Dict[str, str]] = {}
+
+    # -- construction --------------------------------------------------------
+    def add_source(self, relpath: str, source: str) -> Optional[Module]:
+        relpath = relpath.replace(os.sep, "/")
+        try:
+            mod = Module(relpath, source, _dotted_name(relpath))
+        except SyntaxError:
+            return None
+        self.modules[relpath] = mod
+        self.by_dotted[mod.dotted] = mod
+        _FuncIndexer(mod, self).visit(mod.tree)
+        return mod
+
+    def finish(self) -> None:
+        """Resolve imports once every module is loaded."""
+        for relpath, mod in self.modules.items():
+            funcs: Dict[str, Tuple[str, str]] = {}
+            mods: Dict[str, str] = {}
+            cells: Dict[str, str] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom):
+                    src = _resolve_relative(
+                        mod.dotted if not relpath.endswith("__init__.py")
+                        else mod.dotted + "._init_",
+                        node.level, node.module) if node.level else node.module
+                    if src is None:
+                        continue
+                    target = self.by_dotted.get(src)
+                    from_native = src.endswith("core.native")
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        if from_native:
+                            cells[local] = alias.name
+                        if target is not None:
+                            sub = self.by_dotted.get(src + "." + alias.name)
+                            if sub is not None:
+                                mods[local] = sub.relpath
+                            else:
+                                funcs[local] = (target.relpath, alias.name)
+                        else:
+                            sub = self.by_dotted.get(src + "." + alias.name)
+                            if sub is not None:
+                                mods[local] = sub.relpath
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        target = self.by_dotted.get(alias.name)
+                        if target is not None:
+                            mods[alias.asname or alias.name] = target.relpath
+            self.imported_funcs[relpath] = funcs
+            self.imported_mods[relpath] = mods
+            self.flag_cells[relpath] = cells
+
+    # -- call resolution -----------------------------------------------------
+    def resolve_call(self, caller: FuncInfo, call: ast.Call
+                     ) -> Optional[FuncInfo]:
+        """Best-effort static resolution of a call target; None for
+        dynamic/stdlib/unresolvable targets."""
+        return self.resolve_name(caller, call.func)
+
+    def resolve_name(self, caller: FuncInfo, func) -> Optional[FuncInfo]:
+        relpath = caller.module.relpath
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested def in an enclosing scope of the caller
+            qual_parts = caller.qualname.split(".")
+            for cut in range(len(qual_parts), 0, -1):
+                q = ".".join(qual_parts[:cut] + ["<locals>", name]) \
+                    if cut == len(qual_parts) \
+                    else ".".join(qual_parts[:cut] + [name])
+                hit = self.functions.get((relpath, q))
+                if hit is not None:
+                    return hit
+            hit = self.by_module_name.get(relpath, {}).get(name)
+            if hit is not None and hit.cls is None:
+                return hit
+            imp = self.imported_funcs.get(relpath, {}).get(name)
+            if imp is not None:
+                target_rel, target_name = imp
+                cand = self.by_module_name.get(target_rel, {}).get(target_name)
+                if cand is not None:
+                    return cand
+            return None
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name):
+                base = func.value.id
+                if base == "self" and caller.self_cls is not None:
+                    return self.methods.get(
+                        (relpath, caller.self_cls), {}).get(func.attr)
+                target_rel = self.imported_mods.get(relpath, {}).get(base)
+                if target_rel is not None:
+                    cand = self.by_module_name.get(
+                        target_rel, {}).get(func.attr)
+                    if cand is not None and cand.cls is None:
+                        return cand
+            return None
+        return None
+
+
+class Baseline:
+    """Checked-in suppression file: a list of {fingerprint, reason}.
+    Every entry MUST carry a non-empty reason — an unjustified suppression
+    is itself an error."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = list(entries or [])
+        self.by_fp = {e.get("fingerprint", ""): e for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("suppressions", data) if isinstance(data, dict) \
+            else data
+        return cls(entries)
+
+    def validate(self) -> List[str]:
+        errs = []
+        for e in self.entries:
+            if not str(e.get("reason", "")).strip():
+                errs.append(f"baseline entry without a reason: "
+                            f"{e.get('fingerprint', '?')}")
+            if not str(e.get("fingerprint", "")).strip():
+                errs.append(f"baseline entry without a fingerprint: {e!r}")
+        return errs
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        return finding.fingerprint in self.by_fp
+
+    def split(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """(new, suppressed, stale_fingerprints)."""
+        new, sup = [], []
+        seen = set()
+        for f in findings:
+            (sup if self.is_suppressed(f) else new).append(f)
+            seen.add(f.fingerprint)
+        stale = [fp for fp in self.by_fp if fp not in seen]
+        return new, sup, stale
+
+
+def build_project(paths: Iterable[str], root: Optional[str] = None
+                  ) -> Project:
+    root = os.path.abspath(root or os.getcwd())
+    proj = Project(root)
+    for path in _iter_py_files(paths):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root) if ap.startswith(root) else path
+        try:
+            with open(ap, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        proj.add_source(rel, src)
+    proj.finish()
+    return proj
+
+
+def _default_rules():
+    from . import hotpath, invariants, races
+
+    return [hotpath.check, races.check, invariants.check]
+
+
+ALL_RULES = tuple(RULE_DOCS)
+
+
+def run_project(proj: Project, rules=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule_fn in (_default_rules() if rules is None else rules):
+        findings.extend(rule_fn(proj))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return findings
+
+
+def run_lint(paths: Iterable[str], root: Optional[str] = None,
+             rules=None) -> List[Finding]:
+    """Lint the .py files under ``paths``; returns sorted findings."""
+    return run_project(build_project(paths, root=root), rules=rules)
+
+
+def lint_source(source: str, relpath: str = "fixture.py",
+                rules=None, extra: Optional[Dict[str, str]] = None
+                ) -> List[Finding]:
+    """Lint one in-memory snippet (rule fixtures/tests). ``extra`` maps
+    additional relpaths to sources loaded into the same project (e.g. a
+    stats registry for the gauge rules)."""
+    proj = Project(os.getcwd())
+    for rp, src in (extra or {}).items():
+        proj.add_source(rp, src)
+    proj.add_source(relpath, source)
+    proj.finish()
+    return run_project(proj, rules=rules)

@@ -1,0 +1,296 @@
+"""GL003/GL004 — cross-thread shared state and lock ordering.
+
+Thread entry points are found syntactically: every
+``threading.Thread(target=X)`` (the serving scheduler ``_run``, the
+guardian watchdog, the io/prefetch producer closures, plus anything a
+later PR adds). For each entry the detector walks the call graph
+(``self.method`` and local calls) carrying the set of locks held at each
+point (``with self._lock:`` / ``with cv:`` blocks), and records every
+*write* to ``self.*`` attributes and module globals — attribute stores,
+subscript stores, augmented assigns, and known mutator method calls
+(``append``/``popleft``/``clear``/…). Methods not reachable from any
+thread entry form the class's "main" context (what user code calls).
+
+- **GL003**: an attribute written in ≥2 contexts whose write sites share
+  no common lock. ``__init__`` writes are exempt (they happen-before the
+  thread starts). The fix is a shared lock — or confining the writes to
+  one thread.
+- **GL004**: the union of lock-acquisition edges (lock A held while B is
+  taken, across calls) contains a cycle — two threads taking the locks
+  in opposite orders can deadlock even if every individual access is
+  guarded.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .lint import Finding, FuncInfo, Project
+
+__all__ = ["check", "find_thread_entries"]
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "remove", "clear", "add", "update", "discard",
+    "setdefault", "put", "put_nowait",
+}
+# synchronization objects mutate safely — calls on attrs with these
+# names are not shared-state writes, and `with` on them is a guard
+_LOCKY = ("lock", "_cv", "cv", "cond", "mutex", "event", "sem")
+
+
+def _lock_name(expr, fi: FuncInfo) -> Optional[str]:
+    """Canonical name when ``expr`` looks like a lock/condition object
+    (a bare attr/name used as a `with` context, not a call result)."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        owner = fi.self_cls or "?"
+        return f"{fi.module.relpath}:{owner}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return f"{fi.module.relpath}:{fi.qualname}:{expr.id}"
+    return None
+
+
+def _module_globals(mod_tree) -> Set[str]:
+    out = set()
+    for node in mod_tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+class _Write:
+    __slots__ = ("owner", "attr", "ctx", "guards", "relpath", "line", "qual")
+
+    def __init__(self, owner, attr, ctx, guards, relpath, line, qual):
+        self.owner = owner          # (relpath, class) or (relpath, None)
+        self.attr = attr
+        self.ctx = ctx              # context id string
+        self.guards: FrozenSet[str] = guards
+        self.relpath = relpath
+        self.line = line
+        self.qual = qual
+
+
+def find_thread_entries(proj: Project) -> List[FuncInfo]:
+    entries: List[FuncInfo] = []
+    seen = set()
+    for key, fi in proj.functions.items():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if tail != "Thread":
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and node.args:
+                target = node.args[0]
+            if target is None:
+                continue
+            tgt = proj.resolve_name(fi, target)
+            if tgt is not None and tgt.key not in seen:
+                seen.add(tgt.key)
+                entries.append(tgt)
+    return entries
+
+
+class _Walker:
+    """Collect writes + lock-order edges reachable from one context."""
+
+    def __init__(self, proj: Project, ctx: str):
+        self.proj = proj
+        self.ctx = ctx
+        self.writes: List[_Write] = []
+        self.edges: Set[Tuple[str, str]] = set()
+        self.visited: Set[Tuple[Tuple[str, str], FrozenSet[str]]] = set()
+        self.funcs_seen: Set[Tuple[str, str]] = set()
+
+    def walk(self, fi: FuncInfo, held: FrozenSet[str] = frozenset(),
+             depth: int = 0) -> None:
+        key = (fi.key, held)
+        if key in self.visited or depth > 8:
+            return
+        self.visited.add(key)
+        self.funcs_seen.add(fi.key)
+        self._body(fi, list(ast.iter_child_nodes(fi.node)), held, depth)
+
+    def _body(self, fi: FuncInfo, stmts, held: FrozenSet[str],
+              depth: int) -> None:
+        globs = _module_globals(fi.module.tree)
+        stack = list(stmts)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue            # reached through call edges instead
+            if isinstance(n, ast.With):
+                inner = held
+                for item in n.items:
+                    ln = _lock_name(item.context_expr, fi)
+                    if ln is not None:
+                        for h in inner:
+                            if h != ln:
+                                self.edges.add((h, ln))
+                        inner = inner | {ln}
+                self._body(fi, n.body, inner, depth)
+                continue
+            # -- writes --
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    self._target(fi, t, held, globs)
+                stack.extend(ast.iter_child_nodes(n))
+                continue
+            if isinstance(n, ast.Call):
+                self._call(fi, n, held, globs, depth)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _record(self, fi, owner, attr, node, held):
+        if fi.qualname.split(".")[-1] in ("__init__", "__new__"):
+            return                 # happens-before any thread start
+        self.writes.append(_Write(
+            owner, attr, self.ctx, held, fi.module.relpath,
+            getattr(node, "lineno", fi.node.lineno), fi.qualname))
+
+    def _target(self, fi, t, held, globs):
+        # self.X = / self.X[i] = / GLOBAL[i] =
+        base = t
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and fi.self_cls is not None:
+            self._record(fi, (fi.module.relpath, fi.self_cls),
+                         base.attr, t, held)
+        elif isinstance(base, ast.Name) and isinstance(t, ast.Subscript) \
+                and base.id in globs:
+            self._record(fi, (fi.module.relpath, None), base.id, t, held)
+        elif isinstance(t, ast.Name) and t.id in globs \
+                and not isinstance(t.ctx, ast.Load):
+            # plain Name assignment rebinds a local unless declared global
+            if any(isinstance(g, ast.Global) and t.id in g.names
+                   for g in ast.walk(fi.node)):
+                self._record(fi, (fi.module.relpath, None), t.id, t, held)
+
+    def _call(self, fi, n: ast.Call, held, globs, depth):
+        f = n.func
+        # mutator method on self attr / module global
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            recv = f.value
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" \
+                    and fi.self_cls is not None \
+                    and not any(k in recv.attr for k in _LOCKY):
+                self._record(fi, (fi.module.relpath, fi.self_cls),
+                             recv.attr, n, held)
+            elif isinstance(recv, ast.Name) and recv.id in globs \
+                    and not any(k in recv.id.lower() for k in _LOCKY):
+                self._record(fi, (fi.module.relpath, None), recv.id, n, held)
+        # follow call edges carrying the held set
+        target = self.proj.resolve_call(fi, n)
+        if target is not None and target.key != fi.key:
+            self.walk(target, held, depth + 1)
+
+
+def check(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    entries = find_thread_entries(proj)
+    if not entries:
+        return findings
+
+    walkers: List[_Walker] = []
+    thread_funcs: Set[Tuple[str, str]] = set()
+    for e in entries:
+        w = _Walker(proj, f"thread:{e.module.relpath}:{e.qualname}")
+        w.walk(e)
+        walkers.append(w)
+        thread_funcs |= w.funcs_seen
+
+    # main contexts: every class/module hosting a thread entry gets one
+    # walker over its functions NOT reachable from any thread entry
+    touched_owners = {(e.module.relpath, e.self_cls) for e in entries}
+    for relpath, cls in sorted(touched_owners, key=str):
+        ctx = f"main:{relpath}:{cls or '<module>'}"
+        w = _Walker(proj, ctx)
+        if cls is not None:
+            meths = proj.methods.get((relpath, cls), {})
+            for name, fi in sorted(meths.items()):
+                if fi.key in thread_funcs \
+                        or name in ("__init__", "__new__"):
+                    continue
+                w.walk(fi)
+        else:
+            for name, fi in sorted(
+                    proj.by_module_name.get(relpath, {}).items()):
+                if fi.key not in thread_funcs and fi.cls is None:
+                    w.walk(fi)
+        walkers.append(w)
+
+    # -- GL003: per-(owner, attr) cross-context write analysis --------------
+    by_attr: Dict[Tuple, List[_Write]] = {}
+    for w in walkers:
+        for wr in w.writes:
+            by_attr.setdefault((wr.owner, wr.attr), []).append(wr)
+    for (owner, attr), writes in sorted(by_attr.items(), key=str):
+        ctxs = {w.ctx for w in writes}
+        if len(ctxs) < 2:
+            continue
+        common = None
+        for w in writes:
+            common = w.guards if common is None else (common & w.guards)
+        if common:
+            continue
+        first = min(writes, key=lambda w: (w.relpath, w.line))
+        owner_name = owner[1] or "<module>"
+        findings.append(Finding(
+            "GL003", first.relpath, first.line, first.qual,
+            f"race:{owner_name}.{attr}",
+            f"'{owner_name}.{attr}' is written from {len(ctxs)} thread "
+            f"contexts ({', '.join(sorted(ctxs))}) with no common lock — "
+            "guard every write with one shared lock/Condition or confine "
+            "the attribute to a single thread"))
+
+    # -- GL004: lock-order cycle over the union graph -----------------------
+    graph: Dict[str, Set[str]] = {}
+    for w in walkers:
+        for a, b in w.edges:
+            graph.setdefault(a, set()).add(b)
+    state: Dict[str, int] = {}
+    cycle_sets: List[Tuple[str, ...]] = []
+
+    def dfs(node, path):
+        state[node] = 1
+        for nxt in sorted(graph.get(node, ())):
+            if state.get(nxt, 0) == 1:
+                i = path.index(nxt)
+                cyc = tuple(sorted(set(path[i:] + [nxt])))
+                if cyc not in cycle_sets:
+                    cycle_sets.append(cyc)
+            elif state.get(nxt, 0) == 0:
+                dfs(nxt, path + [nxt])
+        state[node] = 2
+
+    for node in sorted(graph):
+        if state.get(node, 0) == 0:
+            dfs(node, [node])
+    for cyc in cycle_sets:
+        relpath = cyc[0].split(":", 1)[0]
+        findings.append(Finding(
+            "GL004", relpath, 1, "",
+            "lockcycle:" + "->".join(cyc),
+            "lock acquisition order cycle: " + " -> ".join(cyc)
+            + " — two threads taking these locks in opposite orders can "
+            "deadlock; impose one global acquisition order"))
+    return findings

@@ -1,0 +1,210 @@
+"""Runtime sanitizers behind ``FLAGS_sanitize`` (default 0).
+
+Two hooks, both free when the flag is off (one list-index check at each
+call site) and purely observational when on — numerics are untouched,
+pinned by tests/test_analysis.py:
+
+**Recompile explainer.** The grad-jit cache (framework/core.py), the
+jit.TrainStep batch signature and the DistributedTrainStep batch-aval
+tracker call :func:`note_recompile` on a cache MISS that follows at
+least one prior entry. The new signature is diffed against the NEAREST
+cached signature (fewest differing leaves) and the result — which leaf,
+what it was, what it is now — lands as a ``sanitize.recompile`` trace
+span/instant (while tracing) and on the :data:`RECENT_RECOMPILES` ring,
+so a shape-churn recompile storm names its culprit leaf instead of just
+bumping GRAD_JIT_MISS.
+
+**Donation-after-use guard.** Donated-step dispatchers call
+:func:`tombstone_tree` on the buffers they just donated, stamped with
+the donating call site. Host reads through the Tensor surface
+(``numpy()``/``item()``/``float()``/...) call :func:`check_host_read`
+and raise :class:`DonatedBufferError` naming that site — instead of
+jax's anonymous "Array has been deleted" three layers later. Tombstones
+are identity-checked (weakref where possible) and capped, so id reuse
+cannot false-positive and long runs cannot leak.
+"""
+from __future__ import annotations
+
+import collections
+import time
+import traceback
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core.native import sanitize as _sanitize
+
+__all__ = [
+    "DonatedBufferError", "enabled", "aval_signature", "diff_signatures",
+    "note_recompile", "RECENT_RECOMPILES", "tombstone_tree",
+    "check_host_read", "reset",
+]
+
+
+def enabled() -> bool:
+    return _sanitize[0]
+
+
+# --------------------------------------------------------------------------
+# recompile explainer
+# --------------------------------------------------------------------------
+
+# last N explained recompiles, host-readable without tracing:
+# {"group", "leaf", "kind", "had", "got", "n_diffs", "ts"}
+RECENT_RECOMPILES: collections.deque = collections.deque(maxlen=256)
+
+
+def aval_signature(tree) -> Tuple:
+    """(name, shape, dtype, weak) per leaf of an arg pytree — the cache
+    key the explainer diffs. Python scalars trace weak-typed, so they
+    sign by type name."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    sig = []
+    for i, a in enumerate(leaves):
+        sh = getattr(a, "shape", None)
+        if sh is None:
+            sig.append((str(i), type(a).__name__, "", True))
+        else:
+            sig.append((str(i), tuple(sh),
+                        str(getattr(a, "dtype", "?")),
+                        bool(getattr(a, "weak_type", False))))
+    return tuple(sig)
+
+
+def _leaf_str(entry) -> str:
+    _, shape, dtype, weak = entry
+    if dtype == "":
+        return f"py:{shape}"
+    return f"{dtype}{list(shape)}" + ("~" if weak else "")
+
+
+def diff_signatures(new_sig: Tuple, seen: Sequence[Tuple]
+                    ) -> Optional[Dict[str, Any]]:
+    """Diff ``new_sig`` against its nearest neighbour in ``seen``;
+    returns {leaf, kind, had, got, n_diffs} for the first differing leaf
+    of the closest entry (None when ``seen`` is empty)."""
+    if not seen:
+        return None
+
+    def distance(old):
+        if len(old) != len(new_sig):
+            return abs(len(old) - len(new_sig)) + sum(
+                1 for a, b in zip(old, new_sig) if a[1:] != b[1:])
+        return sum(1 for a, b in zip(old, new_sig) if a[1:] != b[1:])
+
+    nearest = min(seen, key=distance)
+    if len(nearest) != len(new_sig):
+        return {"leaf": "<structure>", "kind": "leaf_count",
+                "had": str(len(nearest)), "got": str(len(new_sig)),
+                "n_diffs": abs(len(nearest) - len(new_sig))}
+    diffs = [(i, a, b) for i, (a, b) in enumerate(zip(nearest, new_sig))
+             if a[1:] != b[1:]]
+    if not diffs:
+        return None
+    i, a, b = diffs[0]
+    kind = "shape" if a[1] != b[1] else (
+        "dtype" if a[2] != b[2] else "weak_type")
+    return {"leaf": f"leaf[{i}]", "kind": kind, "had": _leaf_str(a),
+            "got": _leaf_str(b), "n_diffs": len(diffs)}
+
+
+def note_recompile(group: str, new_sig: Tuple,
+                   seen: Sequence[Tuple]) -> Optional[Dict[str, Any]]:
+    """Explain one cache miss (no-op unless FLAGS_sanitize). ``group``
+    names the cache ('grad_jit:relu', 'TrainStep', ...)."""
+    if not _sanitize[0]:
+        return None
+    d = diff_signatures(new_sig, seen)
+    if d is None:
+        return None
+    d = dict(d, group=group, ts=time.perf_counter())
+    RECENT_RECOMPILES.append(d)
+    from ..monitor import trace as _mtrace
+
+    if _mtrace.TRACING[0]:
+        _mtrace.get_writer().add_complete(
+            "sanitize.recompile", d["ts"], 0.0, cat="sanitize",
+            args={"group": group, "leaf": d["leaf"], "kind": d["kind"],
+                  "had": d["had"], "got": d["got"],
+                  "n_diffs": d["n_diffs"]})
+    return d
+
+
+# --------------------------------------------------------------------------
+# donation-after-use guard
+# --------------------------------------------------------------------------
+
+class DonatedBufferError(RuntimeError):
+    """Host read of a buffer that was donated to a compiled step."""
+
+
+_MAX_TOMBSTONES = 8192
+# id(arr) -> (ref-or-None, strong-or-None, site); ordered for eviction
+_tombstones: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def _call_site(skip_prefixes: Tuple[str, ...] = ("paddle_tpu",)) -> str:
+    """Innermost stack frame OUTSIDE the framework — the user line whose
+    step call donated the buffers."""
+    site = None
+    for fr in reversed(traceback.extract_stack()):
+        p = fr.filename.replace("\\", "/")
+        if "/paddle_tpu/" in p or p.endswith("sanitizers.py"):
+            continue
+        site = f"{fr.filename}:{fr.lineno} in {fr.name}"
+        break
+    if site is None:
+        fr = traceback.extract_stack()[0]
+        site = f"{fr.filename}:{fr.lineno} in {fr.name}"
+    return site
+
+
+def tombstone_tree(tree, site: Optional[str] = None) -> None:
+    """Mark every array leaf of ``tree`` as donated (no-op unless
+    FLAGS_sanitize)."""
+    if not _sanitize[0]:
+        return
+    import jax
+
+    if site is None:
+        site = _call_site()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "dtype") or not hasattr(leaf, "shape"):
+            continue
+        try:
+            ref = weakref.ref(leaf)
+            entry = (ref, None, site)
+        except TypeError:
+            entry = (None, leaf, site)
+        _tombstones[id(leaf)] = entry
+        _tombstones.move_to_end(id(leaf))
+    while len(_tombstones) > _MAX_TOMBSTONES:
+        _tombstones.popitem(last=False)
+
+
+def check_host_read(arr) -> None:
+    """Raise DonatedBufferError when ``arr`` was donated earlier (no-op
+    unless FLAGS_sanitize). Identity-checked so a recycled id() can never
+    hit a stale entry."""
+    if not _sanitize[0] or not _tombstones:
+        return
+    entry = _tombstones.get(id(arr))
+    if entry is None:
+        return
+    ref, strong, site = entry
+    alive = strong if strong is not None else (ref() if ref else None)
+    if alive is not arr:
+        _tombstones.pop(id(arr), None)     # id recycled — stale entry
+        return
+    raise DonatedBufferError(
+        f"host read of a donated buffer: this array was donated to a "
+        f"compiled train step dispatched at {site}; its contents are "
+        "gone. Read the returned arrays instead (or sync before "
+        "capturing state). [FLAGS_sanitize donation-after-use guard]")
+
+
+def reset() -> None:
+    """Drop all tombstones and explained recompiles (test isolation)."""
+    _tombstones.clear()
+    RECENT_RECOMPILES.clear()

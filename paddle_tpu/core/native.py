@@ -173,6 +173,31 @@ paged_kv = [_truthy(os.environ.get("FLAGS_paged_kv", "0"))]
 fault_inject = [os.environ.get("FLAGS_fault_inject", "")]
 fault_inject_watchers: list = []
 
+# FLAGS_sanitize (ISSUE 8): opt-in runtime sanitizers
+# (paddle_tpu.analysis.sanitizers) — the jit-boundary recompile explainer
+# (a cache miss diffs its aval signature against the nearest cached entry
+# and emits a `sanitize.recompile` span naming the differing leaf) and
+# the donation-after-use guard (buffers donated to a compiled step are
+# tombstoned; a later host read raises with the donating call site).
+# Default OFF; the unset path is pinned bit-for-bit — each hook is one
+# list-index check.
+sanitize = [_truthy(os.environ.get("FLAGS_sanitize", "0"))]
+
+
+def _int_or_zero(value) -> int:
+    try:
+        return int(str(value))
+    except (TypeError, ValueError):
+        return 0
+
+
+# FLAGS_shm_slot_bytes (ISSUE 3 transport, cell added by ISSUE 8's
+# env-flag lint): manual override of the shared-memory ring's per-slot
+# byte size; 0 = size from the probed sample. Going through a cell keeps
+# `paddle.set_flags({"FLAGS_shm_slot_bytes": n})` working — the env var
+# alone would be unreachable after import.
+shm_slot_bytes = [_int_or_zero(os.environ.get("FLAGS_shm_slot_bytes", "0"))]
+
 
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
@@ -199,6 +224,10 @@ def set_flag(name: str, value) -> None:
         fault_inject[0] = str(value)
         for watcher in fault_inject_watchers:
             watcher(fault_inject[0])
+    elif name.endswith("sanitize"):
+        sanitize[0] = _truthy(value)
+    elif name.endswith("shm_slot_bytes"):
+        shm_slot_bytes[0] = _int_or_zero(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

@@ -213,9 +213,12 @@ class ElasticManager:
                         poll: float = 0.2) -> List[str]:
         """Block until the alive set sits inside [min_np, max_np] and is
         stable for one extra poll (reference wait() loop)."""
-        deadline = time.time() + timeout
+        # monotonic deadline: an NTP step mid-wait must not stretch or
+        # collapse the quorum window (graftlint GL008, same class as the
+        # PR-5 heartbeat-staleness fix)
+        deadline = time.monotonic() + timeout
         prev: Optional[List[str]] = None
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             ok, hosts = self.match()
             if ok and hosts == prev:
                 return hosts

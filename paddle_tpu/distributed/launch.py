@@ -77,10 +77,12 @@ class Pod:
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
+        # monotonic: a wall-clock step here would stretch/starve the
+        # shared kill budget across workers (graftlint GL008)
+        deadline = time.monotonic() + 10
         for p in self.procs:
             try:
-                p.wait(timeout=max(0.1, deadline - time.time()))
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
 

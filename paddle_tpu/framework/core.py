@@ -196,14 +196,20 @@ class Tensor:
         return T.transpose(self, list(range(self.ndim))[::-1])
 
     def numpy(self):
+        if _sanitize[0]:
+            _san_check_read(self._data)
         return np.asarray(self._data)
 
     def item(self, *args):
+        if _sanitize[0]:
+            _san_check_read(self._data)
         if args:
             return np.asarray(self._data).item(*args)
         return np.asarray(self._data).item()
 
     def tolist(self):
+        if _sanitize[0]:
+            _san_check_read(self._data)
         return np.asarray(self._data).tolist()
 
     def detach(self):
@@ -284,12 +290,18 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        if _sanitize[0]:
+            _san_check_read(self._data)
         return bool(np.asarray(self._data))
 
     def __int__(self):
+        if _sanitize[0]:
+            _san_check_read(self._data)
         return int(np.asarray(self._data))
 
     def __float__(self):
+        if _sanitize[0]:
+            _san_check_read(self._data)
         return float(np.asarray(self._data))
 
     def __format__(self, spec):
@@ -503,6 +515,22 @@ def _jitted(fn, attrs):
 # the cache entirely.
 
 _grad_jit_cache: dict = {}
+# (fn, attrs) -> list of aval sigs already compiled, in insertion order —
+# the recompile explainer (FLAGS_sanitize) diffs a new miss against these
+# to name the leaf whose shape/dtype churned
+_grad_jit_groups: dict = {}
+
+
+def _san_sig(sig):
+    """Grad-jit aval sig -> sanitizers leaf-signature format
+    ((name, shape, dtype, weak) per leaf)."""
+    out = []
+    for i, e in enumerate(sig):
+        if isinstance(e, tuple):
+            out.append((str(i), e[0], e[1], False))
+        else:                       # python-scalar arg signed by type name
+            out.append((str(i), e, "", True))
+    return tuple(out)
 
 
 class _GradJitEntry:
@@ -562,6 +590,13 @@ def _grad_jitted(fn, attrs, arrays, name=None):
         _mstats.GRAD_JIT_MISS.add()
         _mstats.GRAD_JIT_COMPILE.add()
         e = _GradJitEntry(fn, attrs, name or getattr(fn, "__name__", "op"))
+        group = _grad_jit_groups.setdefault(key[:2], [])
+        if _sanitize[0] and group:
+            # recompile explainer: name the leaf whose aval churned vs
+            # the nearest already-compiled signature
+            _san_note_recompile(f"grad_jit:{e.name}", _san_sig(key[2]),
+                                [_san_sig(s) for s in group])
+        group.append(key[2])
         _grad_jit_cache[key] = e
     else:
         _mstats.GRAD_JIT_HIT.add()
@@ -626,6 +661,12 @@ def set_symbolic_dispatch(fn):
 from ..core.native import check_nan_inf as _nan_check  # noqa: E402
 from ..core.native import benchmark as _benchmark  # noqa: E402
 from ..core.native import eager_grad_jit as _eager_grad_jit  # noqa: E402
+# FLAGS_sanitize (ISSUE 8): donation-after-use guard on Tensor host reads
+# + recompile explainer on grad-jit cache misses; one list-index check
+# per hook while unset
+from ..core.native import sanitize as _sanitize  # noqa: E402
+from ..analysis.sanitizers import check_host_read as _san_check_read  # noqa: E402
+from ..analysis.sanitizers import note_recompile as _san_note_recompile  # noqa: E402
 # Observability hooks (paddle_tpu.monitor): stat handles are pre-created
 # module attributes so the idle dispatch path pays one counter add; span
 # timing and FLAGS_benchmark accumulation are gated on shared cells.

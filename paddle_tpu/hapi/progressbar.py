@@ -15,10 +15,12 @@ class ProgressBar:
         self.file = file
         self._values = {}
         self._seen = 0
-        self._start = time.time()
+        # monotonic: elapsed/ms-per-step math must not go negative or
+        # jump on an NTP step (graftlint GL008)
+        self._start = time.monotonic()
 
     def update(self, current_num, values=None):
-        now = time.time()
+        now = time.monotonic()
         values = values or []
         for k, v in values:
             self._values[k] = v

@@ -251,12 +251,12 @@ def estimate_slot_bytes(sample, batch_size: int,
     except _NotShmable:
         total = 0
     est = int(total * max(1, batch_size) * headroom)
-    env = os.environ.get("FLAGS_shm_slot_bytes")
-    if env:
-        try:
-            return max(int(env), 4096)
-        except ValueError:
-            pass
+    # FLAGS_shm_slot_bytes rides the core/native cell (not a raw env
+    # read) so set_flags can override it after import
+    from ..core.native import shm_slot_bytes as _slot_bytes_flag
+
+    if _slot_bytes_flag[0]:
+        return max(int(_slot_bytes_flag[0]), 4096)
     return max(floor, est)
 
 

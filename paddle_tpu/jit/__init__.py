@@ -25,7 +25,9 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ..analysis import sanitizers as _san
 from ..core.native import fast_step as _fast_step
+from ..core.native import sanitize as _sanitize
 from ..framework.core import AsyncLoss, Parameter, Tensor
 from ..nn.layer.layers import Layer
 from ..resilience import faults as _faults
@@ -243,6 +245,9 @@ class TrainStep:
         self._buffer_tensors: Dict[str, Tensor] = {}
         self._lr_cache = (None, None)
         self._slots_dirty = False
+        # FLAGS_sanitize: batch aval signatures already compiled — a new
+        # one is a recompile; the explainer names the differing leaf
+        self._batch_sigs: list = []
 
     def _build(self):
         model = self.model
@@ -347,9 +352,16 @@ class TrainStep:
         buffers = {k: b._data for k, b in self.model.named_buffers() if b is not None}
         lr = self.optimizer.get_lr()
         arr_batch = _tree_tensor_to_array(batch)
+        donated = None
+        if _sanitize[0]:
+            self._note_batch_sig(arr_batch)
+            donated = (params, {k: list(v)
+                                for k, v in self._slot_values.items()})
         new_params, new_slots, new_buffers, loss, self.sentinel_state = \
             self._compiled(params, self._slot_values, buffers, lr, arr_batch,
                            self.sentinel_state)
+        if donated is not None:
+            _san.tombstone_tree(donated)
         for k in self._param_names:
             self._params[k]._data = new_params[k]
             self._slot_values[k] = new_slots[k]
@@ -378,10 +390,18 @@ class TrainStep:
             # fresh host->device transfer every step
             self._lr_cache = (lr, jnp.float32(lr))
         arr_batch = _tree_tensor_to_array(batch)
+        donated = None
+        if _sanitize[0]:
+            self._note_batch_sig(arr_batch)
+            donated = (params, {k: list(v)
+                                for k, v in self._slot_values.items()},
+                       buffers)
         new_params, new_slots, new_buffers, loss, self.sentinel_state = \
             self._compiled_fast(params, self._slot_values, buffers,
                                 self._lr_cache[1], arr_batch,
                                 self.sentinel_state)
+        if donated is not None:
+            _san.tombstone_tree(donated)
         for k in self._param_names:
             self._params[k]._data = new_params[k]
             self._slot_values[k] = new_slots[k]
@@ -393,6 +413,17 @@ class TrainStep:
             out.health = {"trip": self.sentinel_state["last_trip"],
                           "trips": self.sentinel_state["trips"]}
         return out
+
+    def _note_batch_sig(self, arr_batch):
+        """FLAGS_sanitize recompile explainer: a batch aval signature not
+        seen before means jax recompiles the step — diff it against the
+        nearest compiled one and emit a sanitize.recompile span."""
+        sig = _san.aval_signature(arr_batch)
+        if sig in self._batch_sigs:
+            return
+        if self._batch_sigs:
+            _san.note_recompile("TrainStep", sig, self._batch_sigs)
+        self._batch_sigs.append(sig)
 
     def sync(self):
         """Flush lazily-deferred state mirrors (optimizer slot dicts) so

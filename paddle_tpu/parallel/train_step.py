@@ -21,8 +21,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis import sanitizers as _san
 from ..core import native as _native
 from ..core.native import fast_step as _fast_step
+from ..core.native import sanitize as _sanitize
 from ..framework.core import AsyncLoss as _AsyncLoss
 from ..monitor import benchmark as _bench
 from ..monitor import stats as _mstats
@@ -244,6 +246,12 @@ _OPTS = {
     "lamb": (pure_lamb_init, pure_lamb_update),
     "lars": (pure_lars_init, pure_lars_update),
 }
+
+
+def _san_batch_sig(sig):
+    """Batch aval sig -> sanitizers leaf-signature format."""
+    return tuple((str(i), shape, dtype, False)
+                 for i, (shape, dtype) in enumerate(sig))
 
 
 def _pmean_in_bwd(axes):
@@ -577,9 +585,18 @@ class DistributedTrainStep:
         if sig in self._seen_batch_avals:
             _mstats.JIT_CACHE_HIT.add()
         else:
+            if _sanitize[0] and self._seen_batch_avals:
+                # recompile explainer (FLAGS_sanitize): name the batch
+                # leaf whose aval churned vs the nearest compiled sig
+                _san.note_recompile(
+                    "DistributedTrainStep", _san_batch_sig(sig),
+                    [_san_batch_sig(s) for s in self._seen_batch_avals])
             self._seen_batch_avals.add(sig)
             _mstats.JIT_CACHE_MISS.add()
             _mstats.JIT_COMPILE.add()
+        donated = (self.params, self.opt_state,
+                   self.aux if self._has_aux else None) \
+            if _sanitize[0] else None
         with _trace_span("DistributedTrainStep.step", cat="step",
                          args={"step": self._step_count}):
             with self.mesh:
@@ -587,6 +604,8 @@ class DistributedTrainStep:
                  self.scaler_state, self.sentinel_state) = self._step(
                     self.params, self.opt_state, self.aux, batch, lr,
                     self.scaler_state, self.sentinel_state)
+        if donated is not None:
+            _san.tombstone_tree(donated)
         self._step_count += 1
         _mstats.TRAIN_STEPS.add()
         if _fast_step[0]:

@@ -108,6 +108,11 @@ class TrainGuardian:
         self._rollbacks = 0
         self._preempted = False
         self._prev_sigterm = None
+        # _last_beat is written by BOTH the training thread (_beat) and
+        # the watchdog thread (stall re-arm) — graftlint GL003; the lock
+        # also makes the read-compare-rearm in the watchdog atomic so a
+        # beat landing mid-check cannot be overwritten by the re-arm
+        self._beat_lock = threading.Lock()
         self._last_beat = time.monotonic()
         self._watchdog = None
         self._watchdog_stop = threading.Event()
@@ -343,8 +348,10 @@ class TrainGuardian:
 
     # -- watchdog -------------------------------------------------------------
     def _beat(self) -> None:
-        self._last_beat = time.monotonic()
-        _mstats.GUARDIAN_HEARTBEAT_MS.set(int(self._last_beat * 1e3))
+        now = time.monotonic()
+        with self._beat_lock:
+            self._last_beat = now
+        _mstats.GUARDIAN_HEARTBEAT_MS.set(int(now * 1e3))
 
     def _start_watchdog(self) -> None:
         if self._watchdog is not None:
@@ -359,11 +366,15 @@ class TrainGuardian:
         timeout = float(self.watchdog_timeout)
         poll = max(0.02, min(timeout / 4.0, 0.25))
         while not self._watchdog_stop.wait(poll):
-            if time.monotonic() - self._last_beat <= timeout:
+            now = time.monotonic()
+            with self._beat_lock:
+                stalled = now - self._last_beat > timeout
+                if stalled:
+                    self._last_beat = now       # one report per stall
+            if not stalled:
                 continue
             _mstats.WATCHDOG_STALLS.add()
             self._dump_stall()
-            self._last_beat = time.monotonic()  # one report per stall
 
     def _dump_stall(self) -> None:
         """Stack dump + trace flush for a stalled step."""

@@ -229,6 +229,14 @@ class InferenceEngine:
                  int8_weights: bool = False, paged: Optional[bool] = None,
                  block_size: int = 16, n_blocks: Optional[int] = None,
                  prefill_chunk: int = 64, tps_window_ticks: int = 64):
+        if getattr(cfg, "fused_mlp", None) is None:
+            # pin the fused-MLP choice NOW (graftlint GL002): prefill
+            # programs compile lazily per prompt-length bucket, so a
+            # FLAGS_fused_kernels flip mid-serving would otherwise split
+            # the engine across fused and unfused programs per bucket
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, fused_mlp=bool(native.fused_kernels[0]))
         self.cfg = cfg
         self._params = jax.device_put(params)
         self.int8_weights = bool(int8_weights)

@@ -8,8 +8,10 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.framework.enforce import (
-    InvalidArgumentError, OutOfRangeError, check_axis, check_dtype,
-    check_type, enforce)
+    AlreadyExistsError, EnforceNotMet, InvalidArgumentError, NotFoundError,
+    OutOfRangeError, PreconditionNotMetError, TypeEnforceError,
+    UnavailableError, UnimplementedError, check_axis,
+    check_shape_broadcast, check_dtype, check_type, enforce)
 
 
 def _t(x):
@@ -43,6 +45,108 @@ class TestEnforcePrimitives:
         assert check_axis(-1, 3, "op") == 2
         with pytest.raises(OutOfRangeError, match="range"):
             check_axis(3, 3, "op")
+
+
+class TestErrorPaths:
+    """Message formatting + nested-check unwinding (ISSUE 8 satellite:
+    every category renders its prefix, hints are optional, and an enforce
+    raised while handling another error keeps the causal chain)."""
+
+    def test_every_category_prefixes_its_message(self):
+        cases = [
+            (InvalidArgumentError, "InvalidArgumentError"),
+            (NotFoundError, "NotFoundError"),
+            (OutOfRangeError, "OutOfRangeError"),
+            (AlreadyExistsError, "AlreadyExistsError"),
+            (PreconditionNotMetError, "PreconditionNotMetError"),
+            (UnimplementedError, "UnimplementedError"),
+            (UnavailableError, "UnavailableError"),
+        ]
+        for cls, prefix in cases:
+            err = cls("boom")
+            assert str(err).startswith(f"{prefix}: boom") \
+                or prefix in str(err), (cls, str(err))
+            assert isinstance(err, EnforceNotMet)
+
+    def test_hint_only_rendered_when_given(self):
+        assert "[Hint:" not in str(InvalidArgumentError("msg"))
+        e = InvalidArgumentError("msg", hint="try the other thing")
+        assert "[Hint: try the other thing]" in str(e)
+
+    def test_builtin_subclassing_matrix(self):
+        assert issubclass(NotFoundError, KeyError)
+        assert issubclass(AlreadyExistsError, ValueError)
+        assert issubclass(PreconditionNotMetError, RuntimeError)
+        assert issubclass(UnimplementedError, NotImplementedError)
+        assert issubclass(UnavailableError, RuntimeError)
+        assert issubclass(TypeEnforceError, TypeError)
+
+    def test_enforce_custom_exception_class(self):
+        with pytest.raises(PreconditionNotMetError, match="not ready"):
+            enforce(False, "not ready", exc=PreconditionNotMetError)
+        enforce(True, "never raised", exc=PreconditionNotMetError)
+
+    def test_nested_check_unwinding_keeps_cause_chain(self):
+        """An enforce failure raised while unwinding another check keeps
+        __context__/__cause__ so the original violation stays visible."""
+        try:
+            try:
+                check_axis(9, 2, "inner_op")
+            except OutOfRangeError as inner:
+                raise PreconditionNotMetError(
+                    "outer recovery also failed",
+                    hint="inner check already tripped") from inner
+        except PreconditionNotMetError as outer:
+            assert isinstance(outer.__cause__, OutOfRangeError)
+            assert "inner_op" in str(outer.__cause__)
+            assert "[Hint: inner check already tripped]" in str(outer)
+        else:
+            pytest.fail("no raise")
+
+    def test_nested_context_preserved_without_from(self):
+        try:
+            try:
+                check_type("x", "n", int, "op_a")
+            except TypeError:
+                check_dtype("int8", "x", ["float32"], "op_b")
+        except InvalidArgumentError as e:
+            assert isinstance(e.__context__, TypeEnforceError)
+            assert "op_a" in str(e.__context__) and "op_b" in str(e)
+        else:
+            pytest.fail("no raise")
+
+    def test_check_type_tuple_of_types_message(self):
+        with pytest.raises(TypeError, match="int/float"):
+            check_type("3", "n", (int, float), "op")
+
+    def test_check_dtype_strips_framework_prefixes(self):
+        check_dtype("paddle.float32", "x", ["float32"], "op")
+        check_dtype("jax.numpy.float32", "x", ["float32"], "op")
+        check_dtype("numpy.float32", "x", ["float32"], "op")
+        with pytest.raises(InvalidArgumentError, match="received int8"):
+            check_dtype("paddle.int8", "x", ["float32"], "op")
+
+    def test_check_axis_type_and_bounds_messages(self):
+        with pytest.raises(TypeError, match="must be int"):
+            check_axis("0", 3, "op")
+        with pytest.raises(OutOfRangeError) as ei:
+            check_axis(-4, 3, "op")
+        assert "[-3, 3)" in str(ei.value)
+        assert "[Hint: the input has 3 dimensions]" in str(ei.value)
+
+    def test_check_shape_broadcast_paths(self):
+        check_shape_broadcast((3, 1, 4), (2, 4), "op")   # compatible
+        with pytest.raises(InvalidArgumentError) as ei:
+            check_shape_broadcast((3, 5), (3, 4), "op")
+        msg = str(ei.value)
+        assert "op" in msg and "[3, 5]" in msg and "[3, 4]" in msg
+        assert "[Hint: each trailing dimension must match or be 1]" in msg
+
+    def test_keyerror_str_quirk_documented(self):
+        # NotFoundError subclasses KeyError, whose str() reprs its arg —
+        # the category prefix must survive that quirk
+        e = NotFoundError("no such thing")
+        assert "NotFoundError" in str(e)
 
 
 class TestWiredValidation:

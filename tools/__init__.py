@@ -1,0 +1,1 @@
+"""Repo tooling (trace_report, graftlint, exp_* drivers)."""

@@ -1,0 +1,108 @@
+"""graftlint — framework-aware static analysis for the paddle_tpu tree.
+
+    python -m tools.graftlint [paths ...]
+        [--baseline tools/graftlint_baseline.json] [--json]
+        [--rules GL001,GL003] [--list-rules]
+
+Runs the AST lint suite (paddle_tpu.analysis: trace hazards, flag
+captures, thread races, lock-order cycles, gauge/flag/clock/API
+invariants — rule catalogue in ``paddle_tpu/analysis/__init__.py``) over
+the given paths (default ``paddle_tpu``) and exits non-zero when any
+finding is NOT covered by the baseline suppression file. Baseline
+entries are ``{"fingerprint": ..., "reason": ...}`` — a suppression
+without a reason is itself an error, and stale fingerprints (suppressing
+nothing) are reported so the baseline only shrinks.
+
+Exit codes: 0 clean (vs baseline), 1 new findings, 2 bad baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis.lint import (  # noqa: E402
+    Baseline, RULE_DOCS, run_lint)
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: paddle_tpu)")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: "
+                         "tools/graftlint_baseline.json when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to report (default all)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULE_DOCS):
+            print(f"{rid}  {RULE_DOCS[rid]}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "paddle_tpu")]
+    findings = run_lint(paths, root=_REPO)
+    if args.rules:
+        keep = {r.strip().upper() for r in args.rules.split(",")}
+        findings = [f for f in findings if f.rule in keep]
+
+    baseline = None
+    bl_path = args.baseline
+    if bl_path is None and not args.no_baseline:
+        cand = os.path.join(_REPO, DEFAULT_BASELINE)
+        bl_path = cand if os.path.exists(cand) else None
+    if bl_path is not None and not args.no_baseline:
+        try:
+            baseline = Baseline.load(bl_path)
+        except (OSError, ValueError) as e:
+            print(f"graftlint: cannot load baseline {bl_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        errs = baseline.validate()
+        if errs:
+            for e in errs:
+                print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+
+    if baseline is not None:
+        new, suppressed, stale = baseline.split(findings)
+    else:
+        new, suppressed, stale = findings, [], []
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_suppressions": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        if suppressed:
+            print(f"graftlint: {len(suppressed)} finding(s) suppressed by "
+                  f"baseline", file=sys.stderr)
+        for fp in stale:
+            print(f"graftlint: stale baseline entry (matches nothing): "
+                  f"{fp}", file=sys.stderr)
+        if not new:
+            print(f"graftlint: clean ({len(findings)} total, "
+                  f"{len(suppressed)} baselined)", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

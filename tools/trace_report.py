@@ -137,6 +137,47 @@ def overlap_report(rows: list, file=None) -> dict:
     return out
 
 
+def recompile_report(events: list, file=None, top: int = 5) -> dict:
+    """Recompile-causes verdict from the ``sanitize.recompile`` spans
+    (ISSUE 8, FLAGS_sanitize).
+
+    Each span names the cache group (grad_jit:<op> / TrainStep /
+    DistributedTrainStep) and the LEAF whose (shape, dtype, weak-type)
+    signature differed from the nearest already-compiled entry. Grouped
+    by (group, leaf) they answer the question GRAD_JIT_MISS alone
+    cannot: WHICH input keeps churning — a shape-unstable data loader, a
+    dtype flip, a python-scalar arg retraced per value."""
+    recs = [e for e in events if e.get("name") == "sanitize.recompile"]
+    if not recs:
+        return {}
+    agg: dict = {}   # (group, leaf) -> [count, kinds, example]
+    for e in recs:
+        a = e.get("args") or {}
+        key = (a.get("group", "?"), a.get("leaf", "?"))
+        r = agg.setdefault(key, [0, set(), ""])
+        r[0] += 1
+        r[1].add(a.get("kind", "?"))
+        r[2] = f"{a.get('had', '?')} -> {a.get('got', '?')}"
+    causes = sorted(
+        ({"group": g, "leaf": leaf, "count": c, "kinds": sorted(k),
+          "example": ex} for (g, leaf), (c, k, ex) in agg.items()),
+        key=lambda r: -r["count"])[:top]
+    worst = causes[0]
+    out = {"recompiles": len(recs), "causes": causes,
+           "verdict": (f"recompile churn: {len(recs)} explained "
+                       f"recompile(s); top cause is {worst['group']} "
+                       f"{worst['leaf']} ({'/'.join(worst['kinds'])}: "
+                       f"{worst['example']}) — stabilize that input "
+                       "(pad/bucket shapes, pin dtypes, pass scalars as "
+                       "arrays)")}
+    print("\nRecompile causes:", file=file)
+    for r in causes:
+        print(f"  {r['group']:<28}{r['leaf']:<12}{r['count']:>6}x  "
+              f"{'/'.join(r['kinds'])}: {r['example']}", file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
 def _prefill_starvation(events: list) -> dict:
     """Max consecutive scheduler ticks in which chunked prefill ran while
     open decode streams got no decode step (ISSUE 7).
@@ -321,6 +362,7 @@ def main(argv=None):
     overlap_report(rows)
     serving_report(rows, events=events)
     resilience_report(events, rows)
+    recompile_report(events)
     return rows
 
 
