@@ -17,6 +17,10 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from .core import native as _native_flags
+
+_native_flags.apply_shardy_flag()  # FLAGS_shardy: sdy partitioner dialect
+
 from .framework import dtype as _dtype_mod
 from .framework.dtype import (
     bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,

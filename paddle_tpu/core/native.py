@@ -184,6 +184,48 @@ fault_inject_watchers: list = []
 sanitize = [_truthy(os.environ.get("FLAGS_sanitize", "0"))]
 
 
+# FLAGS_shardy (ISSUE 9): lower shardings through the Shardy (sdy)
+# partitioner dialect instead of legacy GSPMD mhlo.sharding strings —
+# axis NAMES survive into the lowered module (`sdy.sharding_constraint
+# <@mesh, [{"data"}, {"model"}]>`), which is what fleet.auto.explain
+# debugging and the assert-on-HLO tests read. Default ON; flip to 0 to
+# fall back to the legacy partitioner (the compiled HLO is equivalent —
+# partitioning happens at compile time either way).
+shardy = [_truthy(os.environ.get("FLAGS_shardy", "1"))]
+
+
+def apply_shardy_flag() -> None:
+    """Push the cell value into jax's global lowering config (called at
+    paddle_tpu import and from set_flags)."""
+    try:
+        import jax
+
+        jax.config.update("jax_use_shardy_partitioner", bool(shardy[0]))
+    except Exception:  # noqa: BLE001 — older jax without the option
+        pass
+
+
+@contextmanager
+def shardy_disabled():
+    """Trace/lower with the legacy GSPMD partitioner regardless of
+    FLAGS_shardy. Needed around host-callback ops (jax.pure_callback /
+    jax.debug.print): jax 0.4.x's callback lowering predates Shardy and
+    dies with `'OpSharding' object has no attribute 'build'` when the sdy
+    dialect is active."""
+    try:
+        import jax
+
+        prev = bool(jax.config.jax_use_shardy_partitioner)
+    except Exception:  # noqa: BLE001
+        yield
+        return
+    try:
+        jax.config.update("jax_use_shardy_partitioner", False)
+        yield
+    finally:
+        jax.config.update("jax_use_shardy_partitioner", prev)
+
+
 def _int_or_zero(value) -> int:
     try:
         return int(str(value))
@@ -226,6 +268,9 @@ def set_flag(name: str, value) -> None:
             watcher(fault_inject[0])
     elif name.endswith("sanitize"):
         sanitize[0] = _truthy(value)
+    elif name.endswith("shardy"):
+        shardy[0] = _truthy(value)
+        apply_shardy_flag()
     elif name.endswith("shm_slot_bytes"):
         shm_slot_bytes[0] = _int_or_zero(value)
     if _lib is not None:

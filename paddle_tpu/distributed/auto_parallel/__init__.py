@@ -37,7 +37,24 @@ from ...framework.core import Tensor
 from ...parallel.mesh import AXES, get_mesh, set_mesh
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "set_shard_mask",
-           "set_offload_device", "set_pipeline_stage", "get_default_mesh"]
+           "set_offload_device", "set_pipeline_stage", "get_default_mesh",
+           "plan", "explain"]
+
+
+def plan(*args, **kwargs):
+    """Bridge to the fleet.auto cost-model planner (ISSUE 9) — the
+    reference exposes its planner under auto_parallel; ours lives in
+    distributed/fleet/auto (one implementation, two entry points)."""
+    from ..fleet import auto as _auto
+
+    return _auto.plan(*args, **kwargs)
+
+
+def explain(*args, **kwargs):
+    """Print the ranked candidate table of the latest fleet.auto plan."""
+    from ..fleet import auto as _auto
+
+    return _auto.explain(*args, **kwargs)
 
 # dim-name defaults by mesh arity; chosen so the data axis always exists
 # (DistributedTrainStep shards batches over ("data", "sharding")) and a 2-D

@@ -3,6 +3,7 @@ from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.fleet_base import Fleet, fleet  # noqa: F401
 from .base.topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import auto  # noqa: F401  (fleet.auto — hybrid-parallel planner)
 from .utils.recompute import recompute  # noqa: F401
 from .utils.fs import HDFSClient, LocalFS  # noqa: F401
 from .base.fleet_base import Role, UtilBase  # noqa: F401

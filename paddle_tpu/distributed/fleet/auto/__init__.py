@@ -1,0 +1,36 @@
+"""fleet.auto — cost-model hybrid-parallel planner (ISSUE 9).
+
+The reference's headline Fleet capability is hybrid parallelism from one
+config: dp x mp x pp x ZeRO (PAPER.md layer map `distributed/fleet`,
+`auto_parallel`). This package is the subsystem that PICKS the config:
+
+- :mod:`.cost_model` — closed-form per-candidate estimates: per-device HBM
+  (params/grads/optimizer state under ZeRO-0/1/2/3, pipeline/TP splits,
+  activation working set), pipeline bubble fraction ``(S-1)/T``, and
+  collective bytes per step; plus the legal-candidate enumerator.
+- :mod:`.planner` — :func:`plan` ranks the candidates (fastest estimated
+  step among those that fit per-chip HBM) into a :class:`ParallelPlan`
+  (mesh dims over ("data","sharding","pipe","model"), microbatch count,
+  ZeRO level, remat/schedule policy); :func:`explain` prints the ranked
+  table of the latest plan.
+- :mod:`.zero` — :class:`ShardedOptimizer`: ZeRO-2/3 as a first-class
+  optimizer wrapper consumed by ``parallel.DistributedTrainStep``
+  (reduce-scatter grads / 1-Nth-sharded moments and params expressed as
+  PartitionSpecs; XLA inserts the collectives).
+
+Activation: ``fleet.init(strategy={"auto": True})`` defers the mesh to the
+first engine build, where the planner sees the model; unmodified hapi /
+fleet scripts then train under the chosen plan (pipeline microbatching
+runs the in-jit 1F1B schedule of ``parallel.pipeline.pipeline_1f1b``).
+
+Everything in this package runs at trace-build time on the host — no
+device arrays, no jit sinks (pinned by tests/test_fleet_auto.py).
+"""
+from .cost_model import (HardwareSpec, ModelStats, PlanCandidate,  # noqa: F401
+                         enumerate_plans, estimate)
+from .planner import ParallelPlan, explain, last_plan, plan  # noqa: F401
+from .zero import ShardedOptimizer  # noqa: F401
+
+__all__ = ["HardwareSpec", "ModelStats", "PlanCandidate", "enumerate_plans",
+           "estimate", "ParallelPlan", "plan", "explain", "last_plan",
+           "ShardedOptimizer"]

@@ -1,0 +1,213 @@
+"""fleet.auto planner — pick a complete hybrid-parallel plan from
+(model, batch, topology) and make it runnable.
+
+The reference's ``strategy.auto`` routes through its auto-parallel
+completion/partitioner stack; here the equivalent artifact is a
+:class:`ParallelPlan`: the 4-axis mesh shape, the ZeRO level, the
+microbatch count and the remat/schedule policy, all chosen by ranking the
+legal candidates of :mod:`.cost_model` — fastest estimated step among the
+ones that fit per-chip HBM. The plan then installs the process mesh
+(parallel.mesh.create_mesh + fleet/env registration), and FleetEngine /
+DistributedTrainStep consume its fields (zero level, n_micro, 1F1B
+schedule) when ``fleet.init(strategy={"auto": True})`` is active.
+
+The whole planner runs at TRACE-BUILD time on the host: nothing here may
+touch device values (pinned by the GL001 host-sync taint test in
+tests/test_fleet_auto.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ....monitor import stats as _mstats
+from .cost_model import (HardwareSpec, ModelStats, PlanCandidate,
+                         enumerate_plans, estimate)
+
+__all__ = ["ParallelPlan", "plan", "explain", "last_plan"]
+
+_LAST_PLAN: Optional["ParallelPlan"] = None
+
+
+def _fmt_bytes(n: float) -> str:
+    neg = "-" if n < 0 else ""
+    n = abs(float(n))
+    for unit, div in (("G", 2 ** 30), ("M", 2 ** 20), ("K", 2 ** 10)):
+        if n >= div:
+            return f"{neg}{n / div:.1f}{unit}"
+    return f"{neg}{n:.0f}B"
+
+
+@dataclasses.dataclass
+class ParallelPlan:
+    """A complete, installable hybrid-parallel execution plan."""
+
+    dp: int
+    sharding: int
+    pp: int
+    mp: int
+    n_micro: int
+    zero: int
+    remat: bool
+    schedule: str                       # "1f1b" | "fill_drain"
+    stats: ModelStats
+    hardware: HardwareSpec
+    chosen: PlanCandidate
+    candidates: List[PlanCandidate]     # ranked, fitting first
+    global_batch: int
+
+    @property
+    def mesh_dims(self) -> Dict[str, int]:
+        return {"data": self.dp, "sharding": self.sharding,
+                "pipe": self.pp, "model": self.mp}
+
+    def create_mesh(self):
+        """Build + install the 4-axis Fleet mesh for this plan and
+        register it with the fleet facade/env (so facade calls and hcg
+        queries agree with the planner's choice)."""
+        from ....parallel.mesh import create_mesh
+
+        mesh = create_mesh(dp=self.dp, sharding=self.sharding, pp=self.pp,
+                           mp=self.mp)
+        try:
+            from ... import env as _env
+            from ..base.fleet_base import fleet as _fleet
+            from ..base.topology import (CommunicateTopology,
+                                         HybridCommunicateGroup)
+
+            topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                       (self.dp, self.pp, self.sharding,
+                                        self.mp))
+            hcg = HybridCommunicateGroup(topo, _env.get_rank())
+            _fleet._mesh = mesh
+            _fleet._topology = topo
+            _fleet._hcg = hcg
+            _env.set_state(initialized=True, mesh=mesh, topology=topo,
+                           hcg=hcg)
+        except Exception:  # standalone use without the facade initialised
+            pass
+        return mesh
+
+    # -- reporting -----------------------------------------------------------
+    def table(self, top: int = 10) -> str:
+        """Ranked candidate table (the ``explain`` payload)."""
+        hdr = (f"{'rank':<5}{'dp':>4}{'sh':>4}{'pp':>4}{'mp':>4}"
+               f"{'micro':>6}{'zero':>5}{'hbm/dev':>10}{'bubble':>8}"
+               f"{'coll':>10}{'score':>11}  fit")
+        lines = [hdr, "-" * len(hdr)]
+        for i, c in enumerate(self.candidates[:top]):
+            mark = " <== chosen" if c is self.chosen else ""
+            lines.append(
+                f"{i:<5}{c.dp:>4}{c.sharding:>4}{c.pp:>4}{c.mp:>4}"
+                f"{c.n_micro:>6}{c.zero:>5}"
+                f"{_fmt_bytes(c.hbm_bytes):>10}{c.bubble_frac:>8.3f}"
+                f"{_fmt_bytes(c.coll_bytes):>10}{c.score * 1e3:>9.4f}ms"
+                f"  {'yes' if c.fits else 'NO (' + c.why + ')'}{mark}")
+        return "\n".join(lines)
+
+    def explain(self, top: int = 10, file=None) -> str:
+        budget = int(self.hardware.hbm_bytes * self.hardware.hbm_fudge)
+        head = (f"fleet.auto plan over {self.dp * self.sharding * self.pp * self.mp} "
+                f"device(s), global_batch={self.global_batch}, "
+                f"params={_fmt_bytes(self.stats.param_bytes)}, "
+                f"HBM budget={_fmt_bytes(budget)}/device\n"
+                f"chosen: {self.chosen.describe()} schedule={self.schedule} "
+                f"remat={self.remat} (headroom "
+                f"{_fmt_bytes(budget - self.chosen.hbm_bytes)})")
+        text = head + "\n" + self.table(top)
+        print(text, file=file)
+        return text
+
+
+def plan(params=None, *, stats: Optional[ModelStats] = None,
+         global_batch: int, n_devices: Optional[int] = None,
+         hardware: Optional[HardwareSpec] = None,
+         param_specs=None, layers: Optional[int] = None,
+         seq_len: int = 1, hidden: int = 0,
+         allow_mp: Optional[bool] = None,
+         zero_levels=(0, 1, 2, 3), max_micro: int = 64,
+         constraints: Optional[Dict[str, int]] = None,
+         schedule: str = "1f1b") -> ParallelPlan:
+    """Enumerate legal candidates, estimate each, pick the fastest that
+    fits per-chip HBM.
+
+    Raises ``ValueError`` when NO candidate fits (the error carries the
+    closest candidate's shortfall — the actionable number).
+    """
+    import jax
+
+    if stats is None:
+        if params is None:
+            raise ValueError("plan() needs `params` or `stats`")
+        stats = ModelStats.from_params(params, specs=param_specs,
+                                       layers=layers, hidden=hidden,
+                                       seq_len=seq_len)
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    hw = hardware or HardwareSpec()
+    if allow_mp is None:
+        allow_mp = stats.tp_bytes > 0
+
+    cands = enumerate_plans(n_devices, global_batch, stats,
+                            zero_levels=zero_levels, allow_mp=allow_mp,
+                            max_micro=max_micro, constraints=constraints)
+    if not cands:
+        raise ValueError(
+            f"no legal (dp, sharding, pp, mp, n_micro) factorisation for "
+            f"{n_devices} devices / global_batch={global_batch} / "
+            f"layers={stats.layers} (constraints={constraints})")
+    for c in cands:
+        estimate(c, stats, global_batch, hw)
+    # fastest fitting plan first. Scores are bucketed at 2% of the best —
+    # the model's resolution ends well before that — and ties within a
+    # bucket resolve to the simpler topology (less pipe, less tp, less
+    # sharding, more dp: fewer moving parts for the same speed).
+    # Non-fitting candidates rank after every fitting one, by smallest
+    # HBM overshoot (the explain() table then reads as "what was close").
+    fitting = [c for c in cands if c.fits]
+    eps = 0.02 * min((c.score for c in fitting), default=1.0)
+
+    def key(c):
+        rank = (int(c.score / eps) if eps > 0 else 0) if c.fits \
+            else c.hbm_bytes
+        return (not c.fits, rank, c.pp, c.mp, c.sharding, -c.dp)
+
+    cands.sort(key=key)
+    chosen = cands[0]
+    if not chosen.fits:
+        raise ValueError(
+            "fleet.auto: no plan fits per-device HBM "
+            f"({int(hw.hbm_bytes * hw.hbm_fudge) / 2**30:.2f} GiB usable); "
+            f"closest is {chosen.describe()} needing "
+            f"{chosen.hbm_bytes / 2**30:.2f} GiB — add devices, raise the "
+            "ZeRO level ceiling, or shrink the per-replica batch")
+
+    p = ParallelPlan(
+        dp=chosen.dp, sharding=chosen.sharding, pp=chosen.pp, mp=chosen.mp,
+        n_micro=chosen.n_micro, zero=chosen.zero, remat=chosen.remat,
+        schedule=schedule if chosen.pp > 1 else "none",
+        stats=stats, hardware=hw, chosen=chosen, candidates=cands,
+        global_batch=global_batch)
+
+    budget = int(hw.hbm_bytes * hw.hbm_fudge)
+    _mstats.PLAN_CANDIDATES_CONSIDERED.add(len(cands))
+    _mstats.ZERO_LEVEL.set(chosen.zero)
+    _mstats.PIPELINE_BUBBLE_FRAC.set(int(chosen.bubble_frac * 1e6))
+    _mstats.PLANNER_HBM_HEADROOM_BYTES.set(budget - chosen.hbm_bytes)
+
+    global _LAST_PLAN
+    _LAST_PLAN = p
+    return p
+
+
+def last_plan() -> Optional[ParallelPlan]:
+    return _LAST_PLAN
+
+
+def explain(top: int = 10, file=None) -> str:
+    """Print the ranked candidate table of the most recent plan()."""
+    if _LAST_PLAN is None:
+        msg = "fleet.auto: no plan computed yet (call fleet.auto.plan first)"
+        print(msg, file=file)
+        return msg
+    return _LAST_PLAN.explain(top=top, file=file)

@@ -1,0 +1,79 @@
+"""ZeRO-2/3 optimizer-state sharding as a first-class optimizer wrapper.
+
+Parity: the reference's DygraphShardingOptimizer / sharding stage 2-3
+(fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:90
+greedy param partition + hand-inserted reduce-scatter / all-gather /
+broadcast ops). TPU-native, the partition IS a PartitionSpec: moments (and
+at level 3 the params themselves) carry the "sharding" mesh axis in their
+storage specs, and XLA derives the exact collective sequence the reference
+coded by hand — gradients hit a reduce-scatter at the spec boundary
+(level >= 2), the updated shards are all-gathered where the next forward
+consumes them (level 3). Rajbhandari et al. (ZeRO, 2020) levels:
+
+  1: optimizer state 1/Nth per device      (DistributedTrainStep default)
+  2: + gradients reduce-scattered           (grads pinned to the shard spec)
+  3: + parameters stored 1/Nth per device   (param storage spec sharded)
+
+``ShardedOptimizer`` bundles a pure optimizer (init_fn, update_fn) with the
+level; ``DistributedTrainStep(optimizer=ShardedOptimizer("adamw", level=3))``
+applies the spec policy. State-dicts round-trip through the existing
+checkpoint paths unchanged: sharded arrays gather on host read and a load
+device_puts them back through the sharded NamedSharding (layout, not
+content).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+__all__ = ["ShardedOptimizer"]
+
+_KNOWN = ("adamw", "sgd", "momentum", "lamb", "lars")
+
+
+class ShardedOptimizer:
+    """Wrap a pure optimizer with a ZeRO partition level.
+
+    Args:
+      inner: optimizer name ("adamw", "lamb", ...) or an
+        ``(init_fn, update_fn)`` pair with the pure-optimizer signature of
+        parallel.train_step.
+      level: ZeRO stage, 0..3 (see module docstring).
+      axis: mesh axis the states shard over (default "sharding").
+      **opt_kwargs: hyperparameters forwarded to every update call
+        (beta1, weight_decay, ...).
+    """
+
+    def __init__(self, inner="adamw", level: int = 2,
+                 axis: str = "sharding", **opt_kwargs):
+        if level not in (0, 1, 2, 3):
+            raise ValueError(f"ZeRO level must be 0..3, got {level}")
+        self.level = int(level)
+        self.axis = axis
+        self.opt_kwargs = dict(opt_kwargs)
+        if isinstance(inner, str):
+            from ....parallel.train_step import _OPTS
+
+            if inner not in _OPTS:
+                raise ValueError(
+                    f"unknown optimizer {inner!r}; known: {_KNOWN}")
+            self.name = inner
+            self._fns: Tuple[Callable, Callable] = _OPTS[inner]
+        else:
+            init_fn, update_fn = inner
+            self.name = getattr(update_fn, "__name__", "custom")
+            self._fns = (init_fn, update_fn)
+
+    @property
+    def init_fn(self) -> Callable:
+        return self._fns[0]
+
+    @property
+    def update_fn(self) -> Callable:
+        return self._fns[1]
+
+    def fns(self) -> Tuple[Callable, Callable]:
+        return self._fns
+
+    def __repr__(self):
+        return (f"ShardedOptimizer({self.name}, level={self.level}, "
+                f"axis={self.axis!r})")

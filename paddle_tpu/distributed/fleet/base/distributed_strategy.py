@@ -45,6 +45,10 @@ class DistributedStrategy:
         self.a_sync_configs = {"k_steps": -1}
         self.semi_auto = False
         self.auto = False
+        # fleet.auto planner knobs (ISSUE 9): hbm_bytes_per_device,
+        # seq_len/hidden hints, max_micro, zero_min_size, schedule, and
+        # dp/sharding/pp/mp/n_micro/zero pins
+        self.auto_configs = {}
         self.asp = False
         self.heter_ccl_mode = False
         self.hybrid_configs = {

@@ -45,13 +45,40 @@ class Fleet:
                 "(dp/mp/pp/sharding) instead — see README 'Parameter "
                 "server decision'")
         self._is_collective = is_collective
+        if isinstance(strategy, dict):
+            # `fleet.init(strategy={"auto": True})` shorthand (ISSUE 9):
+            # a plain dict of DistributedStrategy attribute overrides
+            d = dict(strategy)
+            strategy = DistributedStrategy()
+            for k, v in d.items():
+                setattr(strategy, k, v)
         self._strategy = strategy or DistributedStrategy()
         if getattr(self._strategy, "a_sync", False):
             raise NotImplementedError(
                 "DistributedStrategy.a_sync (async parameter server) is "
                 "not supported on TPU — see README 'Parameter server "
                 "decision'")
-        if getattr(self._strategy, "semi_auto", False) or \
+        if getattr(self._strategy, "auto", False) and \
+                not getattr(self._strategy, "semi_auto", False):
+            # full-auto (fleet.auto planner): the mesh depends on the
+            # MODEL, which init has not seen — defer it to the first
+            # engine build (FleetEngine._make_plan installs the planned
+            # mesh and re-registers topology/hcg through the plan)
+            from ...auto_parallel import get_default_mesh
+
+            pm = get_default_mesh()
+            if pm is not None:
+                self._mesh = pm.install()
+                ms = dict(self._mesh.shape)
+                dims = (ms["data"], ms["pipe"], ms["sharding"], ms["model"])
+            else:
+                self._mesh = None
+                from ....parallel.mesh import set_mesh
+
+                set_mesh(None)
+                dims = (1, 1, 1, 1)
+            dp, pp, sh, mp = dims
+        elif getattr(self._strategy, "semi_auto", False) or \
                 getattr(self._strategy, "auto", False):
             # semi-auto route (reference fleet_base.py:1423-1430): the mesh
             # comes from the user's ProcessMesh annotations, not

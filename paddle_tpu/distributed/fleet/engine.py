@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...framework.core import Tensor
 from ...framework.functional import functional_call, layer_buffers
+from ...monitor import trace as _mtrace
 from ...nn.clip import ClipGradByGlobalNorm
 from ...nn.layer.layers import Layer
 from ...parallel.mesh import get_mesh, mesh_shape
@@ -306,13 +307,9 @@ class FleetEngine:
 
     def __init__(self, model: Layer, optimizer, strategy, hcg=None,
                  loss_fn: Optional[Callable] = None, mesh=None, scaler=None,
-                 sentinel=None):
+                 sentinel=None, global_batch: Optional[int] = None):
         from .meta_parallel.pp_layers import PipelineLayer
 
-        self.mesh = mesh or get_mesh()
-        if self.mesh is None:
-            raise RuntimeError("FleetEngine needs a mesh (fleet.init first)")
-        shape = mesh_shape(self.mesh)
         self._model = model
 
         inner_model = model
@@ -322,6 +319,20 @@ class FleetEngine:
                 isinstance(getattr(inner_model, "_layers"), Layer):
             inner_model = inner_model._layers
         self._inner_model = inner_model
+
+        # strategy.auto (ISSUE 9): the fleet.auto planner picks the whole
+        # hybrid plan — mesh dims, ZeRO level, microbatch count, schedule
+        # — from the model + batch + device count, then installs the mesh
+        # it chose (fleet.init deferred it for exactly this moment)
+        self.plan = None
+        if getattr(strategy, "auto", False):
+            self.plan = self._make_plan(inner_model, strategy, global_batch)
+            self.mesh = self.plan.create_mesh()
+        else:
+            self.mesh = mesh or get_mesh()
+        if self.mesh is None:
+            raise RuntimeError("FleetEngine needs a mesh (fleet.init first)")
+        shape = mesh_shape(self.mesh)
 
         cfg = _optimizer_config(optimizer)
         pipe_deg = shape.get("pipe", 1)
@@ -363,7 +374,19 @@ class FleetEngine:
         # the eager nesting (k merge boundaries × acc microbatches each).
         self.accumulate_steps = int(pcfg.get("accumulate_steps", 1)) * \
             cfg["merge_k"]
+        # microbatch schedule: "FThenB" (the fill/drain scan, backward by
+        # autodiff) or "1F1B" (parallel.pipeline.pipeline_1f1b — the
+        # interleaved schedule computing grads inside one scan). The
+        # planner picks 1F1B; manual configs opt in via
+        # pipeline_configs={"schedule": "1F1B"}.
+        sched = str(pcfg.get("schedule", "FThenB"))
+        if self.plan is not None:
+            self.accumulate_steps = self.plan.n_micro * cfg["merge_k"]
+            if self.plan.pp > 1:
+                sched = self.plan.schedule
+        self._schedule = sched.lower().replace("-", "").replace("_", "")
         self._merge_avg = cfg["merge_avg"]
+        self._pipe_sched_info = None  # (schedule, n_stages, n_micro)
 
         loss_layer = loss_fn
         if loss_layer is None and isinstance(inner_model, PipelineLayer):
@@ -378,8 +401,19 @@ class FleetEngine:
             return r._data if isinstance(r, Tensor) else r
 
         built = None
-        if isinstance(inner_model, PipelineLayer) and pipe_deg > 1:
-            built = self._build_pipelined(inner_model, loss_arrays, pipe_deg)
+        if pipe_deg > 1:
+            if isinstance(inner_model, PipelineLayer):
+                stages = _stage_layer_lists(inner_model)
+            elif self.plan is not None:
+                # planner-chosen pipe over a plain model: segment its
+                # top-level children into contiguous stages (the implicit
+                # SegmentLayers an unmodified hapi script never wrote)
+                stages = self._auto_stages(inner_model, pipe_deg)
+            else:
+                stages = None
+            if stages is not None:
+                built = self._build_pipelined(stages, inner_model,
+                                              loss_arrays, pipe_deg)
             if built is None:
                 warnings.warn(
                     "PipelineLayer stages are not structurally uniform; "
@@ -516,11 +550,23 @@ class FleetEngine:
 
                 optimizer_arg = (base_init, masked_update)
 
+        # ZeRO stage: planner-chosen, or strategy.sharding stage (the
+        # reference sharding_configs {"stage": 1|2|3}), else the
+        # historical default (stage 1 whenever a sharding axis exists)
+        if self.plan is not None:
+            zero_arg = self.plan.zero
+        elif getattr(strategy, "sharding", False):
+            zero_arg = int((getattr(strategy, "sharding_configs", {}) or
+                            {}).get("stage", 1))
+        else:
+            zero_arg = shard_deg > 1
+        acfg = getattr(strategy, "auto_configs", {}) or {}
         self._step = DistributedTrainStep(
             step_loss, params, specs, optimizer=optimizer_arg, lr=cfg["lr"],
-            clip_norm=cfg["clip_norm"], zero=shard_deg > 1, mesh=self.mesh,
+            clip_norm=cfg["clip_norm"], zero=zero_arg, mesh=self.mesh,
             opt_kwargs=opt_kwargs, aux=buffers,
-            dynamic_scale=dynamic_scale, sentinel=sentinel)
+            dynamic_scale=dynamic_scale, sentinel=sentinel,
+            zero_min_size=int(acfg.get("zero_min_size", 2 ** 12)))
         if self._scaler is not None:
             # start from the eager scaler's live counters (pull any state a
             # previous engine left pending on the mirror first)
@@ -546,9 +592,17 @@ class FleetEngine:
             return one_loss
 
         def scan_loss(params, buffers, batch):
+            from ...parallel.sharding import constraint
+
             x, y = batch
             xm = x.reshape(acc, x.shape[0] // acc, *x.shape[1:])
             ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
+            # pin the microbatch layout (same scan-xs miscompile hazard as
+            # the pipelined build — see _build_pipelined.step_loss)
+            xm = constraint(xm, P(None, ("data", "sharding"),
+                                  *(None,) * (xm.ndim - 2)))
+            ym = constraint(ym, P(None, ("data", "sharding"),
+                                  *(None,) * (ym.ndim - 2)))
 
             def body(carry, xy):
                 total, buf = carry
@@ -577,10 +631,100 @@ class FleetEngine:
 
         return params, specs, self._micro_loss(one_loss), buffers
 
-    def _build_pipelined(self, pp_layer, loss_arrays, pipe_deg):
-        from ...parallel.pipeline import pipeline_forward
+    def _make_plan(self, inner_model, strategy, global_batch):
+        """Run the fleet.auto planner over the model's trainable params."""
+        import jax as _jax
 
-        stages = _stage_layer_lists(pp_layer)
+        from . import auto as fleet_auto
+
+        if global_batch is None:
+            raise ValueError(
+                "strategy.auto needs the global batch size to plan "
+                "microbatching — pass global_batch to FleetEngine (the "
+                "facade wrappers forward it from the first train_batch)")
+        acfg = dict(getattr(strategy, "auto_configs", {}) or {})
+        named = _named_trainable(inner_model)
+
+        def nbytes(p):
+            arr = p._data
+            return int(arr.size) * int(arr.dtype.itemsize)
+
+        total = sum(nbytes(p) for _, p in named)
+        n_params = sum(int(p._data.size) for _, p in named)
+        tp_bytes = sum(nbytes(p) for _, p in named
+                       if "model" in str(_spec_of(p)))
+        # pipeline-stackable depth + bytes: the structurally uniform
+        # middle run of the unit list (edges peel into prologue/epilogue
+        # at build time), measured on the MODEL's own structure rather
+        # than inferred from leaf shapes
+        if hasattr(inner_model, "run_function"):  # PipelineLayer
+            units = [u for u in inner_model.run_function
+                     if isinstance(u, Layer)]
+        else:
+            units = [u for u in self._auto_units(inner_model)
+                     if isinstance(u, Layer)]
+        sigs = [_unit_signature(u) for u in units]
+        mid_sigs = [s for s in sigs if s]
+        modal = max(set(mid_sigs), key=mid_sigs.count) if mid_sigs else None
+        layers = mid_sigs.count(modal) if modal else 1
+        layer_bytes = sum(
+            sum(nbytes(p) for p in _unit_params(u).values())
+            for u, s in zip(units, sigs) if s == modal) if modal else 0
+        hidden = int(acfg.get("hidden", 0))
+        if not hidden:
+            cand = [p._data.shape[-1] for _, p in named
+                    if p._data.ndim >= 2]
+            hidden = max(cand) if cand else 0
+        stats = fleet_auto.ModelStats(
+            param_bytes=total, n_params=n_params, layer_bytes=layer_bytes,
+            tp_bytes=tp_bytes, layers=int(layers), hidden=hidden,
+            seq_len=int(acfg.get("seq_len", 1)))
+        constraints = {k: int(acfg[k]) for k in
+                       ("dp", "sharding", "pp", "mp", "n_micro", "zero")
+                       if k in acfg}
+        hw = fleet_auto.HardwareSpec()
+        if "hbm_bytes_per_device" in acfg:
+            hw = fleet_auto.HardwareSpec(
+                hbm_bytes=int(acfg["hbm_bytes_per_device"]))
+        return fleet_auto.plan(
+            stats=stats, global_batch=int(global_batch),
+            n_devices=len(_jax.devices()), hardware=hw,
+            allow_mp=tp_bytes > 0,
+            max_micro=int(acfg.get("max_micro", 16)),
+            constraints=constraints,
+            schedule=str(acfg.get("schedule", "1f1b")))
+
+    @staticmethod
+    def _auto_units(model: Layer) -> List[Layer]:
+        """Top-level unit list of a plain model (descending through
+        single-child wrappers) — the implicit LayerDesc sequence."""
+        units = [c for c in model.children()]
+        while len(units) == 1 and isinstance(units[0], Layer):
+            inner = [c for c in units[0].children()]
+            if not inner:
+                break
+            units = inner
+        return units
+
+    def _auto_stages(self, model: Layer, pipe_deg: int):
+        """Segment a plain model's units into pipe_deg contiguous stages
+        (uniform-count middle, like SegmentLayers); None when the model
+        has fewer units than stages."""
+        units = self._auto_units(model)
+        if len(units) < pipe_deg:
+            return None
+        base, rem = divmod(len(units), pipe_deg)
+        stages: List[list] = []
+        i = 0
+        for s in range(pipe_deg):
+            k = base + (1 if s < rem else 0)
+            stages.append(units[i:i + k])
+            i += k
+        return stages
+
+    def _build_pipelined(self, stages, root_layer, loss_arrays, pipe_deg):
+        from ...parallel.pipeline import pipeline_1f1b, pipeline_forward
+
         split = _split_stages(stages)
         padded_lens = None
         if split is None:
@@ -652,7 +796,7 @@ class FleetEngine:
         self._write_back = self._assign_pipelined
         self._write_back_buffers = lambda new: None
 
-        buffers = layer_buffers(pp_layer)
+        buffers = layer_buffers(root_layer)
         if buffers:
             warnings.warn(
                 "PipelineLayer stages carry buffers (e.g. BatchNorm running "
@@ -717,6 +861,45 @@ class FleetEngine:
                 return h
 
         acc = max(self.accumulate_steps, n_stages)
+        self._pipe_sched_info = (self._schedule, n_stages, acc)
+
+        if self._schedule == "1f1b" and n_stages > 1:
+            # 1F1B: epilogue + loss fold into the schedule's last-stage
+            # loss head; gradients come out of the SAME scan
+            # (parallel.pipeline.pipeline_1f1b — custom_vjp, so the
+            # DistributedTrainStep's value_and_grad composes unchanged).
+            # The prologue stays outside: its backward is driven by the
+            # schedule's x_micro cotangent through ordinary autodiff,
+            # which also sums a tied (SharedLayerDesc) weight's prologue
+            # and head contributions at the params-dict level.
+            epi_keys = sorted({outer_key_of[id(p)] for u in epilogue
+                               for p in _unit_params(u).values()})
+
+            def loss_head(hp, act, yt):
+                o = apply_edge(epilogue, hp, act)
+                return loss_arrays(o, yt)
+
+            fb = pipeline_1f1b(stage_fn, loss_head, n_stages,
+                               mean=self._merge_avg)
+
+            def step_loss(params, buffers, batch):
+                from ...parallel.sharding import constraint
+
+                x, y = batch
+                h = apply_edge(prologue, params, x)
+                xm = h.reshape(acc, h.shape[0] // acc, *h.shape[1:])
+                ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
+                # same microbatch-layout pins as the fill/drain build
+                xm = constraint(xm, P(None, ("data", "sharding"),
+                                      *(None,) * (xm.ndim - 2)))
+                ym = constraint(ym, P(None, ("data", "sharding"),
+                                      *(None,) * (ym.ndim - 2)))
+                mid_params = {k: v for k, v in params.items()
+                              if k.startswith("stage.")}
+                head_params = {k: params[k] for k in epi_keys}
+                return fb(mid_params, head_params, xm, ym), buffers
+
+            return stacked, specs, step_loss, buffers
 
         def step_loss(params, buffers, batch):
             from ...parallel.sharding import constraint
@@ -725,11 +908,17 @@ class FleetEngine:
             h = apply_edge(prologue, params, x)
             xm = h.reshape(acc, h.shape[0] // acc, *h.shape[1:])
             ym = y.reshape(acc, y.shape[0] // acc, *y.shape[1:])
-            # pin the microbatched labels to the batch layout: the
+            # pin BOTH microbatched streams to the batch layout: the
             # batch->microbatch reshape leaves the data/sharding tiling on
-            # the time axis, and every per-microbatch slice would hit the
-            # partitioner's replicate-and-repartition fallback (same fix
-            # as pipeline.py's carry pinning)
+            # the time axis. For ym the unpinned layout merely costs the
+            # partitioner's replicate-and-repartition fallback per slice;
+            # for xm (the pipeline scan's xs) the propagated split-on-
+            # microbatch-dim sharding MISCOMPILES the scan on CPU GSPMD
+            # (values read with a stride — seed failures
+            # test_compiled_matches_eager_debug_mode & co), so the pin is
+            # a correctness fix, not an optimisation.
+            xm = constraint(xm, P(None, ("data", "sharding"),
+                                  *(None,) * (xm.ndim - 2)))
             ym = constraint(ym, P(None, ("data", "sharding"),
                                   *(None,) * (ym.ndim - 2)))
             mid_params = {k: v for k, v in params.items()
@@ -780,6 +969,31 @@ class FleetEngine:
     def train_step(self) -> DistributedTrainStep:
         return self._step
 
+    def _emit_pipeline_ticks(self):
+        """One ``pipeline.tick`` span per schedule tick with the stage
+        occupancy of the STATIC schedule actually compiled (the in-jit
+        scan never returns to the host mid-step, so occupancy is emitted
+        from the schedule's closed form). tools/trace_report.py's
+        pipeline_report turns Σbusy/Σslots into the measured bubble
+        fraction and diffs it against the cost model's prediction."""
+        import time as _time
+
+        sched, S, n = self._pipe_sched_info
+        writer = _mtrace.get_writer()
+        now = _time.perf_counter()
+        one_f1b = sched == "1f1b" and S > 1
+        T = n + (2 * (S - 1) if one_f1b else S - 1)
+        slots = 2 * S if one_f1b else S
+        for t in range(T):
+            busy = sum(1 for s in range(S) if 0 <= t - s < n)
+            if one_f1b:
+                busy += sum(1 for s in range(S)
+                            if 0 <= t - 2 * (S - 1) + s < n)
+            writer.add_complete(
+                "pipeline.tick", now, 1e-6, cat="pipeline",
+                args={"t": t, "busy": busy, "slots": slots, "stages": S,
+                      "n_micro": n, "schedule": sched})
+
     def step(self, batch):
         if _faults.ENABLED[0]:
             # fault-injection hook (FLAGS_fault_inject): the registry
@@ -791,6 +1005,8 @@ class FleetEngine:
         x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         y = y._data if isinstance(y, Tensor) else jnp.asarray(y)
         loss = self._step((x, y))
+        if self._pipe_sched_info is not None and _mtrace.is_tracing():
+            self._emit_pipeline_ticks()
         self._write_back(self._step.params)
         self._write_back_buffers(self._step.aux)
         if self._scaler is not None:
@@ -818,6 +1034,8 @@ class FleetEngine:
 
 
 def build_engine(model, optimizer, strategy, hcg=None, loss_fn=None,
-                 mesh=None, sentinel=None) -> FleetEngine:
+                 mesh=None, sentinel=None,
+                 global_batch=None) -> FleetEngine:
     return FleetEngine(model, optimizer, strategy, hcg=hcg, loss_fn=loss_fn,
-                       mesh=mesh, sentinel=sentinel)
+                       mesh=mesh, sentinel=sentinel,
+                       global_batch=global_batch)

@@ -72,16 +72,18 @@ class PipelineParallel(Layer):
         self.total_loss = total_loss
         return total_loss
 
-    def _get_engine(self, optimizer):
+    def _get_engine(self, optimizer, global_batch=None):
         from ..engine import FleetEngine
         from ....parallel.mesh import get_mesh
 
-        if get_mesh() is None:
+        if get_mesh() is None and \
+                not getattr(self._strategy, "auto", False):
             return None
         if self._engine is None or self._engine_opt_id != id(optimizer):
             self._engine = FleetEngine(self._layers, optimizer,
                                        self._strategy, hcg=self._hcg,
-                                       scaler=self._engine_scaler)
+                                       scaler=self._engine_scaler,
+                                       global_batch=global_batch)
             self._engine_opt_id = id(optimizer)
         return self._engine
 
@@ -96,7 +98,11 @@ class PipelineParallel(Layer):
             self._engine_scaler = scaler
             self._engine = None
         eager = use_eager
-        engine = None if eager else self._get_engine(optimizer)
+        gb = None
+        if not eager:
+            x0 = data[0]
+            gb = int(getattr(x0, "shape", [0])[0])
+        engine = None if eager else self._get_engine(optimizer, gb)
         if engine is not None:
             loss = Tensor(engine.step(data))
         else:

@@ -38,9 +38,11 @@ class MetaParallelBase(Layer):
 
         key = (id(optimizer), id(loss_fn))
         if self._engine is None or self._engine_key != key:
+            x = data[0]
+            gb = int((x._data if isinstance(x, Tensor) else x).shape[0])
             self._engine = FleetEngine(self._layers, optimizer,
                                        self._strategy, hcg=self._hcg,
-                                       loss_fn=loss_fn)
+                                       loss_fn=loss_fn, global_batch=gb)
             self._engine_key = key
         loss = self._engine.step(data)
         if lr_scheduler is not None:

@@ -33,6 +33,50 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+class _AutoFleetStep:
+    """fleet.auto bridge (ISSUE 9): when ``fleet.init(strategy={"auto":
+    True})`` is active, hapi.Model.fit routes its training step through a
+    planner-built FleetEngine instead of the single-device jit.TrainStep —
+    the unmodified script scales onto the planned dp x sharding x pp x mp
+    mesh. The engine is built lazily at the first batch (the planner needs
+    the global batch size); parameters write back into the eager network
+    every step, so save()/state_dict() keep working."""
+
+    def __init__(self, model):
+        self._model = model
+        self._engine = None
+
+    @property
+    def engine(self):
+        return self._engine
+
+    @property
+    def _step_count(self):
+        return self._engine.train_step._step_count if self._engine else 0
+
+    def sync(self):
+        pass  # no lazily-deferred slot mirrors on the engine path
+
+    def __call__(self, *args):
+        *ins, label = args
+        if len(ins) != 1:
+            raise ValueError(
+                "the fleet.auto hapi path compiles single-input models; "
+                "multi-input models need an explicit FleetEngine")
+        x = ins[0]
+        xa = x._data if isinstance(x, Tensor) else np.asarray(x)
+        if self._engine is None:
+            from ..distributed.fleet.base.fleet_base import fleet as _fleet
+            from ..distributed.fleet.engine import FleetEngine
+
+            self._engine = FleetEngine(
+                self._model.network, self._model._optimizer,
+                _fleet._strategy, loss_fn=self._model._loss,
+                global_batch=int(xa.shape[0]))
+        loss = self._engine.step((x, label))
+        return loss if isinstance(loss, Tensor) else Tensor(loss)
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -110,6 +154,14 @@ class Model:
 
     # -- core steps ----------------------------------------------------------
     def _build_train_step(self, sentinel=None):
+        from ..distributed.fleet.base.fleet_base import fleet as _fleet
+
+        strat = getattr(_fleet, "_strategy", None)
+        if strat is not None and getattr(strat, "auto", False) \
+                and sentinel is None:
+            # fleet.auto active: the planner-built engine IS the step
+            return _AutoFleetStep(self)
+
         from ..jit import TrainStep
 
         loss_layer = self._loss

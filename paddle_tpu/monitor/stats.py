@@ -181,6 +181,11 @@ DEFAULT_STATS = (
     "fused_kernel_calls",     # fused LN/MLP kernel dispatches (eager surface)
     "int8_matmul_calls",      # int8 weight-quantized matmul dispatches
     "grad_overlap_buckets",   # grad all-reduce buckets issued inside backward
+    # fleet.auto hybrid-parallel planner (ISSUE 9)
+    "plan_candidates_considered",   # legal candidates scored by the planner
+    "zero_level",                   # gauge: chosen ZeRO stage (0-3)
+    "pipeline_bubble_frac",         # gauge: chosen plan's bubble, ppm (1e-6)
+    "planner_hbm_headroom_bytes",   # gauge: HBM budget minus chosen plan's need
 )
 
 for _n in DEFAULT_STATS:
@@ -223,6 +228,10 @@ FUSED_OPTIMIZER_STEPS = _registry.get_stat("fused_optimizer_steps")
 FUSED_KERNEL_CALLS = _registry.get_stat("fused_kernel_calls")
 INT8_MATMUL_CALLS = _registry.get_stat("int8_matmul_calls")
 GRAD_OVERLAP_BUCKETS = _registry.get_stat("grad_overlap_buckets")
+PLAN_CANDIDATES_CONSIDERED = _registry.get_stat("plan_candidates_considered")
+ZERO_LEVEL = _registry.get_stat("zero_level")
+PIPELINE_BUBBLE_FRAC = _registry.get_stat("pipeline_bubble_frac")
+PLANNER_HBM_HEADROOM_BYTES = _registry.get_stat("planner_hbm_headroom_bytes")
 
 
 # per-mesh-axis device-memory gauges published by the last

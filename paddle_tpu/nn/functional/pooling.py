@@ -180,6 +180,28 @@ def _adaptive_starts_ends(in_size, out_size):
 def _adaptive_pool(x, out_sizes, op):
     n_spatial = len(out_sizes)
     spatial = x.shape[2:]
+    if op == "avg" and any(in_s != o and in_s % o != 0
+                           for in_s, o in zip(spatial, out_sizes)):
+        # non-uniform windows: sum each JOINT window and divide once — a
+        # per-dim mean-of-means rounds twice and drifts past rtol=1e-5 of
+        # the reference kernels' single sum/divide on cancelling windows
+        import itertools
+
+        windows = [_adaptive_starts_ends(in_s, o)
+                   for in_s, o in zip(spatial, out_sizes)]
+        cells = []
+        for idx in itertools.product(*[range(o) for o in out_sizes]):
+            lo = [windows[d][0][idx[d]] for d in range(n_spatial)]
+            hi = [windows[d][1][idx[d]] for d in range(n_spatial)]
+            seg = x[(slice(None), slice(None))
+                    + tuple(slice(l, h) for l, h in zip(lo, hi))]
+            cnt = 1
+            for l, h in zip(lo, hi):
+                cnt *= h - l
+            cells.append(jnp.sum(seg, axis=tuple(range(2, 2 + n_spatial)))
+                         / cnt)
+        return jnp.stack(cells, axis=-1).reshape(
+            x.shape[:2] + tuple(out_sizes))
     out = x
     for d in range(n_spatial):
         in_s = spatial[d]
@@ -197,7 +219,7 @@ def _adaptive_pool(x, out_sizes, op):
             slices = []
             for s0, e0 in zip(starts, ends):
                 seg = jax.lax.slice_in_dim(out, s0, e0, axis=2 + d)
-                red = jnp.max(seg, axis=2 + d, keepdims=True) if op == "max" else jnp.mean(seg, axis=2 + d, keepdims=True)
+                red = jnp.max(seg, axis=2 + d, keepdims=True)
                 slices.append(red)
             out = jnp.concatenate(slices, axis=2 + d)
     return out

@@ -38,9 +38,27 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["stack_stages", "pipeline_forward"]
+__all__ = ["stack_stages", "pipeline_forward", "pipeline_1f1b"]
+
+
+def _fit_spec(x, dim: int, spec: P) -> P:
+    """``spec`` when x's ``dim`` divides evenly over the spec's mesh axes
+    there, else fully replicated (a sharding constraint with a
+    non-divisible dim is an error outside jit)."""
+    from .mesh import get_mesh
+
+    mesh = get_mesh()
+    entry = tuple(spec)[dim] if dim < len(tuple(spec)) else None
+    if mesh is None or entry is None:
+        return spec
+    axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+    div = 1
+    for a in axes:
+        div *= dict(mesh.shape).get(a, 1)
+    return spec if x.shape[dim] % div == 0 else P()
 
 
 def stack_stages(block_params, n_stages: int):
@@ -98,6 +116,16 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
             return x
         return constraint(x, spec)
 
+    # pin the microbatch stream to (time, batch) layout at entry: when the
+    # caller reshaped a batch-sharded array into (n_micro, micro_batch, ...)
+    # the propagated split-on-time sharding MISCOMPILES the scan's xs
+    # slicing on CPU GSPMD (strided reads — seed fleet_engine failures);
+    # the explicit pin reshards once, correctly, before the schedule. A
+    # microbatch too small for the batch axes pins replicated instead
+    # (same correctness, costs a broadcast).
+    x_micro = pin(x_micro, _fit_spec(x_micro, 1, P(None, batch_entry,
+                                                   *trailing)))
+
     n_micro = x_micro.shape[0]
     if n_stages == 1:
         return jax.vmap(lambda x: stage_fn(
@@ -139,3 +167,214 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_micro,
     _, ys = jax.lax.scan(body, acts0, xs)
     # drain: tick t >= n_stages-1 emitted microbatch t-(n_stages-1)
     return ys[n_stages - 1:].astype(x_micro.dtype)
+
+
+# --------------------------------------------------------------------------
+# 1F1B (ISSUE 9): interleaved forward/backward schedule in ONE lax.scan
+# --------------------------------------------------------------------------
+#
+# The fill/drain schedule above gets its backward by DIFFERENTIATING the
+# scan: autodiff saves the inter-stage carry of every tick, so the saved-
+# activation footprint grows O(T) = O(n_micro + S). 1F1B (Narayanan et al.
+# 2021; reference fleet/meta_parallel/pipeline_parallel.py:80-150) exists
+# to bound that by the pipeline DEPTH: a microbatch's backward starts as
+# soon as its forward leaves the last stage, so at most O(S) microbatches
+# are ever in flight per stage.
+#
+# In-jit, that schedule cannot be expressed by differentiating a forward
+# scan — so this scan computes the gradients ITSELF. Each tick, every
+# stage (vmapped over the "pipe"-sharded stage dim, as above) runs:
+#   F:  stage s forwards microbatch  m_F = t - s            (GPipe timing),
+#       saving its INPUT into a ring buffer (depth R = 2S-1);
+#   B:  stage s backwards microbatch m_B = t - 2(S-1) + s   — i.e. the
+#       last stage backwards m the same tick its forward finishes (that
+#       is the "1F1B" moment), and the cotangent walks one stage back per
+#       tick (the reverse CollectivePermute).
+# The backward uses jax.vjp over the SAVED INPUT — internals rematerialize,
+# matching the fill/drain path's jax.checkpoint policy, so what is stored
+# per stage is the ring of at most 2S-1 stage inputs: the lockstep-SPMD
+# variant of 1F1B's O(S) bound (in-flight at stage s = 2(S-1-s)+1; the
+# asymmetric warmup that gets Megatron to exactly S-s does not exist in a
+# lockstep schedule where every stage acts every tick). T = n + 2(S-1)
+# ticks total; one pass, no separate backward sweep.
+#
+# Because the grads come out of the forward scan, the public wrapper is a
+# custom_vjp whose fwd stashes them as residuals and whose bwd just scales
+# by the incoming loss cotangent — an outer jax.value_and_grad (the
+# DistributedTrainStep) composes with it unchanged.
+
+
+def _zero_cot(x):
+    """Zero cotangent matching a primal (float0 for integer leaves)."""
+    aval = jax.core.get_aval(x)
+    if jnp.issubdtype(aval.dtype, jnp.inexact):
+        return jnp.zeros(aval.shape, aval.dtype)
+    return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def _run_1f1b(stage_fn, loss_head, stage_params, head_params, x_micro,
+              y_micro, n_stages, mean, batch_spec):
+    """Execute the 1F1B scan; returns (loss, dstage_params, dhead_params,
+    dx_micro) — the full gradient set, computed inside the schedule."""
+    from .mesh import get_mesh
+    from .sharding import constraint
+
+    S = n_stages
+    n = x_micro.shape[0]
+    R = 2 * S - 1
+    T = n + 2 * (S - 1)
+
+    have_mesh = get_mesh() is not None
+    batch_entry = tuple(batch_spec)[0] if len(batch_spec) else None
+    trailing = (None,) * (x_micro.ndim - 2)
+    if have_mesh and batch_entry is not None and \
+            _fit_spec(x_micro, 1, P(None, batch_entry)) == P():
+        batch_entry = None  # microbatch too small for the batch axes
+    act_spec = P("pipe", batch_entry, *trailing)
+    ring_spec = P("pipe", None, batch_entry, *trailing)
+
+    def pin(x, spec):
+        if not have_mesh or not isinstance(x, jax.core.Tracer):
+            return x
+        return constraint(x, spec)
+
+    x_micro = pin(x_micro, P(None, batch_entry, *trailing))
+    mb_shape = x_micro.shape[1:]
+    f32 = jnp.float32
+
+    # xs streams: stage-0 inputs at tick t = microbatch t; labels at tick
+    # t feed the last stage's loss for microbatch t-(S-1)
+    xpad = jnp.concatenate(
+        [x_micro, jnp.zeros((2 * (S - 1),) + mb_shape, x_micro.dtype)], 0)
+    ypad = jnp.concatenate(
+        [jnp.zeros((S - 1,) + y_micro.shape[1:], y_micro.dtype), y_micro,
+         jnp.zeros((S - 1,) + y_micro.shape[1:], y_micro.dtype)], 0)
+    ts = jnp.arange(T, dtype=jnp.int32)
+
+    def f_one(sp, a_in, ring_s, t):
+        # forward one stage; save the stage INPUT in the ring at slot
+        # m_F mod R (per-stage slot index via the vmap axis)
+        s = jax.lax.axis_index("pipe_stage")
+        m_f = t - s
+        ring_s = jax.lax.dynamic_update_index_in_dim(
+            ring_s, a_in, jnp.mod(m_f, R), axis=0)
+        return stage_fn(sp, a_in), ring_s
+
+    def b_one(sp, ring_s, cot_in, t):
+        # backward one stage at the saved input (vjp recomputes the
+        # forward — the remat bargain, same as fill/drain's checkpoint)
+        s = jax.lax.axis_index("pipe_stage")
+        m_b = t - 2 * (S - 1) + s
+        saved = jax.lax.dynamic_index_in_dim(
+            ring_s, jnp.mod(m_b, R), axis=0, keepdims=False)
+        _, vjp_fn = jax.vjp(stage_fn, sp, saved)
+        dp, da = vjp_fn(cot_in)
+        valid = (m_b >= 0) & (m_b < n)
+        dp = jax.tree_util.tree_map(
+            lambda g: jnp.where(valid, g, 0).astype(f32), dp)
+        da = jnp.where(valid, da, 0)
+        return dp, da
+
+    vf = jax.vmap(f_one, in_axes=(0, 0, 0, None), axis_name="pipe_stage")
+    vb = jax.vmap(b_one, in_axes=(0, 0, 0, None), axis_name="pipe_stage")
+
+    def tick(carry, xs_t):
+        acts, cots, ring, gstage, ghead, loss_acc = carry
+        t, xt, yt = xs_t
+        # -- F: all stages forward their held activation ------------------
+        acts = acts.at[0].set(pin(xt, P(batch_entry, *trailing))
+                              .astype(acts.dtype))
+        acts = pin(acts, act_spec)
+        y, ring = vf(stage_params, acts, ring, t)
+        ring = pin(ring, ring_spec)
+        # -- loss head: microbatch t-(S-1) leaves the pipe this tick ------
+        m_last = t - (S - 1)
+        valid_last = (m_last >= 0) & (m_last < n)
+        act_last = pin(y[-1], P(batch_entry, *trailing))
+        loss_m, vjp_head = jax.vjp(
+            lambda hp, a: loss_head(hp, a, yt), head_params, act_last)
+        dhead, dact = vjp_head(jnp.ones_like(loss_m))
+        loss_acc = loss_acc + jnp.where(valid_last,
+                                        loss_m.astype(f32), 0.0)
+        ghead = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(valid_last, g, 0).astype(f32),
+            ghead, dhead)
+        # -- B: 1F1B — the seed enters stage S-1 the same tick ------------
+        cots_in = cots.at[S - 1].set(dact.astype(cots.dtype))
+        cots_in = pin(cots_in, act_spec)
+        dp, da = vb(stage_params, ring, cots_in, t)
+        gstage = jax.tree_util.tree_map(lambda acc, g: acc + g, gstage, dp)
+        # rotations: activations one stage forward, cotangents one back
+        acts = pin(jnp.roll(y, shift=1, axis=0), act_spec)
+        cots = pin(jnp.roll(da, shift=-1, axis=0), act_spec)
+        # stage 0's input cotangent exits the pipe (microbatch t-2(S-1))
+        dx_t = pin(da[0], P(batch_entry, *trailing))
+        return (acts, cots, ring, gstage, ghead, loss_acc), dx_t
+
+    zeros_f32 = lambda tree: jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), f32), tree)
+    carry0 = (
+        pin(jnp.zeros((S,) + mb_shape, x_micro.dtype), act_spec),
+        pin(jnp.zeros((S,) + mb_shape, x_micro.dtype), act_spec),
+        pin(jnp.zeros((S, R) + mb_shape, x_micro.dtype), ring_spec),
+        zeros_f32(stage_params),
+        zeros_f32(head_params),
+        jnp.zeros((), f32),
+    )
+    (_, _, _, gstage, ghead, loss_acc), dxs = jax.lax.scan(
+        tick, carry0, (ts, xpad, ypad))
+
+    dx_micro = dxs[2 * (S - 1):]
+    denom = f32(n) if mean else f32(1.0)
+    loss = loss_acc / denom
+    cast = lambda g, p: jax.tree_util.tree_map(
+        lambda a, b: (a / denom).astype(b.dtype), g, p)
+    return (loss, cast(gstage, stage_params), cast(ghead, head_params),
+            (dx_micro / denom).astype(x_micro.dtype))
+
+
+def pipeline_1f1b(stage_fn: Callable, loss_head: Callable, n_stages: int,
+                  mean: bool = True, batch_spec=P(("data", "sharding"))):
+    """Build the in-jit 1F1B pipeline loss.
+
+    Args:
+      stage_fn: ``(params_one_stage, x) -> y`` — one stage's layer stack
+        (same contract as :func:`pipeline_forward`; may use
+        ``lax.axis_index("pipe_stage")``).
+      loss_head: ``(head_params, act, label_micro) -> scalar`` — the
+        epilogue + loss for ONE microbatch leaving the last stage.
+      n_stages: pipeline depth (mesh "pipe" size, >= 2).
+      mean: average per-microbatch losses (True, the eager train_batch
+        accumulation) or sum them (GradientMerge avg=False).
+
+    Returns ``f(stage_params, head_params, x_micro, y_micro) -> loss``, a
+    ``jax.custom_vjp`` function whose backward yields the schedule's
+    gradients (computed inside the SAME scan — see the section comment),
+    so ``jax.value_and_grad`` over it behaves like any loss function.
+    """
+    if n_stages < 2:
+        raise ValueError("pipeline_1f1b needs n_stages >= 2 "
+                         "(use a plain step for a 1-stage model)")
+
+    @jax.custom_vjp
+    def f(stage_params, head_params, x_micro, y_micro):
+        loss, _, _, _ = _run_1f1b(stage_fn, loss_head, stage_params,
+                                  head_params, x_micro, y_micro, n_stages,
+                                  mean, batch_spec)
+        return loss
+
+    def fwd(stage_params, head_params, x_micro, y_micro):
+        loss, gs, gh, dx = _run_1f1b(stage_fn, loss_head, stage_params,
+                                     head_params, x_micro, y_micro,
+                                     n_stages, mean, batch_spec)
+        return loss, (gs, gh, dx, y_micro)
+
+    def bwd(res, g):
+        gs, gh, dx, y_micro = res
+        scale = lambda tree: jax.tree_util.tree_map(
+            lambda a: (a * g).astype(a.dtype), tree)
+        return (scale(gs), scale(gh), (dx * g).astype(dx.dtype),
+                _zero_cot(y_micro))
+
+    f.defvjp(fwd, bwd)
+    return f

@@ -292,7 +292,18 @@ class DistributedTrainStep:
         as extra data parallelism, as in reference sharding_optimizer
         hybrid-dp mode (sharding_optimizer.py, hybrid with dp).
       clip_norm: optional global-norm clip.
-      zero: shard optimizer state along "sharding" (ZeRO-1). Default True.
+      zero: ZeRO stage over the "sharding" axis (Rajbhandari et al. 2020).
+        ``True``/1 shards optimizer state (the historical default);
+        2 additionally pins gradients to the sharded layout (XLA's grad
+        reduction becomes a reduce-scatter and the full-size gradient
+        never materializes); 3 additionally stores the PARAMETERS
+        1/Nth-sharded (all-gathered where the forward consumes them).
+        ``False``/0 disables. A ``fleet.auto.ShardedOptimizer`` passed as
+        ``optimizer`` carries its own level (and hyperparameters), which
+        wins over this argument.
+      zero_min_size: parameters smaller than this stay replicated under
+        ZeRO (the reference's greedy partition likewise skips tiny
+        tensors).
       aux: optional non-trainable state pytree (buffers: BatchNorm running
         stats, quant scales) threaded through the step. When given,
         ``loss_fn`` is ``(params, aux, batch) -> (loss, new_aux)`` and the
@@ -322,15 +333,21 @@ class DistributedTrainStep:
     def __init__(self, loss_fn: Callable, params, param_specs,
                  optimizer="adamw", lr: float = 1e-3,
                  batch_spec: P = P(("data", "sharding")),
-                 clip_norm: Optional[float] = None, zero: bool = True,
+                 clip_norm: Optional[float] = None, zero=True,
                  mesh=None, opt_kwargs: Optional[dict] = None,
                  aux=None, aux_specs=None,
                  dynamic_scale: Optional[dict] = None,
-                 sentinel=None):
+                 sentinel=None, zero_min_size: int = 2 ** 12):
         self.mesh = mesh or get_mesh()
         if self.mesh is None:
             raise RuntimeError("DistributedTrainStep needs a mesh "
                                "(parallel.create_mesh)")
+        if hasattr(optimizer, "fns") and hasattr(optimizer, "level"):
+            # fleet.auto.ShardedOptimizer: carries (init, update), the
+            # ZeRO level and its hyperparameters
+            zero = optimizer.level
+            opt_kwargs = {**optimizer.opt_kwargs, **(opt_kwargs or {})}
+            optimizer = optimizer.fns()
         if isinstance(optimizer, str):
             init_fn, update_fn = _OPTS[optimizer]
             if _native.fused_optimizer[0] and optimizer in ("adamw",
@@ -352,12 +369,22 @@ class DistributedTrainStep:
         self.param_specs = param_specs
 
         shard_deg = mesh_shape(self.mesh).get("sharding", 1)
+        zero_level = (1 if zero is True else 0 if zero is False
+                      else int(zero))
+        if shard_deg <= 1:
+            zero_level = 0
+        self.zero_level = zero_level
         opt_state = init_fn(params)
         shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
-        if zero:
-            zspecs = zero_shard_specs(param_specs, shapes, shard_deg)
+        if zero_level >= 1:
+            zspecs = zero_shard_specs(param_specs, shapes, shard_deg,
+                                      min_size=zero_min_size)
         else:
             zspecs = param_specs
+        self._zspecs = zspecs
+        # ZeRO-3: parameter STORAGE is 1/Nth-sharded — the jit boundary
+        # shardings do the partitioning, XLA all-gathers at first use
+        storage_specs = zspecs if zero_level >= 3 else param_specs
         # per-param moment trees (m/v/velocity/...) mirror the
         # (zero-)sharded param layout; scalars (count) replicated
         param_treedef = jax.tree_util.tree_structure(params)
@@ -375,7 +402,11 @@ class DistributedTrainStep:
         ns = lambda tree: jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), tree,
             is_leaf=lambda x: isinstance(x, P))
-        self._param_sh = ns(param_specs)
+        self._param_sh = ns(storage_specs)
+        # ZeRO-2: gradients pinned to the sharded layout — the dp/sharding
+        # grad reduction lowers to a reduce-scatter at this boundary and
+        # the full-size grad buffer never materializes
+        self._grad_sh = ns(zspecs) if zero_level >= 2 else self._param_sh
         self._opt_sh = ns(self.opt_specs)
         self._batch_spec = batch_spec
 
@@ -472,15 +503,14 @@ class DistributedTrainStep:
 
                 (_, (loss, new_aux)), grads = jax.value_and_grad(
                     run_loss, has_aux=True)(params)
-                # pin grads to the PARAM layout: the ZeRO reshard (m/v
-                # carry the "sharding" axis) then happens at this
-                # boundary as a reduce-scatter, instead of GSPMD
-                # propagating the opt-state sharding backward through
-                # the loss (which forces replicate-and-repartition
-                # inside the pipeline scan)
+                # pin grads to the PARAM layout (ZeRO-0/1: the m/v
+                # reshard happens here as a reduce-scatter instead of
+                # GSPMD propagating the opt-state sharding backward
+                # through the loss) or, at ZeRO-2+, directly to the
+                # SHARDED layout so the full-size gradient never exists
                 grads = jax.tree_util.tree_map(
                     lambda g, s: jax.lax.with_sharding_constraint(g, s),
-                    grads, self._param_sh)
+                    grads, self._grad_sh)
             if scaler_state is not None:
                 inv = (1.0 / scale)
                 grads = jax.tree_util.tree_map(
@@ -624,6 +654,27 @@ class DistributedTrainStep:
         if self.scaler_state is None:
             return None
         return float(self.scaler_state["scale"])
+
+    def state_dict(self) -> dict:
+        """Host snapshot {params, opt_state, step}. Sharded leaves
+        (ZeRO m/v, ZeRO-3 params) GATHER on the host read, so the
+        checkpoint layout is identical to an unsharded run's — sharding
+        is placement, not content."""
+        import numpy as np
+
+        host = lambda tree: jax.tree_util.tree_map(
+            lambda x: np.asarray(x), tree)
+        return {"params": host(self.params),
+                "opt_state": host(self.opt_state),
+                "step": self._step_count}
+
+    def set_state_dict(self, state: dict) -> None:
+        """Restore a state_dict (this run's or an unsharded one's): full
+        arrays are device_put back through the step's NamedShardings, so
+        a ZeRO-sharded step resumes from any checkpoint and vice versa."""
+        self.params = jax.device_put(state["params"], self._param_sh)
+        self.opt_state = jax.device_put(state["opt_state"], self._opt_sh)
+        self._step_count = int(state.get("step", self._step_count))
 
     def lower(self, batch):
         """Expose the lowered/compiled artifact (assert-on-HLO testing —

@@ -137,6 +137,56 @@ def overlap_report(rows: list, file=None) -> dict:
     return out
 
 
+def pipeline_report(events: list, file=None) -> dict:
+    """Pipeline-bubble verdict from the ``pipeline.tick`` spans (ISSUE 9).
+
+    The FleetEngine emits one span per schedule tick with ``{t, busy,
+    slots, stages, n_micro, schedule}`` — the stage occupancy of the
+    STATIC schedule the step compiled (the in-jit scan never returns to
+    the host mid-step, so occupancy comes from the schedule's closed
+    form). The measured bubble fraction ``1 - Σbusy/Σslots`` is diffed
+    against the cost model's prediction — ``(S-1)/T`` with
+    ``T = n_micro + S - 1`` per pass (fill/drain), or the 1F1B
+    equivalent ``2(S-1)/(n_micro + 2(S-1))`` — answering whether the
+    schedule that actually ran matches what the fleet.auto planner
+    budgeted for."""
+    ticks = [e for e in events if e.get("name") == "pipeline.tick"]
+    if not ticks:
+        return {}
+    busy = slots = 0
+    a0 = ticks[0].get("args") or {}
+    for e in ticks:
+        a = e.get("args") or {}
+        busy += int(a.get("busy", 0))
+        slots += int(a.get("slots", 0))
+    measured = 1.0 - busy / slots if slots else 0.0
+    S = int(a0.get("stages", 1))
+    n = int(a0.get("n_micro", 1))
+    sched = str(a0.get("schedule", "fthenb"))
+    if sched == "1f1b" and S > 1:
+        predicted = 2.0 * (S - 1) / (n + 2 * (S - 1))
+    else:
+        predicted = (S - 1) / (n + S - 1) if S > 1 else 0.0
+    out = {"schedule": sched, "stages": S, "n_micro": n,
+           "ticks": len(ticks), "measured_bubble_frac": measured,
+           "predicted_bubble_frac": predicted}
+    delta = abs(measured - predicted)
+    out["verdict"] = (
+        f"pipeline schedule matches the cost model (bubble "
+        f"{measured:.3f} vs predicted {predicted:.3f})" if delta <= 0.02
+        else f"bubble deviates from the cost model by {delta:.3f} "
+             f"(measured {measured:.3f} vs predicted {predicted:.3f}) — "
+             "the compiled schedule is not the one the planner budgeted; "
+             "check accumulate_steps/pipeline_configs overrides")
+    print("\nPipeline schedule:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<24}{v:>12.4f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def recompile_report(events: list, file=None, top: int = 5) -> dict:
     """Recompile-causes verdict from the ``sanitize.recompile`` spans
     (ISSUE 8, FLAGS_sanitize).
@@ -363,6 +413,7 @@ def main(argv=None):
     serving_report(rows, events=events)
     resilience_report(events, rows)
     recompile_report(events)
+    pipeline_report(events)
     return rows
 
 
