@@ -564,6 +564,31 @@ def bench_serving_load(on_accel):
         finally:
             eng.shutdown(drain=False)
 
+    # mesh leg (ISSUE 10): the paged engine sharded data=4 x model=2 over
+    # the 8-device mesh (virtual on CPU runs — real win on a TPU slice);
+    # pool sized to the same tokens, rounded to the per-shard layout
+    import jax
+
+    if len(jax.devices()) >= 8:
+        from jax.sharding import Mesh
+
+        from paddle_tpu.parallel.mesh import AXES
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 1, 1, 2), AXES)
+        eng = InferenceEngine(
+            cfg, params, n_slots=8, max_len=256, paged=True,
+            block_size=block, n_blocks=4 + pool_tokens // block,
+            prefill_chunk=64, queue_size=4 * n_req, mesh=mesh)
+        try:
+            for p in sorted(set(plens)):
+                eng.generate(prompts[plens.index(p) % n_req][:p],
+                             max_new_tokens=2)
+            out["paged_mesh"] = {name: run_level(eng, gaps)
+                                 for name, gaps in levels.items()}
+        except Exception as e:  # noqa: BLE001 — record, don't sink the A/B
+            out["paged_mesh"] = f"error: {type(e).__name__}: {e}"
+        finally:
+            eng.shutdown(drain=False)
+
     hi = "burst"
     ab = out["paged"][hi]["tokens_per_s"] / out["fixed"][hi]["tokens_per_s"]
     result = {"levels": out, "value": round(ab, 3),
@@ -573,13 +598,90 @@ def bench_serving_load(on_accel):
                       f"{plens}, same {pool_tokens}-token KV pool both "
                       "legs (fixed: 4 slots x 256; paged: 64x16 blocks, "
                       "8 slots, prefill_chunk 64); Poisson arrivals per "
-                      "level"}
+                      "level; paged_mesh = same paged engine sharded "
+                      "data=4 x model=2 over the 8-device mesh"}
     if ab < 1.2:
         result["skip_reason"] = (
             f"paged-vs-fixed tokens/s A/B measured {ab:.3f}x (< 1.2x "
             "gate) on this backend — recorded with full level numbers "
             "above; the win requires tick cost to stay sub-linear in "
             "batch width (true on TPU, dispatch-bound CPU varies)")
+    return result
+
+
+def bench_serving_spec(on_accel):
+    """ISSUE 10: speculative-decoding A/B — tokens/s spec vs non-spec at
+    three temperatures on gpt_tiny, with the measured draft acceptance
+    rate. The draft is a 1-layer truncation of the target sharing
+    embeddings and head (models.gpt_truncate — the gpt_nano-class
+    contract a separately trained draft would also satisfy).
+
+    The speculative tick is ONE compiled program (k draft steps + the
+    k+1-position verify + acceptance), so per tick a stream costs one
+    dispatch instead of one per token — on a dispatch-bound CPU host
+    the verify pass amortizes exactly that, and on TPU it additionally
+    turns k serial matmul-bound steps into one wider pass."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import gpt_init, gpt_tiny
+    from paddle_tpu.models.gpt import gpt_truncate
+    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.serving import InferenceEngine
+
+    cfg = gpt_tiny(seq_len=256,
+                   dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    params = gpt_init(cfg, seed=0)
+    draft = gpt_truncate(cfg, params, 1)
+    rng = np.random.default_rng(0)
+    n_req, max_new = 4, 48
+    prompts = [rng.integers(0, cfg.vocab_size, 24).astype(np.int32)
+               for _ in range(n_req)]
+
+    def run(draft_arg, temp):
+        eng = InferenceEngine(cfg, params, n_slots=4, max_len=256,
+                              draft=draft_arg, spec_k=6)
+        try:
+            # warm the prefill bucket + both decode programs
+            eng.generate(prompts[0], max_new_tokens=4, temperature=temp)
+            d0 = stat_get("serving_decode_ms")
+            p0, a0 = stat_get("spec_proposed"), stat_get("spec_accepted")
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, max_new_tokens=max_new, temperature=temp)
+                    for p in prompts]
+            toks = sum(len(r.result(timeout=600)) for r in reqs)
+            wall = time.perf_counter() - t0
+            dms = stat_get("serving_decode_ms") - d0
+            tps = toks / (dms / 1e3) if dms > 0 else toks / wall
+            prop = stat_get("spec_proposed") - p0
+            acc = stat_get("spec_accepted") - a0
+            return {"tokens_per_s": round(tps, 2),
+                    "acceptance": round(acc / prop, 3) if prop else None}
+        finally:
+            eng.shutdown(drain=False)
+
+    temps = {}
+    for temp in (0.0, 0.7, 1.0):
+        base = run(None, temp)
+        spec = run(draft, temp)
+        temps[f"t{temp}"] = {
+            "nonspec_tokens_per_s": base["tokens_per_s"],
+            "spec_tokens_per_s": spec["tokens_per_s"],
+            "speedup": round(spec["tokens_per_s"] / base["tokens_per_s"], 3),
+            "acceptance": spec["acceptance"]}
+    g = temps["t0.0"]
+    result = {"temps": temps, "value": g["speedup"],
+              "unit": "x tokens/s, spec/nonspec @ greedy",
+              "acceptance_at_greedy": g["acceptance"],
+              "note": f"{n_req} req x {max_new} tokens, prompt 24, 4 "
+                      "slots, spec_k 6; draft = 1-layer truncation "
+                      "sharing embeddings/head; tokens/s is decode-phase "
+                      "(serving_decode_ms), greedy output pinned "
+                      "token-identical by tests/test_serving_spec.py"}
+    if g["speedup"] < 1.3 or (g["acceptance"] or 0.0) < 0.6:
+        result["skip_reason"] = (
+            f"spec A/B measured {g['speedup']}x at acceptance "
+            f"{g['acceptance']} (< 1.3x @ >= 0.6 gate) on this backend — "
+            "full per-temperature numbers recorded above")
     return result
 
 
@@ -1100,6 +1202,7 @@ def main():
                      ("ring_attention", bench_ring_attention),
                      ("gpt_tiny_fused", bench_gpt_tiny_fused),
                      ("gpt_tiny_serving", bench_gpt_tiny_serving),
+                     ("serving_spec", bench_serving_spec),
                      ("serving_load", bench_serving_load)):
         if over_budget():
             configs[name] = "skipped: time budget (BENCH_TIME_BUDGET)"

@@ -241,6 +241,15 @@ def _int_or_zero(value) -> int:
 shm_slot_bytes = [_int_or_zero(os.environ.get("FLAGS_shm_slot_bytes", "0"))]
 
 
+# FLAGS_serving_mesh (ISSUE 10): multi-chip sharded decode for the
+# serving engine — an integer DATA degree: decode slots shard over the
+# mesh "data" axis, the remaining devices become the "model" axis over
+# which weights shard Megatron-style via gpt_param_specs (GSPMD derives
+# the collectives). 0 (default) keeps the single-chip engine bit-for-bit;
+# an explicit ``InferenceEngine(mesh=...)`` overrides the flag either way.
+serving_mesh = [_int_or_zero(os.environ.get("FLAGS_serving_mesh", "0"))]
+
+
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
         check_nan_inf[0] = _truthy(value)
@@ -273,6 +282,8 @@ def set_flag(name: str, value) -> None:
         apply_shardy_flag()
     elif name.endswith("shm_slot_bytes"):
         shm_slot_bytes[0] = _int_or_zero(value)
+    elif name.endswith("serving_mesh"):
+        serving_mesh[0] = _int_or_zero(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

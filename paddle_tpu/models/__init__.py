@@ -17,9 +17,13 @@ from .gpt import (
     gpt_prefill_chunk,
     gpt_decode_step,
     gpt_decode_step_paged,
+    gpt_verify_step,
+    gpt_verify_step_paged,
+    gpt_truncate,
     gpt_tiny,
     gpt_small,
     gpt_1p3b,
+    gpt_nano,
     bert_base_config,
 )
 
@@ -27,5 +31,6 @@ __all__ = [
     "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
     "gpt_prefill", "gpt_prefill_chunk",
     "gpt_decode_step", "gpt_decode_step_paged",
-    "gpt_tiny", "gpt_small", "gpt_1p3b", "bert_base_config",
+    "gpt_verify_step", "gpt_verify_step_paged", "gpt_truncate",
+    "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_nano", "bert_base_config",
 ]

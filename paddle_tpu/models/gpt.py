@@ -37,8 +37,9 @@ from ..ops.flash_attention import NEG_INF, _attention_reference, _on_tpu
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
            "gpt_param_specs", "gpt_tiny", "gpt_small", "gpt_1p3b",
-           "bert_base_config", "gpt_prefill", "gpt_decode_step",
-           "gpt_decode_step_paged", "gpt_prefill_chunk",
+           "gpt_nano", "gpt_truncate", "bert_base_config", "gpt_prefill",
+           "gpt_decode_step", "gpt_decode_step_paged", "gpt_prefill_chunk",
+           "gpt_verify_step", "gpt_verify_step_paged",
            "quantize_gpt_weights"]
 
 
@@ -97,6 +98,34 @@ def gpt_1p3b(**kw):
     d = dict(hidden=2048, n_layers=24, n_heads=16, seq_len=2048)
     d.update(kw)
     return GPTConfig(**d)
+
+
+def gpt_nano(**kw):
+    # draft-model scale for speculative decoding (ISSUE 10): small enough
+    # that k draft steps cost less than the one target pass they save
+    d = dict(vocab_size=512, hidden=64, n_layers=2, n_heads=4, seq_len=64)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt_truncate(cfg: GPTConfig, params, n_layers: int):
+    """Layer-truncated draft model: the first ``n_layers`` blocks of
+    ``params`` with the embeddings/final-LN/tied head SHARED with the
+    target. Returns ``(draft_cfg, draft_params)`` ready for
+    ``serving.InferenceEngine(draft=...)``.
+
+    Sharing wte/wpe/lnf keeps the truncated model's logits correlated
+    with the target's without any extra training — the cheapest useful
+    speculative-decoding draft (a separately trained gpt_nano-class
+    model slots into the same contract). ``params`` must be the plain
+    gpt_init layout (quantize AFTER truncation, not before)."""
+    if not 1 <= n_layers <= cfg.n_layers:
+        raise ValueError(
+            f"n_layers={n_layers} outside [1, {cfg.n_layers}]")
+    draft = dict(params)
+    draft["blocks"] = {name: leaf[:n_layers]
+                      for name, leaf in params["blocks"].items()}
+    return dataclasses.replace(cfg, n_layers=n_layers), draft
 
 
 def bert_base_config(**kw):
@@ -517,6 +546,85 @@ def gpt_decode_step(cfg: GPTConfig, params, cache, positions, tokens):
     return _head(cfg, params, x)[:, 0], (k_cache, v_cache)
 
 
+def _block_verify(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
+    """C-token block step against one layer's cache slice (ISSUE 10 —
+    the speculative-decoding verify shape, also a batched chunk append).
+
+    x (B, C, H); kc_l/vc_l (B, nh, max_len, hd); positions (B,) int32 —
+    the index the FIRST incoming token occupies; token j of a row lands
+    at ``positions + j``. The C new K/V rows are one contiguous span, so
+    ONE dynamic_update_slice per slot writes them all; each query j then
+    attends over the slot masked to ``pos <= positions + j`` — the math
+    per query equals :func:`_block_decode` run token-by-token."""
+    B, C, _ = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    cd = cfg.dtype
+
+    h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
+    qkv = _dec_mm(h, p["qkv_w"], cd) + p["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)          # each (B, C, H)
+    to_heads = lambda t: t.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)   # (B, nh, C, hd)
+
+    def write(c, new, pos):  # c (nh, max_len, hd), new (nh, C, hd)
+        return jax.lax.dynamic_update_slice(c, new, (0, pos, 0))
+
+    kc_l = jax.vmap(write)(kc_l, k.astype(kc_l.dtype), positions)
+    vc_l = jax.vmap(write)(vc_l, v.astype(vc_l.dtype), positions)
+
+    scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kc_l.astype(q.dtype)) * scale
+    qpos = positions[:, None] + jnp.arange(C)[None, :]        # (B, C)
+    live = jnp.arange(kc_l.shape[2])[None, None, :] <= qpos[:, :, None]
+    s = jnp.where(live[:, None], s, NEG_INF)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", w, vc_l.astype(q.dtype))
+    o = o.transpose(0, 2, 1, 3).reshape(B, C, nh * hd)
+
+    x = x + _dec_mm(o, p["proj_w"], cd) + p["proj_b"].astype(cd)
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(_dec_mm(h, p["fc_w"], cd) + p["fc_b"].astype(cd))
+    x = x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
+    return x, kc_l, vc_l
+
+
+def gpt_verify_step(cfg: GPTConfig, params, cache, positions, tokens):
+    """Batched MULTI-token decode against a slotted KV cache (ISSUE 10).
+
+    cache = (k, v), each (B, L, nh, max_len, hd); positions (B,) int32 —
+    where each row's FIRST token lands (token j at ``positions + j``);
+    tokens (B, C) int32. Returns (logits (B, C, V) fp32, new cache):
+    logits[:, j] is the next-token distribution after consuming tokens
+    ``[..j]`` — exactly what gpt_decode_step would return fed the same
+    tokens one at a time, in ONE program. This is the
+    speculative-decoding verify pass: the target model scores a draft's
+    k proposals plus the bonus position in a single dispatch. The caller
+    must guarantee ``positions + C <= max_len`` (the engine's headroom
+    check); rows whose later entries are rejected leave stale K/V past
+    the accepted length, which the position mask hides until the next
+    step overwrites them."""
+    k_cache, v_cache = cache
+    cd = cfg.dtype
+    L = k_cache.shape[1]
+    C = tokens.shape[1]
+    qpos = positions[:, None] + jnp.arange(C)[None, :]
+    x = params["wte"].astype(cd)[tokens] + params["wpe"].astype(cd)[qpos]
+
+    def step(carry, inp):
+        x, kc, vc = carry
+        layer_p, li = inp
+        kc_l = jnp.take(kc, li, axis=1)
+        vc_l = jnp.take(vc, li, axis=1)
+        x, kc_l, vc_l = _block_verify(cfg, layer_p, x, kc_l, vc_l, positions)
+        kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 1)
+        vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 1)
+        return (x, kc, vc), None
+
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        step, (x, k_cache, v_cache), (params["blocks"], jnp.arange(L)))
+    return _head(cfg, params, x), (k_cache, v_cache)
+
+
 # --------------------------------------------------------------------------
 # Paged KV cache variants (serving.PagedKVCache, ISSUE 7)
 # --------------------------------------------------------------------------
@@ -598,6 +706,89 @@ def gpt_decode_step_paged(cfg: GPTConfig, params, pool, tables, positions,
     (x, kb, vb), _ = jax.lax.scan(
         step, (x, kb, vb), (params["blocks"], jnp.arange(L)))
     return _head(cfg, params, x)[:, 0], (kb, vb)
+
+
+def _block_verify_paged(cfg: GPTConfig, p, x, kb_l, vb_l, tables,
+                        positions):
+    """C-token block step against one layer's slice of the block pool.
+
+    x (B, C, H); kb_l/vb_l (n_blocks, nh, block_size, hd); tables (B, W)
+    int32; positions (B,) int32 — token j of row b lands at block
+    ``tables[b, (positions[b]+j) // bs]``, offset ``(positions[b]+j) %
+    bs``. Attention is the composed table gather (the multi-query shape
+    the Pallas decode kernel does not cover); the table width W is
+    already bucketed by the engine, so gather work tracks live tokens."""
+    B, C, _ = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    bs = kb_l.shape[2]
+    cd = cfg.dtype
+    W = tables.shape[1]
+
+    h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
+    qkv = _dec_mm(h, p["qkv_w"], cd) + p["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)          # each (B, C, H)
+    qh = q.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)   # (B, nh, C, hd)
+    kh = k.reshape(B, C, nh, hd)
+    vh = v.reshape(B, C, nh, hd)
+
+    # scatter the C new K/V of every row; live slots own their blocks
+    # exclusively (positions contiguous), so the only index collisions
+    # are stale lanes piling onto their garbage sink
+    qpos = positions[:, None] + jnp.arange(C)[None, :]        # (B, C)
+    blk = jnp.take_along_axis(tables, qpos // bs, axis=1)
+    off = qpos % bs
+    kb_l = kb_l.at[blk, :, off, :].set(kh.astype(kb_l.dtype))
+    vb_l = vb_l.at[blk, :, off, :].set(vh.astype(vb_l.dtype))
+
+    kg = kb_l[tables].transpose(0, 2, 1, 3, 4).reshape(B, nh, W * bs, hd)
+    vg = vb_l[tables].transpose(0, 2, 1, 3, 4).reshape(B, nh, W * bs, hd)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kg.astype(qh.dtype)) \
+        * (1.0 / math.sqrt(hd))
+    live = jnp.arange(W * bs)[None, None, :] <= qpos[:, :, None]
+    s = jnp.where(live[:, None], s, NEG_INF)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(qh.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(qh.dtype))
+    o = o.transpose(0, 2, 1, 3).reshape(B, C, nh * hd)
+
+    x = x + _dec_mm(o, p["proj_w"], cd) + p["proj_b"].astype(cd)
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(_dec_mm(h, p["fc_w"], cd) + p["fc_b"].astype(cd))
+    x = x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
+    return x, kb_l, vb_l
+
+
+def gpt_verify_step_paged(cfg: GPTConfig, params, pool, tables, positions,
+                          tokens):
+    """Batched multi-token decode against a paged block pool (ISSUE 10).
+
+    pool = (kb, vb), each (n_blocks, L, nh, block_size, hd); tables
+    (B, W) int32; positions (B,) int32 — the first token's index per
+    row; tokens (B, C) int32. Returns (logits (B, C, V) fp32, new pool).
+    Same per-query math as gpt_decode_step_paged; the caller must have
+    grown each live row's table to cover ``positions + C`` tokens (the
+    engine's speculative grow), and stale lanes scatter onto their
+    garbage sink exactly like the single-token step."""
+    kb, vb = pool
+    L = kb.shape[1]
+
+    def step(carry, inp):
+        x, kb, vb = carry
+        layer_p, li = inp
+        kb_l = jnp.take(kb, li, axis=1)
+        vb_l = jnp.take(vb, li, axis=1)
+        x, kb_l, vb_l = _block_verify_paged(cfg, layer_p, x, kb_l, vb_l,
+                                            tables, positions)
+        kb = jax.lax.dynamic_update_index_in_dim(kb, kb_l, li, 1)
+        vb = jax.lax.dynamic_update_index_in_dim(vb, vb_l, li, 1)
+        return (x, kb, vb), None
+
+    cd = cfg.dtype
+    C = tokens.shape[1]
+    qpos = positions[:, None] + jnp.arange(C)[None, :]
+    x = params["wte"].astype(cd)[tokens] + params["wpe"].astype(cd)[qpos]
+    (x, kb, vb), _ = jax.lax.scan(
+        step, (x, kb, vb), (params["blocks"], jnp.arange(L)))
+    return _head(cfg, params, x), (kb, vb)
 
 
 def _block_chunk(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):

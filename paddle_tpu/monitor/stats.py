@@ -181,6 +181,11 @@ DEFAULT_STATS = (
     "fused_kernel_calls",     # fused LN/MLP kernel dispatches (eager surface)
     "int8_matmul_calls",      # int8 weight-quantized matmul dispatches
     "grad_overlap_buckets",   # grad all-reduce buckets issued inside backward
+    # speculative + multi-chip serving (ISSUE 10)
+    "spec_proposed",           # draft tokens proposed by the speculative path
+    "spec_accepted",           # draft tokens accepted by target verification
+    "spec_acceptance_rate",    # gauge: % of proposed draft tokens accepted
+    "serving_shards",          # gauge: "data"-axis shards the engine decodes over
     # fleet.auto hybrid-parallel planner (ISSUE 9)
     "plan_candidates_considered",   # legal candidates scored by the planner
     "zero_level",                   # gauge: chosen ZeRO stage (0-3)
@@ -228,6 +233,10 @@ FUSED_OPTIMIZER_STEPS = _registry.get_stat("fused_optimizer_steps")
 FUSED_KERNEL_CALLS = _registry.get_stat("fused_kernel_calls")
 INT8_MATMUL_CALLS = _registry.get_stat("int8_matmul_calls")
 GRAD_OVERLAP_BUCKETS = _registry.get_stat("grad_overlap_buckets")
+SPEC_PROPOSED = _registry.get_stat("spec_proposed")
+SPEC_ACCEPTED = _registry.get_stat("spec_accepted")
+SPEC_ACCEPTANCE_RATE = _registry.get_stat("spec_acceptance_rate")
+SERVING_SHARDS = _registry.get_stat("serving_shards")
 PLAN_CANDIDATES_CONSIDERED = _registry.get_stat("plan_candidates_considered")
 ZERO_LEVEL = _registry.get_stat("zero_level")
 PIPELINE_BUBBLE_FRAC = _registry.get_stat("pipeline_bubble_frac")

@@ -1,4 +1,4 @@
-"""paddle_tpu.serving — continuous-batching inference engine (ISSUE 4/7).
+"""paddle_tpu.serving — continuous-batching inference engine (ISSUE 4/7/10).
 
 The generation-side counterpart of ``paddle_tpu.inference``: where the
 Predictor serves one compiled program per call (the reference's
@@ -12,38 +12,57 @@ Layers:
   donated device buffers ``(slots, layers, heads, max_len, head_dim)``.
   :class:`PagedKVCache` (``FLAGS_paged_kv=1``): a shared block pool
   ``(n_blocks, layers, heads, block_size, head_dim)`` + per-slot block
-  tables and a host-side free list — slot memory proportional to LIVE
+  tables and host-side free lists — slot memory proportional to LIVE
   tokens, admission gated on free blocks instead of a fixed ``max_len``,
   with ``kv_blocks_free`` / ``kv_blocks_used`` / ``kv_fragmentation``
-  gauges and loud ``AssertionError`` on free-list double-frees;
+  gauges and loud ``AssertionError`` on free-list double-frees. With
+  ``shards=D`` (multi-chip) the pool partitions into per-shard block
+  ranges with per-shard free lists and garbage sinks, so every lookup
+  and scatter stays local to the chip holding that slot's lane;
 - :func:`paddle_tpu.models.gpt_prefill` /
   :func:`paddle_tpu.models.gpt_decode_step` — the cache-aware forward
   variants (they live with the model); paged mode adds
-  :func:`~paddle_tpu.models.gpt_prefill_chunk` (one prompt chunk
-  appended through the block table) and
-  :func:`~paddle_tpu.models.gpt_decode_step_paged`, whose attention is
-  the Pallas paged-attention kernel (ops/paged_attention.py) on TPU and
-  the identical composed gather elsewhere;
+  :func:`~paddle_tpu.models.gpt_prefill_chunk` and
+  :func:`~paddle_tpu.models.gpt_decode_step_paged` (Pallas
+  paged-attention kernel on TPU); speculative decoding adds the
+  multi-token verify passes :func:`~paddle_tpu.models.gpt_verify_step`
+  / ``gpt_verify_step_paged`` — k+1 positions scored in one program;
 - :mod:`sampling` — fused greedy/temperature/top-k/top-p with per-slot
-  parameters;
+  parameters, per-REQUEST RNG streams (``stream_keys`` folds request id
+  + draw index, so a stream's sampled tokens never depend on batch
+  neighbors) and the speculative accept/resample rule
+  (:func:`~paddle_tpu.serving.sampling.spec_accept`);
+- :mod:`tokenizer` — the byte-level text front end:
+  :class:`ByteTokenizer` (byte floor + optional merge vocab file) and
+  :class:`StreamDetokenizer` for utf-8-safe live text streaming; give
+  the engine one and ``submit(text=...)`` / ``stream_text()`` work;
 - :mod:`engine` — the scheduler: bounded queue with backpressure,
-  prefill-and-insert admission (paged: CHUNKED prefill, at most
-  ``prefill_chunk`` tokens per tick, interleaved with decode so long
-  prompts never stall open streams; pool-exhaustion preemption requeues
-  the youngest slot), one batched decode step per tick, eviction
-  without draining, deadlines/cancellation, graceful shutdown, and the
-  serving_* gauges + trace spans.
+  prefill-and-insert admission (paged: CHUNKED prefill interleaved with
+  decode; pool-exhaustion preemption requeues the youngest slot), one
+  batched decode step per tick, eviction without draining,
+  deadlines/cancellation, graceful shutdown, and the serving_* gauges +
+  trace spans. ``draft=(cfg, params)`` switches the tick to
+  speculative decoding (draft proposes ``spec_k``, target verifies k+1
+  in one pass, greedy token-identical to ``draft=None``);
+  ``mesh=``/``FLAGS_serving_mesh=D`` shards slots over "data" and
+  weights over "model" so the tick runs over a whole TPU slice.
 
 Escape hatches: ``paddle.set_flags({"FLAGS_serving_jit": 0})`` swaps the
-jitted cache path for an un-jitted full-recompute reference decode;
-``FLAGS_paged_kv=0`` (default) keeps the fixed-slot cache, pinned
-bit-identical to the pre-paging engine.
+jitted cache path for an un-jitted full-recompute reference decode
+(speculation pauses — the reference path decodes one token at a time);
+``FLAGS_paged_kv=0`` (default) keeps the fixed-slot cache;
+``FLAGS_serving_mesh=0`` + ``draft=None`` (defaults) pin the single-chip
+non-speculative engine.
 """
 from .engine import GenerationRequest, InferenceEngine, QueueFull
 from .kv_cache import KVCache, PagedKVCache, cache_insert
-from .sampling import sample_tokens
+from .sampling import sample_tokens, sample_tokens_streams, spec_accept, \
+    stream_keys
+from .tokenizer import ByteTokenizer, StreamDetokenizer
 
 __all__ = [
     "InferenceEngine", "GenerationRequest", "QueueFull",
-    "KVCache", "PagedKVCache", "cache_insert", "sample_tokens",
+    "KVCache", "PagedKVCache", "cache_insert",
+    "sample_tokens", "sample_tokens_streams", "stream_keys", "spec_accept",
+    "ByteTokenizer", "StreamDetokenizer",
 ]

@@ -45,14 +45,48 @@ changes the tick loop in two ways:
   resumes by re-prefilling its prompt + generated prefix — output
   streams are unaffected.
 
+Speculative decoding (ISSUE 10, ``InferenceEngine(draft=(draft_cfg,
+draft_params), spec_k=k)``): a small draft model (its OWN fixed-slot KV
+cache, prefilled alongside the target's) proposes k tokens per slot per
+tick, and the target model scores all k+1 positions in ONE batched
+verify pass (:func:`~paddle_tpu.models.gpt_verify_step` /
+``gpt_verify_step_paged``). Acceptance follows the standard
+rejection-sampling rule (serving.sampling.spec_accept), so
+temperature/top-k/top-p sampling keeps the target distribution exactly
+and greedy output is token-identical to ``draft=None`` — the whole
+propose+verify+accept tick is one compiled program, so a tick emits up
+to k+1 tokens per stream for one dispatch. Draft contract: same
+vocabulary, gpt_init-layout params (``models.gpt_truncate`` builds a
+layer-truncated draft from the target for free). Rejected positions
+leave stale K/V past the accepted length, which the position masks hide
+until the next step overwrites them; in paged mode the accepted length
+drives the same block accounting as the plain path, with tables grown
+(non-preemptively) to k+1 tokens of headroom — when a slot cannot get
+spec headroom the tick falls back to the plain one-token program.
+
+Multi-chip decode (ISSUE 10, ``FLAGS_serving_mesh=D`` or
+``InferenceEngine(mesh=...)``): decode slots shard over the mesh "data"
+axis and weights shard Megatron-style over "model"
+(models.gpt_param_specs transfers directly — the decode step is a pure
+function over the param pytree), so one jitted tick runs over the whole
+mesh with GSPMD deriving the collectives. The fixed cache shards its
+slot dim, the paged pool partitions its blocks into per-shard ranges
+(per-shard free lists + garbage sinks; see PagedKVCache(shards=D)), and
+admission places each request in the shard with the most free blocks.
+``FLAGS_serving_mesh=0`` (default) with no explicit mesh keeps the
+single-chip engine unchanged.
+
 Observability: gauges serving_queue_depth / serving_slot_occupancy /
 serving_prefill_ms / serving_decode_ms / serving_tokens_per_s (sliding
 window over the last N ticks) / serving_evictions /
 serving_preemptions, kv_blocks_free / kv_blocks_used /
-kv_fragmentation from the block pool, plus ``serving.prefill`` /
-``serving.prefill_chunk`` / ``serving.decode_step`` trace spans that
-``tools/trace_report.py`` turns into prefill-vs-decode and
-prefill-starvation verdicts.
+kv_fragmentation from the block pool, spec_proposed / spec_accepted /
+spec_acceptance_rate from the speculative path and serving_shards for
+the mesh, plus ``serving.prefill`` / ``serving.prefill_chunk`` /
+``serving.decode_step`` trace spans (decode spans carry
+proposed/accepted and per-shard load args) that ``tools/trace_report.py``
+turns into prefill-vs-decode, prefill-starvation, speculation and
+shard-balance verdicts.
 """
 from __future__ import annotations
 
@@ -64,19 +98,27 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import native
 from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
-                          gpt_forward, gpt_prefill, gpt_prefill_chunk)
+                          gpt_forward, gpt_param_specs, gpt_prefill,
+                          gpt_prefill_chunk, gpt_verify_step,
+                          gpt_verify_step_paged)
 from ..monitor.stats import (SERVING_DECODE_MS, SERVING_EVICTIONS,
                              SERVING_PREEMPTIONS, SERVING_PREFILL_MS,
-                             SERVING_QUEUE_DEPTH, SERVING_SLOT_OCCUPANCY,
-                             SERVING_TOKENS_PER_S)
+                             SERVING_QUEUE_DEPTH, SERVING_SHARDS,
+                             SERVING_SLOT_OCCUPANCY, SERVING_TOKENS_PER_S,
+                             SPEC_ACCEPTANCE_RATE, SPEC_ACCEPTED,
+                             SPEC_PROPOSED)
 from ..monitor.trace import span
 from .kv_cache import KVCache, PagedKVCache, cache_insert
-from .sampling import sample_tokens
+from .sampling import (DRAFT_SALT, sample_tokens, sample_tokens_streams,
+                       spec_accept, stream_keys)
 
 __all__ = ["InferenceEngine", "GenerationRequest", "QueueFull"]
+
+_CACHE_SPEC = P("data", None, "model", None, None)
 
 
 class QueueFull(RuntimeError):
@@ -98,6 +140,9 @@ class GenerationRequest:
     Tokens stream in as the scheduler generates them: ``stream()`` yields
     them live, ``result()`` blocks for the full list, ``finish_reason``
     says why generation stopped (eos/length/deadline/cancelled/shutdown).
+    Engines built with a tokenizer also offer ``stream_text()`` /
+    ``text()`` — live detokenized text (specials skipped, split utf-8
+    sequences held until complete).
     """
 
     def __init__(self, prompt, max_new_tokens: int, temperature: float,
@@ -110,10 +155,13 @@ class GenerationRequest:
         self.top_p = float(top_p)
         self.eos_id = eos_id
         self.deadline = deadline          # absolute time.monotonic() or None
+        self.rid = 0                      # engine-assigned request id: the
+        #                                   RNG stream identity (sampling.py)
         self.tokens: List[int] = []       # generated ids (includes eos)
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self._cancelled = False
+        self._tokenizer = None            # set by engines with a text front end
         # paged-mode preemption: (cached-prefix tokens, last token) to
         # re-prefill from when the request is re-admitted
         self._resume = None
@@ -173,6 +221,30 @@ class GenerationRequest:
                     raise RuntimeError("generation failed") from self.error
                 return
 
+    def stream_text(self, timeout: Optional[float] = None):
+        """Yield decoded text pieces as tokens arrive (engine must have a
+        tokenizer). Special ids are skipped; a token that ends mid-utf-8
+        is held until its sequence completes."""
+        if self._tokenizer is None:
+            raise RuntimeError("engine has no tokenizer — pass "
+                               "InferenceEngine(tokenizer=...)")
+        detok = self._tokenizer.stream_detokenizer()
+        for tok in self.stream(timeout):
+            piece = detok.push(tok)
+            if piece:
+                yield piece
+        tail = detok.flush()
+        if tail:
+            yield tail
+
+    def text(self, timeout: Optional[float] = None) -> str:
+        """Block until generation stops; returns the decoded text."""
+        if self._tokenizer is None:
+            raise RuntimeError("engine has no tokenizer — pass "
+                               "InferenceEngine(tokenizer=...)")
+        return self._tokenizer.decode(self.result(timeout),
+                                      skip_special=True)
+
 
 class _Slot:
     """Host-side state of one occupied cache slot."""
@@ -221,6 +293,25 @@ class InferenceEngine:
     TPU. ``block_size`` tokens per pool block; ``n_blocks`` defaults to
     worst-case (every slot at seq_len) — size it smaller to actually
     overcommit. Greedy output is token-identical to paged=False.
+
+    ``draft=(draft_cfg, draft_params)`` enables speculative decoding:
+    ``spec_k`` proposals per slot per tick from the draft, one target
+    verify pass, rejection-sampling acceptance — greedy token-identical
+    to ``draft=None``, sampled output keeps the target distribution.
+    The draft must share the vocabulary and its positional table must
+    cover the engine's ``max_len``. Requires FLAGS_serving_jit=1 (the
+    reference escape hatch decodes one token at a time and must not be
+    flipped mid-run on an engine holding a draft cache).
+
+    ``mesh`` (None = follow FLAGS_serving_mesh) runs the decode over a
+    multi-chip mesh: slots shard over "data", weights over "model";
+    ``n_slots`` must divide by the data degree and ``n_heads`` (target
+    and draft) by the model degree. Not combinable with
+    ``int8_weights`` (the quantized pytree has no spec table yet).
+
+    ``tokenizer`` (serving.tokenizer.ByteTokenizer or anything with the
+    same encode/decode/stream_detokenizer surface) enables the text
+    front end: ``submit(text=...)`` and request ``stream_text()``.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
@@ -228,7 +319,8 @@ class InferenceEngine:
                  eos_id: Optional[int] = None, seed: int = 0,
                  int8_weights: bool = False, paged: Optional[bool] = None,
                  block_size: int = 16, n_blocks: Optional[int] = None,
-                 prefill_chunk: int = 64, tps_window_ticks: int = 64):
+                 prefill_chunk: int = 64, tps_window_ticks: int = 64,
+                 draft=None, spec_k: int = 4, mesh=None, tokenizer=None):
         if getattr(cfg, "fused_mlp", None) is None:
             # pin the fused-MLP choice NOW (graftlint GL002): prefill
             # programs compile lazily per prompt-length bucket, so a
@@ -238,7 +330,22 @@ class InferenceEngine:
 
             cfg = _dc.replace(cfg, fused_mlp=bool(native.fused_kernels[0]))
         self.cfg = cfg
-        self._params = jax.device_put(params)
+        self._mesh = self._resolve_mesh(mesh)
+        self._shards = int(self._mesh.shape["data"]) \
+            if self._mesh is not None else 1
+        if self._mesh is not None:
+            if int8_weights:
+                raise ValueError("int8_weights and mesh are not yet "
+                                 "combinable (no spec table for the "
+                                 "quantized pytree)")
+            if n_slots % self._shards != 0:
+                raise ValueError(f"n_slots={n_slots} not divisible by the "
+                                 f"data degree {self._shards}")
+            model_deg = int(self._mesh.shape["model"])
+            if cfg.n_heads % model_deg != 0:
+                raise ValueError(f"n_heads={cfg.n_heads} not divisible by "
+                                 f"the model degree {model_deg}")
+        self._params = self._put_params(cfg, params)
         self.int8_weights = bool(int8_weights)
         if int8_weights:
             from ..models.gpt import quantize_gpt_weights
@@ -252,7 +359,8 @@ class InferenceEngine:
         self.paged = native.paged_kv[0] if paged is None else bool(paged)
         if self.paged:
             self.cache = PagedKVCache(cfg, n_slots, n_blocks=n_blocks,
-                                      block_size=block_size)
+                                      block_size=block_size,
+                                      shards=self._shards)
             self.block_size = self.cache.block_size
             self.max_len = cfg.seq_len   # positional table = per-slot cap
             if prefill_chunk % self.block_size != 0:
@@ -264,11 +372,19 @@ class InferenceEngine:
             self._decode_paged_jit = jax.jit(self._decode_paged_fn,
                                              donate_argnums=(1, 2))
             self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
+            if self._mesh is not None:
+                self.cache.kb = self._put_cache(self.cache.kb)
+                self.cache.vb = self._put_cache(self.cache.vb)
         else:
             self.cache = KVCache(cfg, n_slots, max_len)
             self.max_len = self.cache.max_len
             self.prefill_chunk = None
+            if self._mesh is not None:
+                self.cache.k = self._put_cache(self.cache.k)
+                self.cache.v = self._put_cache(self.cache.v)
         self.n_slots = self.cache.n_slots
+        self._init_draft(draft, spec_k)
+        self.tokenizer = tokenizer
         self.eos_id = eos_id
         self._queue: collections.deque = collections.deque()
         self._queue_size = int(queue_size)
@@ -278,9 +394,11 @@ class InferenceEngine:
         self._drain = True
         self._error: Optional[BaseException] = None  # scheduler crash cause
         self._base_key = jax.random.key(seed)
-        self._tick = 0
+        self._rid = 0            # next request id (per-request RNG stream)
         self._ticks = 0          # scheduler loop iterations (span tagging)
         self._admit_seq = 0
+        self._spec_prop = 0      # lifetime draft proposals / acceptances
+        self._spec_acc = 0       # behind the acceptance-rate gauge
         # float running totals behind the int ms gauges (prefetch.py idiom:
         # sub-ms ticks still accumulate)
         self._prefill_ms = 0.0
@@ -292,16 +410,111 @@ class InferenceEngine:
             maxlen=max(2, int(tps_window_ticks)))  # (t, n_tokens)
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1, 2))
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        SERVING_SHARDS.set(self._shards)
         self._thread = threading.Thread(target=self._run,
                                         name="serving-scheduler", daemon=True)
         self._thread.start()
 
+    # -- multi-chip placement ------------------------------------------------
+    def _resolve_mesh(self, mesh):
+        """Explicit ``mesh`` wins; else FLAGS_serving_mesh=D builds a
+        (data=D, model=rest) mesh over every visible device; else None
+        (single chip — the pinned PR-7 path)."""
+        if mesh is not None:
+            return mesh
+        degree = int(native.serving_mesh[0])
+        if degree <= 0:
+            return None
+        from jax.sharding import Mesh
+
+        from ..parallel.mesh import AXES
+        devices = jax.devices()
+        if len(devices) % degree != 0:
+            raise ValueError(
+                f"FLAGS_serving_mesh={degree} does not divide the "
+                f"{len(devices)} visible devices")
+        arr = np.array(devices).reshape(degree, 1, 1,
+                                        len(devices) // degree)
+        return Mesh(arr, AXES)
+
+    def _put_params(self, cfg, params):
+        if self._mesh is None:
+            return jax.device_put(params)
+        from ..parallel.sharding import shard_params
+        return shard_params(params, gpt_param_specs(cfg), self._mesh)
+
+    def _put_cache(self, buf):
+        return jax.device_put(buf, NamedSharding(self._mesh, _CACHE_SPEC))
+
+    # -- speculative-decoding setup ------------------------------------------
+    def _init_draft(self, draft, spec_k: int) -> None:
+        if draft is None:
+            self.draft = None
+            self.draft_cfg = None
+            self.spec_k = 0
+            return
+        draft_cfg, draft_params = draft
+        if int(spec_k) < 1:
+            raise ValueError(f"spec_k={spec_k} must be >= 1")
+        if draft_cfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{self.cfg.vocab_size} (the acceptance rule compares "
+                "distributions over one vocabulary)")
+        # paged chunks are block-padded, so the draft cache (and its
+        # positional table) must cover max_len rounded up to a block
+        draft_len = self.max_len if not self.paged else \
+            -(-self.max_len // self.block_size) * self.block_size
+        if draft_cfg.seq_len < draft_len:
+            raise ValueError(
+                f"draft seq_len {draft_cfg.seq_len} < engine cache span "
+                f"{draft_len} — the draft must reach every position the "
+                "target can")
+        if getattr(draft_cfg, "fused_mlp", None) is None:
+            import dataclasses as _dc
+
+            draft_cfg = _dc.replace(
+                draft_cfg, fused_mlp=bool(native.fused_kernels[0]))
+        if self._mesh is not None:
+            model_deg = int(self._mesh.shape["model"])
+            if draft_cfg.n_heads % model_deg != 0:
+                raise ValueError(
+                    f"draft n_heads={draft_cfg.n_heads} not divisible by "
+                    f"the model degree {model_deg}")
+        self.draft_cfg = draft_cfg
+        self._draft_params = self._put_params(draft_cfg, draft_params)
+        self.draft = (draft_cfg, self._draft_params)
+        self.spec_k = int(spec_k)
+        # the draft always decodes against its own fixed-slot cache —
+        # k short steps over a small model don't need paging
+        self.draft_cache = KVCache(draft_cfg, self.n_slots,
+                                   max_len=draft_len)
+        if self._mesh is not None:
+            self.draft_cache.k = self._put_cache(self.draft_cache.k)
+            self.draft_cache.v = self._put_cache(self.draft_cache.v)
+        self._prefill_spec_jit = jax.jit(self._prefill_spec_fn,
+                                         donate_argnums=(2, 3, 4, 5))
+        if self.paged:
+            self._spec_paged_jit = jax.jit(self._spec_paged_fn,
+                                           donate_argnums=(2, 3, 4, 5))
+            self._chunk_spec_jit = jax.jit(self._chunk_spec_fn,
+                                           donate_argnums=(2, 3, 4, 5))
+        else:
+            self._spec_jit = jax.jit(self._spec_fn,
+                                     donate_argnums=(2, 3, 4, 5))
+
     # -- compiled programs ---------------------------------------------------
-    def _decode_fn(self, params, k, v, positions, tokens, key, temps,
-                   top_ks, top_ps):
+    def _sample_args(self, logits, base_key, rids, steps, temps, top_ks,
+                    top_ps):
+        keys = stream_keys(base_key, rids, steps)
+        return sample_tokens_streams(logits, keys, temps, top_ks, top_ps)
+
+    def _decode_fn(self, params, k, v, positions, tokens, base_key, rids,
+                   steps, temps, top_ks, top_ps):
         logits, (k, v) = gpt_decode_step(self.cfg, params, (k, v),
                                          positions, tokens)
-        toks = sample_tokens(logits, key, temps, top_ks, top_ps)
+        toks = self._sample_args(logits, base_key, rids, steps, temps,
+                                 top_ks, top_ps)
         return toks, k, v
 
     def _prefill_fn(self, params, k, v, tokens, slot, true_len, key, temp,
@@ -316,11 +529,26 @@ class InferenceEngine:
                             top_p[None])[0]
         return tok, k, v
 
+    def _prefill_spec_fn(self, params, dparams, k, v, dk, dv, tokens, slot,
+                         true_len, key, temp, top_k, top_p):
+        # target prefill + draft prefill in ONE program: both caches seed
+        # the same slot so the first speculative tick can draft at once
+        logits, (ke, ve) = gpt_prefill(self.cfg, params, tokens)
+        k, v = cache_insert(k, v, slot, ke[0], ve[0])
+        _, (dke, dve) = gpt_prefill(self.draft_cfg, dparams, tokens)
+        dk, dv = cache_insert(dk, dv, slot, dke[0], dve[0])
+        last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, 0,
+                                            keepdims=False)
+        tok = sample_tokens(last[None], key, temp[None], top_k[None],
+                            top_p[None])[0]
+        return tok, k, v, dk, dv
+
     def _decode_paged_fn(self, params, kb, vb, tables, positions, tokens,
-                         key, temps, top_ks, top_ps):
+                         base_key, rids, steps, temps, top_ks, top_ps):
         logits, (kb, vb) = gpt_decode_step_paged(
             self.cfg, params, (kb, vb), tables, positions, tokens)
-        toks = sample_tokens(logits, key, temps, top_ks, top_ps)
+        toks = self._sample_args(logits, base_key, rids, steps, temps,
+                                 top_ks, top_ps)
         return toks, kb, vb
 
     def _chunk_fn(self, params, kb, vb, table_row, tokens, start):
@@ -330,20 +558,97 @@ class InferenceEngine:
             self.cfg, params, (kb, vb), table_row, tokens, start)
         return logits, kb, vb
 
+    def _chunk_spec_fn(self, params, dparams, kb, vb, dk, dv, table_row,
+                       slot, tokens, start):
+        # paged target chunk + the same chunk appended to the draft's
+        # fixed cache row (gpt_verify_step doubles as a chunk append)
+        logits, (kb, vb) = gpt_prefill_chunk(
+            self.cfg, params, (kb, vb), table_row, tokens, start)
+        row_k = jax.lax.dynamic_slice_in_dim(dk, slot, 1, axis=0)
+        row_v = jax.lax.dynamic_slice_in_dim(dv, slot, 1, axis=0)
+        _, (row_k, row_v) = gpt_verify_step(
+            self.draft_cfg, dparams, (row_k, row_v),
+            jnp.reshape(start, (1,)), tokens)
+        dk = jax.lax.dynamic_update_slice_in_dim(dk, row_k, slot, axis=0)
+        dv = jax.lax.dynamic_update_slice_in_dim(dv, row_v, slot, axis=0)
+        return logits, kb, vb, dk, dv
+
+    def _draft_propose(self, dparams, dk, dv, positions, tokens, base_key,
+                       rids, steps, temps, top_ks, top_ps):
+        """spec_k autoregressive draft steps (unrolled into the one spec
+        program): returns proposed tokens (B, K), the distributions they
+        were drawn from (B, K, V), and the updated draft cache."""
+        cur = tokens
+        d_toks, d_logits = [], []
+        for j in range(self.spec_k):
+            lg, (dk, dv) = gpt_decode_step(self.draft_cfg, dparams,
+                                           (dk, dv), positions + j, cur)
+            keys = stream_keys(base_key, rids, steps + j)
+            dkeys = jax.vmap(
+                lambda kk: jax.random.fold_in(kk, DRAFT_SALT))(keys)
+            cur = sample_tokens_streams(lg, dkeys, temps, top_ks, top_ps)
+            d_toks.append(cur)
+            d_logits.append(lg)
+        return (jnp.stack(d_toks, axis=1), jnp.stack(d_logits, axis=1),
+                dk, dv)
+
+    def _spec_fn(self, params, dparams, k, v, dk, dv, positions, tokens,
+                 base_key, rids, steps, temps, top_ks, top_ps):
+        d_toks, d_logits, dk, dv = self._draft_propose(
+            dparams, dk, dv, positions, tokens, base_key, rids, steps,
+            temps, top_ks, top_ps)
+        vtokens = jnp.concatenate([tokens[:, None], d_toks], axis=1)
+        t_logits, (k, v) = gpt_verify_step(self.cfg, params, (k, v),
+                                           positions, vtokens)
+        keys = stream_keys(base_key, rids, steps)
+        out, n_emit = spec_accept(t_logits, d_logits, d_toks, keys, temps,
+                                  top_ks, top_ps)
+        return out, n_emit, k, v, dk, dv
+
+    def _spec_paged_fn(self, params, dparams, kb, vb, dk, dv, tables,
+                       positions, tokens, base_key, rids, steps, temps,
+                       top_ks, top_ps):
+        d_toks, d_logits, dk, dv = self._draft_propose(
+            dparams, dk, dv, positions, tokens, base_key, rids, steps,
+            temps, top_ks, top_ps)
+        vtokens = jnp.concatenate([tokens[:, None], d_toks], axis=1)
+        t_logits, (kb, vb) = gpt_verify_step_paged(
+            self.cfg, params, (kb, vb), tables, positions, vtokens)
+        keys = stream_keys(base_key, rids, steps)
+        out, n_emit = spec_accept(t_logits, d_logits, d_toks, keys, temps,
+                                  top_ks, top_ps)
+        return out, n_emit, kb, vb, dk, dv
+
     # -- public API ----------------------------------------------------------
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+    def submit(self, prompt: Optional[Sequence[int]] = None,
+               max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_id: Optional[int] = None, deadline_s: Optional[float] = None,
-               block: bool = True,
-               timeout: Optional[float] = None) -> GenerationRequest:
+               block: bool = True, timeout: Optional[float] = None,
+               text: Optional[str] = None) -> GenerationRequest:
         """Queue a generation request; returns its streaming handle.
 
-        Backpressure: when the bounded queue is full, ``block=True`` waits
-        (up to ``timeout`` seconds) for space and raises :class:`QueueFull`
-        on timeout; ``block=False`` raises immediately. ``deadline_s`` is a
-        wall-clock budget from now — a request over budget is evicted with
-        ``finish_reason="deadline"`` wherever it is (queued or mid-decode).
+        Exactly one of ``prompt`` (token ids) and ``text`` must be given;
+        ``text`` requires the engine's tokenizer, encodes through it, and
+        defaults ``eos_id`` to the tokenizer's (so ``stream_text()``
+        terminates naturally). Backpressure: when the bounded queue is
+        full, ``block=True`` waits (up to ``timeout`` seconds) for space
+        and raises :class:`QueueFull` on timeout; ``block=False`` raises
+        immediately. ``deadline_s`` is a wall-clock budget from now — a
+        request over budget is evicted with ``finish_reason="deadline"``
+        wherever it is (queued or mid-decode).
         """
+        if text is not None:
+            if prompt is not None:
+                raise ValueError("pass prompt OR text, not both")
+            if self.tokenizer is None:
+                raise ValueError("submit(text=...) needs an engine "
+                                 "tokenizer — InferenceEngine(tokenizer=...)")
+            prompt = self.tokenizer.encode(text)
+            if eos_id is None and self.eos_id is None:
+                eos_id = self.tokenizer.eos_id
+        if prompt is None:
+            raise ValueError("provide a prompt (token ids) or text")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -355,15 +660,17 @@ class InferenceEngine:
                 + (f"(positional table seq_len={self.max_len})" if self.paged
                    else f"(cache max_len={self.max_len})"))
         if self.paged and \
-                self.cache.blocks_for(prompt.size + 1) > self.cache.n_blocks - 1:
+                self.cache.blocks_for(prompt.size + 1) > \
+                self.cache.max_slot_blocks:
             raise ValueError(
-                f"prompt length {prompt.size} can never fit the block pool "
-                f"({self.cache.n_blocks - 1} blocks x "
+                f"prompt length {prompt.size} can never fit one shard of "
+                f"the block pool ({self.cache.max_slot_blocks} blocks x "
                 f"{self.block_size} tokens)")
         req = GenerationRequest(
             prompt, max_new_tokens, temperature, top_k, top_p,
             self.eos_id if eos_id is None else eos_id,
             None if deadline_s is None else time.monotonic() + deadline_s)
+        req._tokenizer = self.tokenizer
         with self._cv:
             self._check_open()
             if len(self._queue) >= self._queue_size:
@@ -377,12 +684,17 @@ class InferenceEngine:
                     raise QueueFull(
                         f"serving queue still full after {timeout}s")
                 self._check_open()
+            # the request id is the RNG stream identity: assigned in
+            # submission order, so a stream's sampled tokens are a pure
+            # function of (seed, rid) — batch neighbors can't perturb it
+            req.rid = self._rid
+            self._rid += 1
             self._queue.append(req)
             SERVING_QUEUE_DEPTH.set(len(self._queue))
             self._cv.notify_all()
         return req
 
-    def generate(self, prompt: Sequence[int], **kw) -> List[int]:
+    def generate(self, prompt: Sequence[int] = None, **kw) -> List[int]:
         """Blocking convenience wrapper: submit + result."""
         return self.submit(prompt, **kw).result()
 
@@ -470,11 +782,14 @@ class InferenceEngine:
     def _admit(self) -> None:
         """Move queued requests into free slots. Fixed mode: prefill-and-
         insert on the spot. Paged mode: capacity-check the head of the
-        queue against the FREE BLOCK pool (queue-until-available — a
-        too-long prompt waits for evictions instead of being rejected),
-        then park the prompt on the slot for the chunked-prefill tick."""
+        queue against the free-block pool of a shard that also has a
+        free slot (queue-until-available — a too-long prompt waits for
+        evictions instead of being rejected; multi-chip admission lands
+        in the shard with the most free blocks), then park the prompt on
+        the slot for the chunked-prefill tick."""
         paged = self.paged and native.serving_jit[0]
         while self.cache.free_count > 0:
+            shard = None
             with self._cv:
                 if not self._queue:
                     break
@@ -482,7 +797,8 @@ class InferenceEngine:
                     head = self._queue[0]
                     seq = head._resume[0] if head._resume is not None \
                         else head.prompt
-                    if not self.cache.can_admit(seq.size + 1):
+                    shard = self.cache.admit_shard(seq.size + 1)
+                    if shard is None:
                         break   # head-of-line waits for blocks to free up
                 req = self._queue.popleft()
                 SERVING_QUEUE_DEPTH.set(len(self._queue))
@@ -493,7 +809,8 @@ class InferenceEngine:
             if req.deadline is not None and time.monotonic() > req.deadline:
                 req._finish(DEADLINE)
                 continue
-            slot = self.cache.alloc()
+            slot = self.cache.alloc(prefer_shard=shard) if paged \
+                else self.cache.alloc()
             if paged:
                 st = _Slot(req, length=0, last_token=-1)
                 st.generated = len(req.tokens)   # nonzero on resume
@@ -530,10 +847,12 @@ class InferenceEngine:
             b *= 2
         return min(b, self.cache.table_width)
 
-    def _next_key(self):
-        key = jax.random.fold_in(self._base_key, self._tick)
-        self._tick += 1
-        return key
+    def _stream_key(self, rid: int, draw: int):
+        """Host-side stream key for single-row programs (prefill): the
+        same (seed, request, draw) fold the batched steps compute
+        in-jit."""
+        return jax.random.fold_in(
+            jax.random.fold_in(self._base_key, rid % (2**31 - 1)), draw)
 
     def _prefill(self, req: GenerationRequest, slot: int) -> None:
         S = int(req.prompt.size)
@@ -544,16 +863,27 @@ class InferenceEngine:
                 s_pad = self._bucket(S)
                 toks = np.zeros((1, s_pad), np.int32)
                 toks[0, :S] = req.prompt
-                tok, self.cache.k, self.cache.v = self._prefill_jit(
-                    self._params, self.cache.k, self.cache.v,
-                    jnp.asarray(toks), np.int32(slot), np.int32(S),
-                    self._next_key(), np.float32(req.temperature),
-                    np.int32(req.top_k), np.float32(req.top_p))
+                key = self._stream_key(req.rid, 0)
+                if self.draft is not None:
+                    (tok, self.cache.k, self.cache.v, self.draft_cache.k,
+                     self.draft_cache.v) = self._prefill_spec_jit(
+                        self._params, self._draft_params, self.cache.k,
+                        self.cache.v, self.draft_cache.k,
+                        self.draft_cache.v, jnp.asarray(toks),
+                        np.int32(slot), np.int32(S), key,
+                        np.float32(req.temperature), np.int32(req.top_k),
+                        np.float32(req.top_p))
+                else:
+                    tok, self.cache.k, self.cache.v = self._prefill_jit(
+                        self._params, self.cache.k, self.cache.v,
+                        jnp.asarray(toks), np.int32(slot), np.int32(S),
+                        key, np.float32(req.temperature),
+                        np.int32(req.top_k), np.float32(req.top_p))
             else:
                 logits = gpt_forward(self.cfg, self._params,
                                      jnp.asarray(req.prompt[None]))
                 tok = sample_tokens(
-                    logits[:, -1], self._next_key(),
+                    logits[:, -1], self._stream_key(req.rid, 0),
                     jnp.float32(req.temperature)[None],
                     jnp.int32(req.top_k)[None],
                     jnp.float32(req.top_p)[None])[0]
@@ -614,10 +944,18 @@ class InferenceEngine:
             toks[0, :c_true] = pending[:c_true]
             row = self.cache.table_row(slot)[:self._width_bucket(
                 self.cache.blocks_for(st.length + c_pad))]
-            logits, self.cache.kb, self.cache.vb = self._chunk_jit(
-                self._params, self.cache.kb, self.cache.vb,
-                jnp.asarray(row), jnp.asarray(toks),
-                np.int32(st.length))
+            if self.draft is not None:
+                (logits, self.cache.kb, self.cache.vb, self.draft_cache.k,
+                 self.draft_cache.v) = self._chunk_spec_jit(
+                    self._params, self._draft_params, self.cache.kb,
+                    self.cache.vb, self.draft_cache.k, self.draft_cache.v,
+                    jnp.asarray(row), np.int32(slot), jnp.asarray(toks),
+                    np.int32(st.length))
+            else:
+                logits, self.cache.kb, self.cache.vb = self._chunk_jit(
+                    self._params, self.cache.kb, self.cache.vb,
+                    jnp.asarray(row), jnp.asarray(toks),
+                    np.int32(st.length))
         self._note_ms(SERVING_PREFILL_MS, "_prefill_ms",
                       (time.perf_counter() - t0) * 1e3)
         st.length += c_true
@@ -633,7 +971,7 @@ class InferenceEngine:
             st.resume_last = None
             return
         tok = int(sample_tokens(
-            logits[0:1, c_true - 1], self._next_key(),
+            logits[0:1, c_true - 1], self._stream_key(st.req.rid, 0),
             jnp.float32(st.req.temperature)[None],
             jnp.int32(st.req.top_k)[None],
             jnp.float32(st.req.top_p)[None])[0])
@@ -701,6 +1039,24 @@ class InferenceEngine:
                 ready.append(s)
         return [s for s in ready if self._slots[s] is not None]
 
+    def _try_spec_grow(self, active: List[int]) -> bool:
+        """Paged spec headroom: grow every active table to cover the k
+        proposals + bonus WITHOUT preempting anyone (speculation is an
+        optimization, never worth evicting work for). False → this tick
+        falls back to the plain one-token program."""
+        for s in active:
+            st = self._slots[s]
+            if not self.cache.grow(s, st.length + self.spec_k + 1):
+                return False
+        return True
+
+    def _shard_load(self, active: List[int]) -> List[int]:
+        per = self.n_slots // self._shards
+        load = [0] * self._shards
+        for s in active:
+            load[s // per] += 1
+        return load
+
     def _decode_tick(self) -> None:
         now = time.monotonic()
         for s, st in enumerate(self._slots):
@@ -715,16 +1071,28 @@ class InferenceEngine:
                   and self._slots[s].pending is None]
         if not active:
             return
+        # speculation needs k+1 positions of cache headroom on every
+        # active slot; a near-cap slot drops the whole tick to the plain
+        # one-token program (correct, just unaccelerated) rather than
+        # splitting the batch across two programs
+        use_spec = (self.draft is not None and native.serving_jit[0]
+                    and all(self._slots[s].length + self.spec_k + 1
+                            <= self.max_len for s in active))
         if self.paged and native.serving_jit[0]:
-            active = self._grow_for_decode(active)
-            if not active:
-                return
+            if use_spec:
+                use_spec = self._try_spec_grow(active)
+            if not use_spec:
+                active = self._grow_for_decode(active)
+                if not active:
+                    return
 
         positions = np.zeros(self.n_slots, np.int32)
         tokens = np.zeros(self.n_slots, np.int32)
         temps = np.zeros(self.n_slots, np.float32)
         top_ks = np.zeros(self.n_slots, np.int32)
         top_ps = np.ones(self.n_slots, np.float32)
+        rids = np.zeros(self.n_slots, np.int32)
+        steps = np.zeros(self.n_slots, np.int32)
         for s in active:
             st = self._slots[s]
             positions[s] = st.length
@@ -732,11 +1100,24 @@ class InferenceEngine:
             temps[s] = st.req.temperature
             top_ks[s] = st.req.top_k
             top_ps[s] = st.req.top_p
+            rids[s] = st.req.rid % (2**31 - 1)
+            steps[s] = len(st.req.tokens)
 
+        span_args = {"batch": len(active), "tick": self._ticks}
+        if self._shards > 1:
+            span_args["shards"] = self._shards
+            span_args["shard_load"] = self._shard_load(active)
+        if use_spec:
+            span_args["spec_k"] = self.spec_k
         t0 = time.perf_counter()
-        with span("serving.decode_step", cat="serving",
-                  args={"batch": len(active), "tick": self._ticks}):
-            if native.serving_jit[0]:
+        # span_args is serialized when the span closes, so the spec
+        # proposed/accepted counts added below land in the trace event
+        with span("serving.decode_step", cat="serving", args=span_args):
+            if use_spec:
+                out, n_emit = self._spec_dispatch(active, positions, tokens,
+                                                  rids, steps, temps,
+                                                  top_ks, top_ps)
+            elif native.serving_jit[0]:
                 if self.paged:
                     # table width bucketed to the live maximum (next pow2):
                     # attention/gather work tracks LIVE tokens, not the
@@ -750,17 +1131,18 @@ class InferenceEngine:
                         self._decode_paged_jit(
                             self._decode_params, self.cache.kb,
                             self.cache.vb, tables, positions, tokens,
-                            self._next_key(), temps, top_ks, top_ps)
+                            self._base_key, rids, steps, temps, top_ks,
+                            top_ps)
                 else:
                     out, self.cache.k, self.cache.v = self._decode_jit(
                         self._decode_params, self.cache.k, self.cache.v,
-                        positions,
-                        tokens, self._next_key(), temps, top_ks, top_ps)
+                        positions, tokens, self._base_key, rids, steps,
+                        temps, top_ks, top_ps)
                 out = np.asarray(out)
+                n_emit = None
             else:
                 # reference decode: full recompute per sequence, no cache
                 out = np.zeros(self.n_slots, np.int32)
-                key = self._next_key()
                 for s in active:
                     st = self._slots[s]
                     seq = np.concatenate(
@@ -768,26 +1150,64 @@ class InferenceEngine:
                     logits = gpt_forward(self.cfg, self._params,
                                          jnp.asarray(seq[None]))
                     out[s] = int(sample_tokens(
-                        logits[:, -1], jax.random.fold_in(key, s),
+                        logits[:, -1],
+                        self._stream_key(int(rids[s]), int(steps[s])),
                         temps[s:s + 1], top_ks[s:s + 1], top_ps[s:s + 1])[0])
+                n_emit = None
+            if use_spec:
+                span_args["proposed"] = self.spec_k * len(active)
+                span_args["accepted"] = int(sum(int(n_emit[s]) - 1
+                                               for s in active))
         self._note_ms(SERVING_DECODE_MS, "_decode_ms",
                       (time.perf_counter() - t0) * 1e3)
 
+        emitted = 0
         for s in active:
             st = self._slots[s]
-            tok = int(out[s])
-            st.length += 1
-            st.generated += 1
-            st.last_token = tok
-            self.cache.lengths[s] = st.length
-            st.req._push(tok)
-            reason = self._finish_reason(st, tok)
-            if reason is not None:
-                self._evict(s, reason)
-        self._note_tokens(len(active))
+            burst = [int(out[s])] if n_emit is None \
+                else [int(t) for t in out[s, :int(n_emit[s])]]
+            for tok in burst:
+                st.length += 1
+                st.generated += 1
+                st.last_token = tok
+                self.cache.lengths[s] = st.length
+                st.req._push(tok)
+                emitted += 1
+                reason = self._finish_reason(st, tok)
+                if reason is not None:
+                    self._evict(s, reason)
+                    break
+        if use_spec:
+            self._note_spec(self.spec_k * len(active),
+                            int(sum(int(n_emit[s]) - 1 for s in active)))
+        self._note_tokens(emitted)
         SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
         if self.paged:
             self.cache.update_gauges()   # refresh kv_fragmentation vs lengths
+
+    def _spec_dispatch(self, active, positions, tokens, rids, steps, temps,
+                       top_ks, top_ps):
+        """Run the one-program speculative tick: draft proposes spec_k,
+        target verifies k+1 positions, rejection sampling accepts.
+        Returns (out_tokens (B, k+1) np, n_emit (B,) np)."""
+        if self.paged:
+            tables = self.cache.tables_array(active)
+            tables = tables[:, :self._width_bucket(
+                max(len(self.cache.block_tables[s]) for s in active))]
+            (out, n_emit, self.cache.kb, self.cache.vb, self.draft_cache.k,
+             self.draft_cache.v) = self._spec_paged_jit(
+                self._decode_params, self._draft_params, self.cache.kb,
+                self.cache.vb, self.draft_cache.k, self.draft_cache.v,
+                tables, positions, tokens, self._base_key, rids, steps,
+                temps, top_ks, top_ps)
+        else:
+            (out, n_emit, self.cache.k, self.cache.v, self.draft_cache.k,
+             self.draft_cache.v) = self._spec_jit(
+                self._decode_params, self._draft_params, self.cache.k,
+                self.cache.v, self.draft_cache.k, self.draft_cache.v,
+                positions, tokens, self._base_key, rids, steps, temps,
+                top_ks, top_ps)
+        return np.asarray(out), np.asarray(n_emit)
 
     def _finish_reason(self, st: _Slot, tok: int) -> Optional[str]:
         if st.req.eos_id is not None and tok == st.req.eos_id:
@@ -812,6 +1232,15 @@ class InferenceEngine:
         new = old + ms
         setattr(self, attr, new)
         gauge.add(int(new) - int(old))
+
+    def _note_spec(self, proposed: int, accepted: int) -> None:
+        SPEC_PROPOSED.add(proposed)
+        SPEC_ACCEPTED.add(accepted)
+        self._spec_prop += proposed
+        self._spec_acc += accepted
+        if self._spec_prop > 0:
+            SPEC_ACCEPTANCE_RATE.set(
+                int(round(100.0 * self._spec_acc / self._spec_prop)))
 
     def _note_tokens(self, n: int) -> None:
         # sliding window over the last N tick completions (deque maxlen):

@@ -120,38 +120,62 @@ class PagedKVCache:
     of any per-slot length budget (up to ``cfg.seq_len``, the positional
     table).
 
-    Pool block 0 is RESERVED as the garbage sink: it is never allocated,
-    table padding entries (and the all-zero tables of unoccupied batch
-    lanes) point at it, so the batched decode step's stale-lane scatter
-    writes land where no live slot ever reads.
+    The first block of each shard range is RESERVED as that shard's
+    garbage sink: it is never allocated, table padding entries (and the
+    sink-filled tables of unoccupied batch lanes) point at it, so the
+    batched decode step's stale-lane scatter writes land where no live
+    slot ever reads. With the default ``shards=1`` that is pool block 0,
+    exactly the ISSUE-7 layout.
 
-    Host side: the free list, per-slot tables and lengths — request/
+    Multi-chip layout (ISSUE 10, ``shards=D``): the pool is partitioned
+    into D contiguous shard ranges so the device buffers can shard over
+    the mesh "data" axis — shard d owns blocks ``[d*per, (d+1)*per)``,
+    slot s belongs to shard ``s // (n_slots // D)``, and a slot only
+    ever allocates (and sinks its garbage) inside its OWN shard's range,
+    so every block-table lookup, scatter and gather in the decode step
+    stays local to the chip holding that slot's lane. Free lists are
+    per-shard; admission asks :meth:`admit_shard` for the shard that can
+    host a request (free slot + enough free blocks, most-free wins).
+
+    Host side: the free lists, per-slot tables and lengths — request/
     block-granularity bookkeeping kept out of the jitted step, exactly
     like KVCache's slot accounting. Double-frees in the block free list
     raise ``AssertionError`` (a corrupted free list silently cross-wires
     two requests' caches — fail loudly instead). The pool exports
     ``kv_blocks_free`` / ``kv_blocks_used`` gauges and a
     ``kv_fragmentation`` percentage (share of used-block capacity not
-    holding a live token) through the StatRegistry.
+    holding a live token) through the StatRegistry, aggregated over
+    shards.
     """
 
     def __init__(self, cfg, n_slots: int, n_blocks: Optional[int] = None,
-                 block_size: int = 16, dtype=None):
+                 block_size: int = 16, dtype=None, shards: int = 1):
         if block_size < 1:
             raise ValueError(f"block_size={block_size} must be >= 1")
         self.cfg = cfg
         self.n_slots = int(n_slots)
         self.block_size = int(block_size)
+        self.shards = int(shards)
+        if self.shards < 1:
+            raise ValueError(f"shards={shards} must be >= 1")
+        if self.n_slots % self.shards != 0:
+            raise ValueError(f"n_slots={n_slots} not divisible by "
+                             f"shards={shards}")
         # widest table any slot can need: the positional table is the
         # per-slot length ceiling
         self.table_width = -(-cfg.seq_len // self.block_size)
         if n_blocks is None:
-            # worst case every slot runs to seq_len, +1 for the sink
-            n_blocks = 1 + self.n_slots * self.table_width
+            # worst case every slot runs to seq_len, +1 sink per shard
+            n_blocks = self.shards + self.n_slots * self.table_width
         self.n_blocks = int(n_blocks)
-        if self.n_blocks < 2:
+        if self.n_blocks % self.shards != 0:
+            raise ValueError(f"n_blocks={self.n_blocks} not divisible by "
+                             f"shards={shards}")
+        self.blocks_per_shard = self.n_blocks // self.shards
+        if self.blocks_per_shard < 2:
             raise ValueError(
-                f"n_blocks={self.n_blocks} must be >= 2 (block 0 is the "
+                f"n_blocks={self.n_blocks} must give every shard >= 2 "
+                "blocks (the first block of each shard range is its "
                 "reserved garbage sink)")
         self.dtype = cfg.dtype if dtype is None else dtype
         shape = (self.n_blocks, cfg.n_layers, cfg.n_heads, self.block_size,
@@ -160,16 +184,45 @@ class PagedKVCache:
         self.vb = jnp.zeros(shape, self.dtype)
         self.lengths = np.zeros(self.n_slots, np.int32)
         self.block_tables: List[List[int]] = [[] for _ in range(self.n_slots)]
-        self._free: List[int] = list(range(1, self.n_blocks))  # 0 = sink
-        self._free_set = set(self._free)
+        # per-shard free lists; the first block of each range is the sink
+        self._free: List[List[int]] = [
+            list(range(d * self.blocks_per_shard + 1,
+                       (d + 1) * self.blocks_per_shard))
+            for d in range(self.shards)]
+        self._free_set = set(b for free in self._free for b in free)
         self._slot_free: List[int] = list(range(self.n_slots))
         self._update_gauges()
 
+    # -- shard topology ------------------------------------------------------
+    @property
+    def slots_per_shard(self) -> int:
+        return self.n_slots // self.shards
+
+    def shard_of(self, slot: int) -> int:
+        return slot // self.slots_per_shard
+
+    def sink_of(self, shard: int) -> int:
+        return shard * self.blocks_per_shard
+
+    @property
+    def max_slot_blocks(self) -> int:
+        """Largest block count one slot can ever own (its shard's pool
+        minus the sink) — the submit-time can-never-fit bound."""
+        return self.blocks_per_shard - 1
+
     # -- slot accounting (same surface as KVCache) ---------------------------
-    def alloc(self) -> Optional[int]:
+    def alloc(self, prefer_shard: Optional[int] = None) -> Optional[int]:
         if not self._slot_free:
             return None
-        slot = self._slot_free.pop(0)
+        if prefer_shard is not None:
+            for i, s in enumerate(self._slot_free):
+                if self.shard_of(s) == prefer_shard:
+                    slot = self._slot_free.pop(i)
+                    break
+            else:
+                return None
+        else:
+            slot = self._slot_free.pop(0)
         self.lengths[slot] = 0
         self.block_tables[slot] = []
         return slot
@@ -195,31 +248,52 @@ class PagedKVCache:
         return -(-int(n_tokens) // self.block_size)
 
     def can_admit(self, n_tokens: int) -> bool:
-        """Enough free blocks to cache ``n_tokens``? (The admission gate —
-        replaces the fixed engine's ``prompt >= max_len`` hard reject.)"""
-        return self.blocks_for(n_tokens) <= len(self._free)
+        """Some shard has enough free blocks to cache ``n_tokens``? (The
+        admission gate — replaces the fixed engine's ``prompt >=
+        max_len`` hard reject; pair with :meth:`admit_shard` to also
+        require a free slot in that shard.)"""
+        need = self.blocks_for(n_tokens)
+        return any(need <= len(free) for free in self._free)
+
+    def admit_shard(self, n_tokens: int) -> Optional[int]:
+        """The shard that should host a new request needing ``n_tokens``
+        cached: a free slot AND enough free blocks, most free blocks
+        wins (keeps shard load balanced). None when no shard qualifies."""
+        need = self.blocks_for(n_tokens)
+        free_slots = {self.shard_of(s) for s in self._slot_free}
+        best = None
+        for d in range(self.shards):
+            if d in free_slots and need <= len(self._free[d]):
+                if best is None or len(self._free[d]) > len(self._free[best]):
+                    best = d
+        return best
 
     @property
     def free_blocks_count(self) -> int:
-        return len(self._free)
+        return sum(len(free) for free in self._free)
 
     @property
     def used_blocks_count(self) -> int:
-        return self.n_blocks - 1 - len(self._free)
+        return self.n_blocks - self.shards - self.free_blocks_count
+
+    def free_blocks_of(self, shard: int) -> int:
+        return len(self._free[shard])
 
     def grow(self, slot: int, n_tokens: int) -> bool:
-        """Extend ``slot``'s table to cover positions < n_tokens.
-        All-or-nothing: returns False (allocating nothing) when the free
-        list cannot supply every needed block."""
+        """Extend ``slot``'s table to cover positions < n_tokens, from
+        its OWN shard's free list. All-or-nothing: returns False
+        (allocating nothing) when that list cannot supply every needed
+        block."""
         need = self.blocks_for(n_tokens)
         table = self.block_tables[slot]
         extra = need - len(table)
         if extra <= 0:
             return True
-        if extra > len(self._free):
+        free = self._free[self.shard_of(slot)]
+        if extra > len(free):
             return False
         for _ in range(extra):
-            b = self._free.pop(0)
+            b = free.pop(0)
             self._free_set.discard(b)
             table.append(b)
         self._update_gauges()
@@ -230,24 +304,31 @@ class PagedKVCache:
             if b in self._free_set:
                 raise AssertionError(
                     f"KV block {b} double-freed (free-list corruption)")
-            if not 1 <= b < self.n_blocks:
-                raise AssertionError(f"KV block {b} outside pool "
-                                     f"[1, {self.n_blocks})")
-            self._free.append(b)
+            shard, local = divmod(int(b), self.blocks_per_shard)
+            if not 0 <= shard < self.shards or local == 0:
+                raise AssertionError(f"KV block {b} outside pool or a "
+                                     "reserved shard sink")
+            self._free[shard].append(b)
             self._free_set.add(b)
         self._update_gauges()
 
     def table_row(self, slot: int) -> np.ndarray:
-        """This slot's table as a fixed-width int32 row, sink-padded."""
-        row = np.zeros(self.table_width, np.int32)
+        """This slot's table as a fixed-width int32 row, sink-padded
+        (with the slot's OWN shard sink, so padding lookups stay
+        shard-local)."""
+        row = np.full(self.table_width,
+                      self.sink_of(self.shard_of(slot)), np.int32)
         table = self.block_tables[slot]
         row[:len(table)] = table
         return row
 
     def tables_array(self, slots=None) -> np.ndarray:
         """(n_slots, table_width) int32 for the batched decode step; rows
-        not in ``slots`` stay all-zero (= the garbage sink)."""
-        out = np.zeros((self.n_slots, self.table_width), np.int32)
+        not in ``slots`` (and all padding) point at their shard's
+        garbage sink."""
+        out = np.empty((self.n_slots, self.table_width), np.int32)
+        for s in range(self.n_slots):
+            out[s] = self.sink_of(self.shard_of(s))
         for s in (range(self.n_slots) if slots is None else slots):
             table = self.block_tables[s]
             out[s, :len(table)] = table
@@ -256,7 +337,7 @@ class PagedKVCache:
     # -- gauges --------------------------------------------------------------
     def _update_gauges(self) -> None:
         used = self.used_blocks_count
-        KV_BLOCKS_FREE.set(len(self._free))
+        KV_BLOCKS_FREE.set(self.free_blocks_count)
         KV_BLOCKS_USED.set(used)
         cap = used * self.block_size
         live = int(self.lengths.sum())
@@ -272,5 +353,6 @@ class PagedKVCache:
     def __repr__(self):
         return (f"PagedKVCache(slots={self.n_slots}, "
                 f"blocks={self.n_blocks}x{self.block_size}, "
+                f"shards={self.shards}, "
                 f"used={self.used_blocks_count}, occupied={self.occupancy}, "
                 f"{self.nbytes / 1e6:.1f}MB)")

@@ -321,6 +321,89 @@ def serving_report(rows: list, file=None, events: list | None = None) -> dict:
     return out
 
 
+def spec_report(events: list, file=None) -> dict:
+    """Speculative-decoding verdict from the decode spans (ISSUE 10).
+
+    A speculative tick tags its ``serving.decode_step`` span with
+    ``{spec_k, proposed, accepted}``. Aggregated they answer the first
+    question about a spec-enabled engine: is the draft EARNING its k
+    extra forward passes? Each tick emits ``accepted + batch`` tokens
+    for one target dispatch, so the acceptance rate directly sets the
+    speedup ceiling — a rate near 0 means the engine is doing strictly
+    more work than plain decode."""
+    ticks = [e for e in events
+             if e.get("name") == "serving.decode_step"
+             and "proposed" in (e.get("args") or {})]
+    if not ticks:
+        return {}
+    proposed = sum(int(e["args"]["proposed"]) for e in ticks)
+    accepted = sum(int(e["args"]["accepted"]) for e in ticks)
+    batch = sum(int(e["args"].get("batch", 0)) for e in ticks)
+    rate = accepted / proposed if proposed else 0.0
+    # every active stream runs one target pass per tick and emits its
+    # accepted proposals + one target token, so tokens-per-pass is the
+    # dispatch amortization the speculation buys
+    out = {"spec_ticks": len(ticks), "proposed": proposed,
+           "accepted": accepted, "acceptance_rate": rate,
+           "tokens_per_target_pass":
+               (accepted + batch) / batch if batch else 0.0}
+    out["verdict"] = (
+        f"speculation effective: {rate:.2f} of draft proposals accepted "
+        f"({out['tokens_per_target_pass']:.2f} tokens per target pass)"
+        if rate >= 0.5 else
+        f"draft poorly matched: only {rate:.2f} of proposals accepted — "
+        "use a closer draft model or lower spec_k (below ~0.3 the spec "
+        "engine does more work than plain decode)")
+    print("\nSpeculative decoding:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<24}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
+def shard_balance_report(events: list, file=None) -> dict:
+    """Shard-balance verdict for multi-chip decode (ISSUE 10).
+
+    Mesh-mode ``serving.decode_step`` spans carry ``{shards,
+    shard_load: [...]}`` — the live slots per "data" shard that tick.
+    SPMD decode runs at the pace of the busiest shard while every shard
+    pays the full program, so sustained imbalance is pure wasted
+    capacity; the verdict compares the busiest shard's share against
+    the ideal 1/shards."""
+    ticks = [e for e in events
+             if e.get("name") == "serving.decode_step"
+             and "shard_load" in (e.get("args") or {})]
+    if not ticks:
+        return {}
+    shards = int(ticks[0]["args"].get("shards", 1))
+    totals = [0] * shards
+    for e in ticks:
+        for d, n in enumerate(e["args"]["shard_load"]):
+            totals[d] += int(n)
+    grand = sum(totals)
+    out = {"shards": shards, "ticks": len(ticks),
+           "slot_ticks_per_shard": totals}
+    if grand > 0:
+        worst = max(totals) / grand
+        out["busiest_shard_frac"] = worst
+        ideal = 1.0 / shards
+        out["verdict"] = (
+            f"balanced: busiest shard carried {worst:.2f} of slot-ticks "
+            f"(ideal {ideal:.2f})" if worst <= 1.5 * ideal else
+            f"imbalanced: busiest shard carried {worst:.2f} of slot-ticks "
+            f"(ideal {ideal:.2f}) — admission is clumping requests; check "
+            "per-shard free blocks and n_slots % shards")
+    print("\nShard balance:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<24}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def resilience_report(events: list, rows: list, file=None,
                       gauges: dict | None = None) -> dict:
     """Self-healing verdict from the resilience spans (ISSUE 5).
@@ -411,6 +494,8 @@ def main(argv=None):
     input_pipeline_report(rows)
     overlap_report(rows)
     serving_report(rows, events=events)
+    spec_report(events)
+    shard_balance_report(events)
     resilience_report(events, rows)
     recompile_report(events)
     pipeline_report(events)
