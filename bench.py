@@ -589,17 +589,104 @@ def bench_serving_load(on_accel):
         finally:
             eng.shutdown(drain=False)
 
+    # shared-prefix leg (ISSUE 11): production traffic — every prompt =
+    # one shared system prompt + few-shot header (208 tokens) plus a
+    # short unique tail (16), Poisson arrivals, prefix cache ON vs OFF
+    # on the SAME paged pool. >= 80% of prompt tokens should come from
+    # the radix tree, and skipping their prefill is first-token latency
+    # off the critical path.
+    from paddle_tpu.monitor import stat_get as _sg
+
+    shared_head = sched_rng.integers(0, cfg.vocab_size, 208).astype(np.int32)
+    sp_prompts = [np.concatenate([
+        shared_head,
+        sched_rng.integers(0, cfg.vocab_size, 16).astype(np.int32)])
+        for _ in range(n_req)]
+    sp_gaps = sched_rng.exponential(1 / 16.0, n_req)
+
+    def run_shared(prefix_on):
+        eng = InferenceEngine(
+            cfg, params, n_slots=8, paged=True, block_size=block,
+            n_blocks=1 + pool_tokens // block, prefill_chunk=64,
+            queue_size=4 * n_req, prefix_cache=prefix_on)
+        try:
+            # warm the programs AND (prefix leg) seed the radix tree —
+            # steady-state behavior is what production traffic sees.
+            # The second warm request HITS the freshly-seeded tree, so
+            # the tail-prefill and CoW programs compile here, not under
+            # the measured burst (a compile on the scheduler thread
+            # would serialize every stream behind it)
+            eng.generate(sp_prompts[0], max_new_tokens=2)
+            eng.generate(sp_prompts[0], max_new_tokens=2)
+            m0, l0 = _sg("prefix_matched_tokens"), _sg("prefix_lookup_tokens")
+            first_t = [None] * n_req
+            done_t = [None] * n_req
+            sub_t = [None] * n_req
+
+            def consume(i, req):
+                it = req.stream(timeout=600)
+                next(it)
+                first_t[i] = time.perf_counter()
+                for _ in it:
+                    pass
+                done_t[i] = time.perf_counter()
+
+            threads = []
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                sub_t[i] = time.perf_counter()
+                req = eng.submit(sp_prompts[i], max_new_tokens=max_new)
+                th = threading.Thread(target=consume, args=(i, req))
+                th.start()
+                threads.append(th)
+                if sp_gaps[i] > 0:
+                    time.sleep(sp_gaps[i])
+            for th in threads:
+                th.join(timeout=600)
+            wall = time.perf_counter() - t0
+            ftl = np.asarray([f - s for f, s in zip(first_t, sub_t)]) * 1e3
+            matched = _sg("prefix_matched_tokens") - m0
+            looked = _sg("prefix_lookup_tokens") - l0
+            return {
+                "cache_hit_rate": round(matched / looked, 3) if looked
+                else 0.0,
+                "first_token_ms_p50":
+                    round(float(np.percentile(ftl, 50)), 2),
+                "first_token_ms_p99":
+                    round(float(np.percentile(ftl, 99)), 2),
+                "tokens_per_s": round(n_req * max_new / wall, 2),
+            }
+        finally:
+            eng.shutdown(drain=False)
+
+    sp_off = run_shared(False)
+    sp_on = run_shared(True)
+    out["shared_prefix"] = {
+        "cache_off": sp_off, "cache_on": sp_on,
+        "first_token_p50_speedup": round(
+            sp_off["first_token_ms_p50"]
+            / max(sp_on["first_token_ms_p50"], 1e-9), 3),
+        "tokens_per_s_speedup": round(
+            sp_on["tokens_per_s"] / max(sp_off["tokens_per_s"], 1e-9), 3)}
+
     hi = "burst"
     ab = out["paged"][hi]["tokens_per_s"] / out["fixed"][hi]["tokens_per_s"]
     result = {"levels": out, "value": round(ab, 3),
               "unit": "x tokens/s, paged/fixed @ burst",
               "ab_speedup_at_high_concurrency": round(ab, 3),
+              "shared_prefix_hit_rate": out["shared_prefix"]["cache_on"][
+                  "cache_hit_rate"],
+              "shared_prefix_first_token_p50_speedup":
+                  out["shared_prefix"]["first_token_p50_speedup"],
               "note": f"{n_req} req x {max_new} new tokens, prompts "
                       f"{plens}, same {pool_tokens}-token KV pool both "
                       "legs (fixed: 4 slots x 256; paged: 64x16 blocks, "
                       "8 slots, prefill_chunk 64); Poisson arrivals per "
                       "level; paged_mesh = same paged engine sharded "
-                      "data=4 x model=2 over the 8-device mesh"}
+                      "data=4 x model=2 over the 8-device mesh; "
+                      "shared_prefix = 208-token shared system prompt + "
+                      "16-token unique tail at 16rps Poisson, radix "
+                      "prefix cache ON vs OFF on the same pool"}
     if ab < 1.2:
         result["skip_reason"] = (
             f"paged-vs-fixed tokens/s A/B measured {ab:.3f}x (< 1.2x "
@@ -610,11 +697,14 @@ def bench_serving_load(on_accel):
 
 
 def bench_serving_spec(on_accel):
-    """ISSUE 10: speculative-decoding A/B — tokens/s spec vs non-spec at
-    three temperatures on gpt_tiny, with the measured draft acceptance
-    rate. The draft is a 1-layer truncation of the target sharing
-    embeddings and head (models.gpt_truncate — the gpt_nano-class
-    contract a separately trained draft would also satisfy).
+    """ISSUE 10/11: speculative-decoding A/B — tokens/s spec vs non-spec
+    at three temperatures on gpt_tiny, with the measured draft
+    acceptance rate. The HEADLINE draft is a *distilled* 2-layer
+    gpt_nano (tools/distill_draft — KL-matched to the teacher on CPU in
+    seconds, embeddings seeded from the target), so the acceptance
+    number measures a real draft, not shared-weights machinery; the
+    PR-10 1-layer truncation (models.gpt_truncate) stays as the
+    comparison row.
 
     The speculative tick is ONE compiled program (k draft steps + the
     k+1-position verify + acceptance), so per tick a stream costs one
@@ -627,11 +717,14 @@ def bench_serving_spec(on_accel):
     from paddle_tpu.models.gpt import gpt_truncate
     from paddle_tpu.monitor import stat_get
     from paddle_tpu.serving import InferenceEngine
+    from tools.distill_draft import distill_draft
 
     cfg = gpt_tiny(seq_len=256,
                    dtype=jnp.bfloat16 if on_accel else jnp.float32)
     params = gpt_init(cfg, seed=0)
-    draft = gpt_truncate(cfg, params, 1)
+    truncated = gpt_truncate(cfg, params, 1)
+    distilled, distill_info = distill_draft(cfg, params, n_layers=1,
+                                            steps=250, seq=32)
     rng = np.random.default_rng(0)
     n_req, max_new = 4, 48
     prompts = [rng.integers(0, cfg.vocab_size, 24).astype(np.int32)
@@ -662,19 +755,28 @@ def bench_serving_spec(on_accel):
     temps = {}
     for temp in (0.0, 0.7, 1.0):
         base = run(None, temp)
-        spec = run(draft, temp)
+        spec = run(distilled, temp)
+        trunc = run(truncated, temp)
         temps[f"t{temp}"] = {
             "nonspec_tokens_per_s": base["tokens_per_s"],
             "spec_tokens_per_s": spec["tokens_per_s"],
             "speedup": round(spec["tokens_per_s"] / base["tokens_per_s"], 3),
-            "acceptance": spec["acceptance"]}
+            "acceptance": spec["acceptance"],
+            "truncated_tokens_per_s": trunc["tokens_per_s"],
+            "truncated_acceptance": trunc["acceptance"]}
     g = temps["t0.0"]
     result = {"temps": temps, "value": g["speedup"],
               "unit": "x tokens/s, spec/nonspec @ greedy",
               "acceptance_at_greedy": g["acceptance"],
+              "distill": {k: round(v, 4) if isinstance(v, float) else v
+                          for k, v in distill_info.items()},
               "note": f"{n_req} req x {max_new} tokens, prompt 24, 4 "
-                      "slots, spec_k 6; draft = 1-layer truncation "
-                      "sharing embeddings/head; tokens/s is decode-phase "
+                      "slots, spec_k 6; draft = DISTILLED 1-layer "
+                      "gpt_nano (tools/distill_draft, KL-matched, "
+                      "embeddings seeded from the target) — acceptance "
+                      "measures a real draft; truncated_* rows keep the "
+                      "PR-10 shared-weights 1-layer truncation for "
+                      "comparison; tokens/s is decode-phase "
                       "(serving_decode_ms), greedy output pinned "
                       "token-identical by tests/test_serving_spec.py"}
     if g["speedup"] < 1.3 or (g["acceptance"] or 0.0) < 0.6:

@@ -250,6 +250,17 @@ shm_slot_bytes = [_int_or_zero(os.environ.get("FLAGS_shm_slot_bytes", "0"))]
 serving_mesh = [_int_or_zero(os.environ.get("FLAGS_serving_mesh", "0"))]
 
 
+# FLAGS_prefix_cache (ISSUE 11): radix-tree prefix sharing over the
+# paged KV block pool — admission walks a host-side radix tree of
+# cached prompt prefixes, splices matched (refcounted, copy-on-write)
+# blocks into the new slot's table and only prefills the uncached tail,
+# so a shared system prompt prefills ONCE and fans out across streams.
+# Requires FLAGS_paged_kv=1 (or InferenceEngine(paged=True)). Default
+# OFF; the cache-cold engine is pinned token-identical while unset, and
+# greedy output with the cache ON is pinned token-identical to cold.
+prefix_cache = [_truthy(os.environ.get("FLAGS_prefix_cache", "0"))]
+
+
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
         check_nan_inf[0] = _truthy(value)
@@ -284,6 +295,8 @@ def set_flag(name: str, value) -> None:
         shm_slot_bytes[0] = _int_or_zero(value)
     elif name.endswith("serving_mesh"):
         serving_mesh[0] = _int_or_zero(value)
+    elif name.endswith("prefix_cache"):
+        prefix_cache[0] = _truthy(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

@@ -39,7 +39,7 @@ __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
            "gpt_param_specs", "gpt_tiny", "gpt_small", "gpt_1p3b",
            "gpt_nano", "gpt_truncate", "bert_base_config", "gpt_prefill",
            "gpt_decode_step", "gpt_decode_step_paged", "gpt_prefill_chunk",
-           "gpt_verify_step", "gpt_verify_step_paged",
+           "gpt_prefill_prefix", "gpt_verify_step", "gpt_verify_step_paged",
            "quantize_gpt_weights"]
 
 
@@ -789,6 +789,29 @@ def gpt_verify_step_paged(cfg: GPTConfig, params, pool, tables, positions,
     (x, kb, vb), _ = jax.lax.scan(
         step, (x, kb, vb), (params["blocks"], jnp.arange(L)))
     return _head(cfg, params, x), (kb, vb)
+
+
+def gpt_prefill_prefix(cfg: GPTConfig, params, pool, table_row, tokens,
+                       start):
+    """Prefill continuing from an arbitrary cached prefix (ISSUE 11 —
+    the radix prefix cache's tail entry point).
+
+    Like :func:`gpt_prefill_chunk`, but ``start`` (tokens already cached
+    for this slot) need NOT be block-aligned: a prefix-cache match ends
+    wherever the shared prompt diverges, often mid-block (the engine has
+    already copy-on-write-duplicated that block, so the scatter below
+    writes a private copy). Routes through the batched verify math
+    (:func:`gpt_verify_step_paged` at B=1): token j of ``tokens``
+    (1, C) lands at position ``start + j`` through ``table_row``'s
+    block/offset lookup, and each query attends over the WHOLE cached
+    prefix — matched blocks included — masked to ``pos <= start + j``,
+    so logits at chunk position i equal :func:`gpt_prefill`'s at global
+    position ``start + i`` over the same tokens. Padded tail positions
+    scatter garbage through sink-padded table entries nobody reads.
+    Returns (logits (1, C, V) fp32, updated pool)."""
+    return gpt_verify_step_paged(cfg, params, pool, table_row[None, :],
+                                 jnp.reshape(start, (1,)).astype(jnp.int32),
+                                 tokens)
 
 
 def _block_chunk(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):

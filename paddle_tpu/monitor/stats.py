@@ -191,6 +191,19 @@ DEFAULT_STATS = (
     "zero_level",                   # gauge: chosen ZeRO stage (0-3)
     "pipeline_bubble_frac",         # gauge: chosen plan's bubble, ppm (1e-6)
     "planner_hbm_headroom_bytes",   # gauge: HBM budget minus chosen plan's need
+    # radix prefix cache + serving front end (ISSUE 11)
+    "prefix_matched_tokens",    # prompt tokens served from the radix tree
+    "prefix_lookup_tokens",     # prompt tokens looked up at admission
+    "prefix_hit_rate",          # gauge: % of looked-up prompt tokens matched
+    "prefix_cache_blocks",      # gauge: pool blocks pinned by the radix tree
+    "prefix_evictions",         # LRU-leaf tree nodes reclaimed to the pool
+    "prefix_cow_copies",        # copy-on-write duplications of shared blocks
+    "frontend_requests",        # HTTP generation requests accepted
+    "frontend_429s",            # requests rejected by tenant admission (429)
+    "frontend_queue_wait_ms",   # cumulative WFQ lane wait before submission
+    "frontend_active_streams",  # gauge: generation streams currently open
+    "constrained_requests",     # requests decoding under a token-mask automaton
+    "constrained_fallback_ticks",  # spec ticks dropped to the plain program
 )
 
 for _n in DEFAULT_STATS:
@@ -241,6 +254,18 @@ PLAN_CANDIDATES_CONSIDERED = _registry.get_stat("plan_candidates_considered")
 ZERO_LEVEL = _registry.get_stat("zero_level")
 PIPELINE_BUBBLE_FRAC = _registry.get_stat("pipeline_bubble_frac")
 PLANNER_HBM_HEADROOM_BYTES = _registry.get_stat("planner_hbm_headroom_bytes")
+PREFIX_MATCHED_TOKENS = _registry.get_stat("prefix_matched_tokens")
+PREFIX_LOOKUP_TOKENS = _registry.get_stat("prefix_lookup_tokens")
+PREFIX_HIT_RATE = _registry.get_stat("prefix_hit_rate")
+PREFIX_CACHE_BLOCKS = _registry.get_stat("prefix_cache_blocks")
+PREFIX_EVICTIONS = _registry.get_stat("prefix_evictions")
+PREFIX_COW_COPIES = _registry.get_stat("prefix_cow_copies")
+FRONTEND_REQUESTS = _registry.get_stat("frontend_requests")
+FRONTEND_429S = _registry.get_stat("frontend_429s")
+FRONTEND_QUEUE_WAIT_MS = _registry.get_stat("frontend_queue_wait_ms")
+FRONTEND_ACTIVE_STREAMS = _registry.get_stat("frontend_active_streams")
+CONSTRAINED_REQUESTS = _registry.get_stat("constrained_requests")
+CONSTRAINED_FALLBACK_TICKS = _registry.get_stat("constrained_fallback_ticks")
 
 
 # per-mesh-axis device-memory gauges published by the last

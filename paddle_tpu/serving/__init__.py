@@ -1,68 +1,94 @@
-"""paddle_tpu.serving — continuous-batching inference engine (ISSUE 4/7/10).
+"""paddle_tpu.serving — continuous-batching inference engine and its
+production traffic layer (ISSUE 4/7/10/11).
 
 The generation-side counterpart of ``paddle_tpu.inference``: where the
 Predictor serves one compiled program per call (the reference's
 AnalysisPredictor shape), this package serves AUTOREGRESSIVE workloads —
 many concurrent requests sharing one jitted KV-cache decode step,
-Orca-style continuous batching instead of request-at-a-time.
+Orca-style continuous batching instead of request-at-a-time — and, as
+of ISSUE 11, speaks HTTP to real multi-tenant traffic.
 
-Layers:
+Layers, bottom up:
 
 - :mod:`kv_cache` — two cache shapes. :class:`KVCache`: fixed-slot
   donated device buffers ``(slots, layers, heads, max_len, head_dim)``.
   :class:`PagedKVCache` (``FLAGS_paged_kv=1``): a shared block pool
   ``(n_blocks, layers, heads, block_size, head_dim)`` + per-slot block
-  tables and host-side free lists — slot memory proportional to LIVE
-  tokens, admission gated on free blocks instead of a fixed ``max_len``,
-  with ``kv_blocks_free`` / ``kv_blocks_used`` / ``kv_fragmentation``
-  gauges and loud ``AssertionError`` on free-list double-frees. With
-  ``shards=D`` (multi-chip) the pool partitions into per-shard block
-  ranges with per-shard free lists and garbage sinks, so every lookup
-  and scatter stays local to the chip holding that slot's lane;
-- :func:`paddle_tpu.models.gpt_prefill` /
-  :func:`paddle_tpu.models.gpt_decode_step` — the cache-aware forward
-  variants (they live with the model); paged mode adds
-  :func:`~paddle_tpu.models.gpt_prefill_chunk` and
-  :func:`~paddle_tpu.models.gpt_decode_step_paged` (Pallas
-  paged-attention kernel on TPU); speculative decoding adds the
-  multi-token verify passes :func:`~paddle_tpu.models.gpt_verify_step`
-  / ``gpt_verify_step_paged`` — k+1 positions scored in one program;
+  tables, host-side free lists and PER-BLOCK REFCOUNTS — slot memory
+  proportional to LIVE tokens, admission gated on free blocks,
+  ``free_slot`` decrements instead of freeing so blocks can be SHARED
+  across slots (``splice``/``ref_block``/``replace_block`` are the
+  prefix cache's contract), with ``kv_blocks_free`` / ``kv_blocks_used``
+  / ``kv_fragmentation`` gauges and loud ``AssertionError`` on
+  refcount/free-list corruption. ``shards=D`` (multi-chip) partitions
+  the pool into per-shard block ranges;
+- :mod:`prefix_cache` — :class:`~prefix_cache.RadixPrefixCache`
+  (``FLAGS_prefix_cache=1``): a host-side radix tree keyed by token-id
+  block chunks over that pool. Admission walks it, bumps refcounts on
+  matched blocks and splices them into the new slot's table, so a
+  shared system prompt prefills ONCE and fans out; only the uncached
+  tail runs (``models.gpt_prefill_prefix`` continues from an unaligned
+  cached length), a partially-used last block is copy-on-write
+  duplicated first, and eviction is LRU-by-leaf over refcount-0 nodes —
+  composing with, not replacing, pool-exhaustion preemption. Greedy
+  output is pinned token-identical to the cache-cold engine;
+- :func:`paddle_tpu.models.gpt_prefill` / ``gpt_decode_step`` /
+  ``gpt_prefill_chunk`` / ``gpt_prefill_prefix`` /
+  ``gpt_decode_step_paged`` / ``gpt_verify_step`` (+``_paged``) — the
+  cache-aware forward variants (they live with the model);
 - :mod:`sampling` — fused greedy/temperature/top-k/top-p with per-slot
-  parameters, per-REQUEST RNG streams (``stream_keys`` folds request id
-  + draw index, so a stream's sampled tokens never depend on batch
-  neighbors) and the speculative accept/resample rule
-  (:func:`~paddle_tpu.serving.sampling.spec_accept`);
+  parameters, per-REQUEST RNG streams, the speculative accept/resample
+  rule, and per-row token MASKS (``mask=``) so constrained rows ride
+  the same compiled program;
+- :mod:`constrained` — structured decoding: JSON-schema / regex →
+  byte-level DFA → per-state vocabulary masks
+  (:func:`~constrained.compile_constraint`,
+  :class:`~constrained.TokenConstraint`); pass the result to
+  ``submit(constraint=...)`` and the stream ends with
+  ``finish_reason="stop"`` when the match completes;
 - :mod:`tokenizer` — the byte-level text front end:
   :class:`ByteTokenizer` (byte floor + optional merge vocab file) and
-  :class:`StreamDetokenizer` for utf-8-safe live text streaming; give
-  the engine one and ``submit(text=...)`` / ``stream_text()`` work;
+  :class:`StreamDetokenizer` for utf-8-safe live text streaming;
 - :mod:`engine` — the scheduler: bounded queue with backpressure,
   prefill-and-insert admission (paged: CHUNKED prefill interleaved with
-  decode; pool-exhaustion preemption requeues the youngest slot), one
-  batched decode step per tick, eviction without draining,
-  deadlines/cancellation, graceful shutdown, and the serving_* gauges +
-  trace spans. ``draft=(cfg, params)`` switches the tick to
-  speculative decoding (draft proposes ``spec_k``, target verifies k+1
-  in one pass, greedy token-identical to ``draft=None``);
-  ``mesh=``/``FLAGS_serving_mesh=D`` shards slots over "data" and
-  weights over "model" so the tick runs over a whole TPU slice.
+  decode; prefix-cache splicing; LRU tree reclaim, then youngest-first
+  preemption), one batched decode step per tick, speculative decoding
+  (``draft=``), multi-chip decode (``mesh=``/``FLAGS_serving_mesh``),
+  eviction without draining, deadlines/cancellation, graceful shutdown,
+  and the serving_*/prefix_*/constrained_* gauges + trace spans;
+- :mod:`frontend` — the network surface (``python -m
+  paddle_tpu.serving.frontend``): a stdlib-asyncio HTTP server with
+  OpenAI-style ``/v1/completions`` and ``/v1/chat/completions`` (SSE
+  streaming), ``/v1/models``, and a ``/metrics`` StatRegistry dump;
+  per-tenant API-key auth with token-bucket admission (429 +
+  Retry-After on exhaustion, ``max_streams`` caps) and SLO lanes
+  drained by weighted fair queuing over prefill chunks.
+  ``response_format`` compiles to a :mod:`constrained` automaton.
+  ``tools/trace_report.py frontend_report`` turns its spans into a
+  per-tenant queue-wait/throttle/prefix-hit verdict.
 
 Escape hatches: ``paddle.set_flags({"FLAGS_serving_jit": 0})`` swaps the
-jitted cache path for an un-jitted full-recompute reference decode
-(speculation pauses — the reference path decodes one token at a time);
+jitted cache path for an un-jitted full-recompute reference decode;
 ``FLAGS_paged_kv=0`` (default) keeps the fixed-slot cache;
-``FLAGS_serving_mesh=0`` + ``draft=None`` (defaults) pin the single-chip
-non-speculative engine.
+``FLAGS_prefix_cache=0`` (default) keeps every prefill cache-cold;
+``FLAGS_serving_mesh=0`` + ``draft=None`` (defaults) pin the
+single-chip non-speculative engine.
 """
+from .constrained import (ConstraintCursor, TokenConstraint,
+                          compile_constraint, compile_regex,
+                          schema_to_regex)
 from .engine import GenerationRequest, InferenceEngine, QueueFull
 from .kv_cache import KVCache, PagedKVCache, cache_insert
+from .prefix_cache import RadixPrefixCache
 from .sampling import sample_tokens, sample_tokens_streams, spec_accept, \
     stream_keys
 from .tokenizer import ByteTokenizer, StreamDetokenizer
 
 __all__ = [
     "InferenceEngine", "GenerationRequest", "QueueFull",
-    "KVCache", "PagedKVCache", "cache_insert",
+    "KVCache", "PagedKVCache", "cache_insert", "RadixPrefixCache",
     "sample_tokens", "sample_tokens_streams", "stream_keys", "spec_accept",
     "ByteTokenizer", "StreamDetokenizer",
+    "TokenConstraint", "ConstraintCursor", "compile_constraint",
+    "compile_regex", "schema_to_regex",
 ]

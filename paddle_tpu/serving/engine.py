@@ -76,6 +76,35 @@ admission places each request in the shard with the most free blocks.
 ``FLAGS_serving_mesh=0`` (default) with no explicit mesh keeps the
 single-chip engine unchanged.
 
+Prefix sharing (ISSUE 11, ``FLAGS_prefix_cache=1`` or
+``InferenceEngine(prefix_cache=True)``, paged mode only): admission
+walks a host-side radix tree of cached prompt prefixes
+(serving.prefix_cache.RadixPrefixCache). A hit splices the matched
+(refcounted) pool blocks straight into the new slot's block table and
+only the uncached TAIL is prefilled — chunked, through
+``gpt_prefill_prefix``, which continues from an arbitrary (not
+necessarily block-aligned) cached length; a partially-used last block
+is copy-on-write duplicated first (one compiled ``_cow_jit`` pool-row
+copy), since tree blocks are read-only to everyone but their original
+writer. Releasing a slot unrefs its blocks instead of freeing them, a
+fully-prefilled prompt is inserted back into the tree, and when the
+pool runs dry the scheduler reclaims LRU tree leaves BEFORE falling
+back to youngest-first preemption. Greedy output is pinned
+token-identical to the cache-cold engine. Not combinable with
+``draft=`` (the draft's fixed cache has no K/V for a skipped prefix —
+sharing would force a full draft prefill and erase the win).
+
+Constrained decoding (ISSUE 11, ``submit(constraint=...)`` with a
+serving.constrained.TokenConstraint): each constrained request carries
+a byte-DFA cursor; its per-state token mask rides into the SAME jitted
+sampling program as a (slots, vocab) bool input, composing with
+temperature/top-k/top-p, and the cursor advances host-side per emitted
+token. A completed match stops the stream (finish_reason ``"stop"``).
+Ticks whose batch holds a constrained row drop from the speculative to
+the plain one-token program (counted by ``constrained_fallback_ticks``)
+— a draft proposing through an automaton would otherwise get
+unconstrained tokens accepted.
+
 Observability: gauges serving_queue_depth / serving_slot_occupancy /
 serving_prefill_ms / serving_decode_ms / serving_tokens_per_s (sliding
 window over the last N ticks) / serving_evictions /
@@ -103,9 +132,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core import native
 from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
                           gpt_forward, gpt_param_specs, gpt_prefill,
-                          gpt_prefill_chunk, gpt_verify_step,
-                          gpt_verify_step_paged)
-from ..monitor.stats import (SERVING_DECODE_MS, SERVING_EVICTIONS,
+                          gpt_prefill_chunk, gpt_prefill_prefix,
+                          gpt_verify_step, gpt_verify_step_paged)
+from ..monitor.stats import (CONSTRAINED_FALLBACK_TICKS,
+                             CONSTRAINED_REQUESTS, PREFIX_COW_COPIES,
+                             SERVING_DECODE_MS, SERVING_EVICTIONS,
                              SERVING_PREEMPTIONS, SERVING_PREFILL_MS,
                              SERVING_QUEUE_DEPTH, SERVING_SHARDS,
                              SERVING_SLOT_OCCUPANCY, SERVING_TOKENS_PER_S,
@@ -113,6 +144,7 @@ from ..monitor.stats import (SERVING_DECODE_MS, SERVING_EVICTIONS,
                              SPEC_PROPOSED)
 from ..monitor.trace import span
 from .kv_cache import KVCache, PagedKVCache, cache_insert
+from .prefix_cache import RadixPrefixCache
 from .sampling import (DRAFT_SALT, sample_tokens, sample_tokens_streams,
                        spec_accept, stream_keys)
 
@@ -132,6 +164,8 @@ DEADLINE = "deadline"
 CANCELLED = "cancelled"
 SHUTDOWN = "shutdown"
 ERROR = "error"
+STOP = "stop"        # constrained decoding: the token-mask automaton
+#                      reached a complete match — nothing more to emit
 
 
 class GenerationRequest:
@@ -147,7 +181,7 @@ class GenerationRequest:
 
     def __init__(self, prompt, max_new_tokens: int, temperature: float,
                  top_k: int, top_p: float, eos_id: Optional[int],
-                 deadline: Optional[float]):
+                 deadline: Optional[float], constraint=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -155,6 +189,7 @@ class GenerationRequest:
         self.top_p = float(top_p)
         self.eos_id = eos_id
         self.deadline = deadline          # absolute time.monotonic() or None
+        self.constraint = constraint      # ConstraintCursor (scheduler-owned)
         self.rid = 0                      # engine-assigned request id: the
         #                                   RNG stream identity (sampling.py)
         self.tokens: List[int] = []       # generated ids (includes eos)
@@ -250,7 +285,7 @@ class _Slot:
     """Host-side state of one occupied cache slot."""
 
     __slots__ = ("req", "length", "last_token", "generated", "pending",
-                 "resume_last", "admit_order")
+                 "resume_last", "admit_order", "tail_mode")
 
     def __init__(self, req: GenerationRequest, length: int, last_token: int):
         self.req = req
@@ -260,6 +295,8 @@ class _Slot:
         self.pending = None           # paged: prompt tokens not yet prefilled
         self.resume_last = None       # paged: last token of a preempted run
         self.admit_order = 0          # paged: preemption picks the youngest
+        self.tail_mode = False        # prefix hit: chunks continue from an
+        #                               unaligned cached length (_tail_jit)
 
 
 class InferenceEngine:
@@ -312,6 +349,13 @@ class InferenceEngine:
     ``tokenizer`` (serving.tokenizer.ByteTokenizer or anything with the
     same encode/decode/stream_detokenizer surface) enables the text
     front end: ``submit(text=...)`` and request ``stream_text()``.
+
+    ``prefix_cache`` (None = follow FLAGS_prefix_cache; needs paged
+    mode, not combinable with ``draft``) turns on radix-tree prefix
+    sharing: prompts that repeat a cached prefix splice its refcounted
+    blocks instead of re-prefilling, with copy-on-write on a
+    partially-used last block and LRU-by-leaf reclaim ahead of
+    preemption. Greedy output stays token-identical to the cold cache.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
@@ -320,7 +364,8 @@ class InferenceEngine:
                  int8_weights: bool = False, paged: Optional[bool] = None,
                  block_size: int = 16, n_blocks: Optional[int] = None,
                  prefill_chunk: int = 64, tps_window_ticks: int = 64,
-                 draft=None, spec_k: int = 4, mesh=None, tokenizer=None):
+                 draft=None, spec_k: int = 4, mesh=None, tokenizer=None,
+                 prefix_cache: Optional[bool] = None):
         if getattr(cfg, "fused_mlp", None) is None:
             # pin the fused-MLP choice NOW (graftlint GL002): prefill
             # programs compile lazily per prompt-length bucket, so a
@@ -383,8 +428,30 @@ class InferenceEngine:
                 self.cache.k = self._put_cache(self.cache.k)
                 self.cache.v = self._put_cache(self.cache.v)
         self.n_slots = self.cache.n_slots
+        use_prefix = native.prefix_cache[0] if prefix_cache is None \
+            else bool(prefix_cache)
+        if use_prefix and not self.paged:
+            raise ValueError("prefix_cache requires the paged KV cache "
+                             "(FLAGS_paged_kv=1 or paged=True) — sharing "
+                             "needs block-table indirection")
+        if use_prefix and draft is not None:
+            raise ValueError("prefix_cache and draft= are not combinable: "
+                             "the draft's fixed cache holds no K/V for a "
+                             "skipped prefix, so every hit would force a "
+                             "full draft prefill")
+        if use_prefix:
+            self._prefix = RadixPrefixCache(self.cache)
+            self._tail_jit = jax.jit(self._tail_fn, donate_argnums=(1, 2))
+            self._cow_jit = jax.jit(self._cow_fn, donate_argnums=(0, 1))
+        else:
+            self._prefix = None
         self._init_draft(draft, spec_k)
         self.tokenizer = tokenizer
+        # all-true token mask reused by every unconstrained tick: host
+        # template for constrained batches, device-resident copy so the
+        # common path ships no (slots, vocab) buffer per tick
+        self._ones_mask = np.ones((self.n_slots, cfg.vocab_size), bool)
+        self._mask_dev = jax.device_put(self._ones_mask)
         self.eos_id = eos_id
         self._queue: collections.deque = collections.deque()
         self._queue_size = int(queue_size)
@@ -505,20 +572,21 @@ class InferenceEngine:
 
     # -- compiled programs ---------------------------------------------------
     def _sample_args(self, logits, base_key, rids, steps, temps, top_ks,
-                    top_ps):
+                    top_ps, mask):
         keys = stream_keys(base_key, rids, steps)
-        return sample_tokens_streams(logits, keys, temps, top_ks, top_ps)
+        return sample_tokens_streams(logits, keys, temps, top_ks, top_ps,
+                                     mask=mask)
 
     def _decode_fn(self, params, k, v, positions, tokens, base_key, rids,
-                   steps, temps, top_ks, top_ps):
+                   steps, temps, top_ks, top_ps, mask):
         logits, (k, v) = gpt_decode_step(self.cfg, params, (k, v),
                                          positions, tokens)
         toks = self._sample_args(logits, base_key, rids, steps, temps,
-                                 top_ks, top_ps)
+                                 top_ks, top_ps, mask)
         return toks, k, v
 
     def _prefill_fn(self, params, k, v, tokens, slot, true_len, key, temp,
-                    top_k, top_p):
+                    top_k, top_p, mask):
         # tokens (1, S_pad) end-padded; causality keeps positions < true_len
         # exact, and the logits/cache rows past true_len are never read
         logits, (ke, ve) = gpt_prefill(self.cfg, params, tokens)
@@ -526,11 +594,11 @@ class InferenceEngine:
         last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, 0,
                                             keepdims=False)
         tok = sample_tokens(last[None], key, temp[None], top_k[None],
-                            top_p[None])[0]
+                            top_p[None], mask=mask)[0]
         return tok, k, v
 
     def _prefill_spec_fn(self, params, dparams, k, v, dk, dv, tokens, slot,
-                         true_len, key, temp, top_k, top_p):
+                         true_len, key, temp, top_k, top_p, mask):
         # target prefill + draft prefill in ONE program: both caches seed
         # the same slot so the first speculative tick can draft at once
         logits, (ke, ve) = gpt_prefill(self.cfg, params, tokens)
@@ -540,16 +608,34 @@ class InferenceEngine:
         last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, 0,
                                             keepdims=False)
         tok = sample_tokens(last[None], key, temp[None], top_k[None],
-                            top_p[None])[0]
+                            top_p[None], mask=mask)[0]
         return tok, k, v, dk, dv
 
     def _decode_paged_fn(self, params, kb, vb, tables, positions, tokens,
-                         base_key, rids, steps, temps, top_ks, top_ps):
+                         base_key, rids, steps, temps, top_ks, top_ps,
+                         mask):
         logits, (kb, vb) = gpt_decode_step_paged(
             self.cfg, params, (kb, vb), tables, positions, tokens)
         toks = self._sample_args(logits, base_key, rids, steps, temps,
-                                 top_ks, top_ps)
+                                 top_ks, top_ps, mask)
         return toks, kb, vb
+
+    def _tail_fn(self, params, kb, vb, table_row, tokens, start):
+        # prefix-cache tail chunk: continue a prefill from an UNALIGNED
+        # cached length (the radix match ends wherever the shared prompt
+        # diverges); only the final chunk's last live row is read
+        logits, (kb, vb) = gpt_prefill_prefix(
+            self.cfg, params, (kb, vb), table_row, tokens, start)
+        return logits, kb, vb
+
+    def _cow_fn(self, kb, vb, src, dst):
+        # copy-on-write: duplicate ONE pool block's rows (every layer)
+        # into a freshly-allocated block before the slot extends it
+        kr = jax.lax.dynamic_slice_in_dim(kb, src, 1, axis=0)
+        vr = jax.lax.dynamic_slice_in_dim(vb, src, 1, axis=0)
+        kb = jax.lax.dynamic_update_slice_in_dim(kb, kr, dst, axis=0)
+        vb = jax.lax.dynamic_update_slice_in_dim(vb, vr, dst, axis=0)
+        return kb, vb
 
     def _chunk_fn(self, params, kb, vb, table_row, tokens, start):
         # one prefill chunk: writes the chunk's K/V into the pool, returns
@@ -625,7 +711,8 @@ class InferenceEngine:
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_id: Optional[int] = None, deadline_s: Optional[float] = None,
                block: bool = True, timeout: Optional[float] = None,
-               text: Optional[str] = None) -> GenerationRequest:
+               text: Optional[str] = None,
+               constraint=None) -> GenerationRequest:
         """Queue a generation request; returns its streaming handle.
 
         Exactly one of ``prompt`` (token ids) and ``text`` must be given;
@@ -637,6 +724,11 @@ class InferenceEngine:
         immediately. ``deadline_s`` is a wall-clock budget from now — a
         request over budget is evicted with ``finish_reason="deadline"``
         wherever it is (queued or mid-decode).
+
+        ``constraint`` (serving.constrained.TokenConstraint) masks every
+        sampled token through the compiled automaton — structured
+        decoding; the stream finishes with ``finish_reason="stop"`` when
+        the match completes.
         """
         if text is not None:
             if prompt is not None:
@@ -666,10 +758,21 @@ class InferenceEngine:
                 f"prompt length {prompt.size} can never fit one shard of "
                 f"the block pool ({self.cache.max_slot_blocks} blocks x "
                 f"{self.block_size} tokens)")
+        cursor = None
+        if constraint is not None:
+            if getattr(constraint, "vocab_size", self.cfg.vocab_size) \
+                    > self.cfg.vocab_size:
+                raise ValueError(
+                    f"constraint vocab {constraint.vocab_size} exceeds the "
+                    f"model vocab {self.cfg.vocab_size}")
+            cursor = constraint.cursor() if hasattr(constraint, "cursor") \
+                else constraint
+            CONSTRAINED_REQUESTS.add(1)
         req = GenerationRequest(
             prompt, max_new_tokens, temperature, top_k, top_p,
             self.eos_id if eos_id is None else eos_id,
-            None if deadline_s is None else time.monotonic() + deadline_s)
+            None if deadline_s is None else time.monotonic() + deadline_s,
+            constraint=cursor)
         req._tokenizer = self.tokenizer
         with self._cv:
             self._check_open()
@@ -790,6 +893,7 @@ class InferenceEngine:
         paged = self.paged and native.serving_jit[0]
         while self.cache.free_count > 0:
             shard = None
+            place = None
             with self._cv:
                 if not self._queue:
                     break
@@ -797,9 +901,10 @@ class InferenceEngine:
                     head = self._queue[0]
                     seq = head._resume[0] if head._resume is not None \
                         else head.prompt
-                    shard = self.cache.admit_shard(seq.size + 1)
-                    if shard is None:
+                    place = self._admit_place(seq)
+                    if place is None:
                         break   # head-of-line waits for blocks to free up
+                    shard = place[0]
                 req = self._queue.popleft()
                 SERVING_QUEUE_DEPTH.set(len(self._queue))
                 self._cv.notify_all()   # wake submitters blocked on full
@@ -817,10 +922,19 @@ class InferenceEngine:
                 self._admit_seq += 1
                 st.admit_order = self._admit_seq
                 if req._resume is not None:
-                    st.pending, st.resume_last = req._resume
+                    seq, st.resume_last = req._resume
                     req._resume = None
                 else:
-                    st.pending = req.prompt
+                    seq = req.prompt
+                _, m_len, m_blocks = place
+                if self._prefix is not None:
+                    m_len = self._splice_prefix(slot, m_len, m_blocks)
+                    self._prefix.note_lookup(m_len, seq.size)
+                if m_len > 0:
+                    st.length = m_len
+                    st.tail_mode = True
+                    self.cache.lengths[slot] = m_len
+                st.pending = seq[m_len:]
                 self._slots[slot] = st
                 continue
             try:
@@ -834,6 +948,88 @@ class InferenceEngine:
                 req._finish(ERROR, e)
                 raise
         SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
+
+    def _admit_place(self, seq):
+        """Where the head request should land: ``(shard, matched_len,
+        matched_blocks)``, or None to queue-until-available.
+
+        Without the prefix cache this is PR-10's most-free-blocks shard
+        pick. With it, each eligible shard is scored by the radix match
+        its tree offers — a shard only needs free blocks for the
+        UNCACHED tail (+1 when the last matched block is partially used
+        and must be CoW-duplicated), and LRU tree leaves count toward
+        capacity because the scheduler reclaims them before giving up."""
+        need_total = int(seq.size) + 1
+        if self._prefix is None:
+            shard = self.cache.admit_shard(need_total)
+            return None if shard is None else (shard, 0, [])
+        best = None          # (headroom, shard)
+        for d in self.cache.free_slot_shards:
+            m_len, m_blocks = self._prefix.match(d, seq)
+            need = self.cache.blocks_for(need_total) - len(m_blocks) \
+                + (1 if m_len % self.block_size else 0)
+            avail = self.cache.free_blocks_of(d) \
+                + self._prefix.evictable_count(d)
+            if need <= avail and (best is None or avail - need > best[0]):
+                best = (avail - need, d)
+        if best is None:
+            return None
+        d = best[1]
+        # reclaim LRU leaves to cover the shortfall, then RE-match: the
+        # eviction could have clipped the matched path itself (only when
+        # the tree is down to this very prefix)
+        m_len, m_blocks = self._prefix.match(d, seq)
+        need = self.cache.blocks_for(need_total) - len(m_blocks) \
+            + (1 if m_len % self.block_size else 0)
+        short = need - self.cache.free_blocks_of(d)
+        if short > 0:
+            self._prefix.evict(d, short)
+            m_len, m_blocks = self._prefix.match(d, seq)
+            need = self.cache.blocks_for(need_total) - len(m_blocks) \
+                + (1 if m_len % self.block_size else 0)
+            if need > self.cache.free_blocks_of(d):
+                return None
+        return d, m_len, m_blocks
+
+    def _splice_prefix(self, slot: int, m_len: int, m_blocks) -> int:
+        """Wire a radix match into a fresh slot's table: take one
+        reference per matched block, and copy-on-write the last block
+        when the match ends mid-block (the slot will write offsets the
+        tree's readers must never see change). Returns the matched
+        length actually kept (trimmed to the block boundary if the CoW
+        allocation loses a race with pool pressure)."""
+        if m_len == 0:
+            return 0
+        self.cache.splice(slot, m_blocks)
+        if m_len % self.block_size == 0:
+            return m_len
+        nb = self.cache.alloc_block(self.cache.shard_of(slot))
+        if nb is None:
+            # no block for the copy: drop the partial block from the
+            # match instead (its full-block prefix is still shared)
+            self.cache.block_tables[slot].pop()
+            self.cache.unref_block(m_blocks[-1])
+            return (m_len // self.block_size) * self.block_size
+        src = int(m_blocks[-1])
+        self.cache.kb, self.cache.vb = self._cow_jit(
+            self.cache.kb, self.cache.vb, np.int32(src), np.int32(nb))
+        self.cache.replace_block(slot, len(m_blocks) - 1, nb)
+        PREFIX_COW_COPIES.add(1)
+        return m_len
+
+    def _reclaim_blocks(self, slot: int, n_tokens: int) -> bool:
+        """Try to make ``grow(slot, n_tokens)`` succeed by evicting LRU
+        prefix-tree leaves from the slot's shard — the reclaim step that
+        runs BEFORE youngest-first preemption ever fires."""
+        if self._prefix is None:
+            return False
+        shard = self.cache.shard_of(slot)
+        missing = self.cache.blocks_for(n_tokens) \
+            - len(self.cache.block_tables[slot]) \
+            - self.cache.free_blocks_of(shard)
+        if missing <= 0:
+            return True
+        return self._prefix.evict(shard, missing) >= missing
 
     def _bucket(self, n: int) -> int:
         b = 16
@@ -854,6 +1050,19 @@ class InferenceEngine:
         return jax.random.fold_in(
             jax.random.fold_in(self._base_key, rid % (2**31 - 1)), draw)
 
+    def _mask_row(self, req: GenerationRequest) -> np.ndarray:
+        """(1, V) bool sampling mask for one request's next token —
+        all-true when unconstrained, the automaton's live-token set
+        (padded to the model vocab) otherwise."""
+        if req.constraint is None:
+            return self._ones_mask[:1]
+        m = req.constraint.mask()
+        if m.shape[0] == self.cfg.vocab_size:
+            return m[None]
+        out = np.zeros((1, self.cfg.vocab_size), bool)
+        out[0, :m.shape[0]] = m
+        return out
+
     def _prefill(self, req: GenerationRequest, slot: int) -> None:
         S = int(req.prompt.size)
         t0 = time.perf_counter()
@@ -872,13 +1081,15 @@ class InferenceEngine:
                         self.draft_cache.v, jnp.asarray(toks),
                         np.int32(slot), np.int32(S), key,
                         np.float32(req.temperature), np.int32(req.top_k),
-                        np.float32(req.top_p))
+                        np.float32(req.top_p),
+                        jnp.asarray(self._mask_row(req)))
                 else:
                     tok, self.cache.k, self.cache.v = self._prefill_jit(
                         self._params, self.cache.k, self.cache.v,
                         jnp.asarray(toks), np.int32(slot), np.int32(S),
                         key, np.float32(req.temperature),
-                        np.int32(req.top_k), np.float32(req.top_p))
+                        np.int32(req.top_k), np.float32(req.top_p),
+                        jnp.asarray(self._mask_row(req)))
             else:
                 logits = gpt_forward(self.cfg, self._params,
                                      jnp.asarray(req.prompt[None]))
@@ -886,7 +1097,8 @@ class InferenceEngine:
                     logits[:, -1], self._stream_key(req.rid, 0),
                     jnp.float32(req.temperature)[None],
                     jnp.int32(req.top_k)[None],
-                    jnp.float32(req.top_p)[None])[0]
+                    jnp.float32(req.top_p)[None],
+                    mask=jnp.asarray(self._mask_row(req)))[0]
             tok = int(tok)
         self._note_ms(SERVING_PREFILL_MS, "_prefill_ms",
                       (time.perf_counter() - t0) * 1e3)
@@ -925,10 +1137,19 @@ class InferenceEngine:
         c_true = min(int(pending.size), self.prefill_chunk)
         bs = self.block_size
         c_pad = -(-c_true // bs) * bs    # one compile per padded length
+        if st.tail_mode:
+            # prefix-matched slots continue from an UNALIGNED length;
+            # clamp the pad so scatter positions never run past the
+            # table (near the seq_len cap the pad is trimmed odd — a
+            # rare extra compile, not a corruption)
+            c_pad = min(c_pad, self.cache.table_width * bs - st.length)
         while not self.cache.grow(slot, st.length + c_pad):
-            # pool exhausted: preempt strictly-younger work, else wait for
-            # an eviction (the oldest slot is never preempted, so the
-            # engine always makes progress — no preemption livelock)
+            # pool exhausted: reclaim LRU prefix-tree leaves first, then
+            # preempt strictly-younger work, else wait for an eviction
+            # (the oldest slot is never preempted, so the engine always
+            # makes progress — no preemption livelock)
+            if self._reclaim_blocks(slot, st.length + c_pad):
+                continue
             victim = self._youngest_slot(exclude=slot)
             if victim is None \
                     or self._slots[victim].admit_order <= st.admit_order:
@@ -944,7 +1165,12 @@ class InferenceEngine:
             toks[0, :c_true] = pending[:c_true]
             row = self.cache.table_row(slot)[:self._width_bucket(
                 self.cache.blocks_for(st.length + c_pad))]
-            if self.draft is not None:
+            if st.tail_mode:
+                logits, self.cache.kb, self.cache.vb = self._tail_jit(
+                    self._params, self.cache.kb, self.cache.vb,
+                    jnp.asarray(row), jnp.asarray(toks),
+                    np.int32(st.length))
+            elif self.draft is not None:
                 (logits, self.cache.kb, self.cache.vb, self.draft_cache.k,
                  self.draft_cache.v) = self._chunk_spec_jit(
                     self._params, self._draft_params, self.cache.kb,
@@ -964,6 +1190,11 @@ class InferenceEngine:
         self.cache.update_gauges()
         if not last:
             return
+        if self._prefix is not None and st.length >= st.req.prompt.size:
+            # the whole prompt is cached now — register it so the NEXT
+            # identical prefix splices these blocks instead of computing
+            self._prefix.insert(self.cache.shard_of(slot), st.req.prompt,
+                                self.cache.block_tables[slot])
         if st.resume_last is not None:
             # resumed after preemption: the "next" token was already
             # streamed before the preemption — just rebuild decode state
@@ -974,7 +1205,8 @@ class InferenceEngine:
             logits[0:1, c_true - 1], self._stream_key(st.req.rid, 0),
             jnp.float32(st.req.temperature)[None],
             jnp.int32(st.req.top_k)[None],
-            jnp.float32(st.req.top_p)[None])[0])
+            jnp.float32(st.req.top_p)[None],
+            mask=jnp.asarray(self._mask_row(st.req)))[0])
         st.last_token = tok
         st.generated = 1
         st.req._push(tok)
@@ -1025,6 +1257,8 @@ class InferenceEngine:
             if st is None:       # preempted as a victim earlier this tick
                 continue
             while not self.cache.grow(s, st.length + 1):
+                if self._reclaim_blocks(s, st.length + 1):
+                    continue
                 victim = self._youngest_slot(exclude=s)
                 if victim is None:
                     # alone and the pool is spent: nothing will ever free
@@ -1074,10 +1308,17 @@ class InferenceEngine:
         # speculation needs k+1 positions of cache headroom on every
         # active slot; a near-cap slot drops the whole tick to the plain
         # one-token program (correct, just unaccelerated) rather than
-        # splitting the batch across two programs
+        # splitting the batch across two programs. Constrained rows
+        # force the same fallback: draft proposals are not mask-aware,
+        # so speculating through an automaton would emit illegal tokens.
+        constrained = [s for s in active
+                       if self._slots[s].req.constraint is not None]
         use_spec = (self.draft is not None and native.serving_jit[0]
                     and all(self._slots[s].length + self.spec_k + 1
                             <= self.max_len for s in active))
+        if use_spec and constrained:
+            use_spec = False
+            CONSTRAINED_FALLBACK_TICKS.add(1)
         if self.paged and native.serving_jit[0]:
             if use_spec:
                 use_spec = self._try_spec_grow(active)
@@ -1102,6 +1343,16 @@ class InferenceEngine:
             top_ps[s] = st.req.top_p
             rids[s] = st.req.rid % (2**31 - 1)
             steps[s] = len(st.req.tokens)
+        # per-slot sampling mask: the device-resident all-true buffer on
+        # unconstrained ticks (no per-tick transfer), a fresh host array
+        # carrying each constrained row's automaton mask otherwise
+        if constrained:
+            masks = self._ones_mask.copy()
+            for s in constrained:
+                masks[s] = self._mask_row(self._slots[s].req)[0]
+            mask_arg = jnp.asarray(masks)
+        else:
+            mask_arg = self._mask_dev
 
         span_args = {"batch": len(active), "tick": self._ticks}
         if self._shards > 1:
@@ -1132,12 +1383,12 @@ class InferenceEngine:
                             self._decode_params, self.cache.kb,
                             self.cache.vb, tables, positions, tokens,
                             self._base_key, rids, steps, temps, top_ks,
-                            top_ps)
+                            top_ps, mask_arg)
                 else:
                     out, self.cache.k, self.cache.v = self._decode_jit(
                         self._decode_params, self.cache.k, self.cache.v,
                         positions, tokens, self._base_key, rids, steps,
-                        temps, top_ks, top_ps)
+                        temps, top_ks, top_ps, mask_arg)
                 out = np.asarray(out)
                 n_emit = None
             else:
@@ -1152,7 +1403,8 @@ class InferenceEngine:
                     out[s] = int(sample_tokens(
                         logits[:, -1],
                         self._stream_key(int(rids[s]), int(steps[s])),
-                        temps[s:s + 1], top_ks[s:s + 1], top_ps[s:s + 1])[0])
+                        temps[s:s + 1], top_ks[s:s + 1], top_ps[s:s + 1],
+                        mask=jnp.asarray(self._mask_row(st.req)))[0])
                 n_emit = None
             if use_spec:
                 span_args["proposed"] = self.spec_k * len(active)
@@ -1210,8 +1462,16 @@ class InferenceEngine:
         return np.asarray(out), np.asarray(n_emit)
 
     def _finish_reason(self, st: _Slot, tok: int) -> Optional[str]:
+        """Why generation stops after emitting ``tok`` (None = keep
+        going). Called exactly once per emitted token, so this is also
+        where a constrained request's automaton consumes the token."""
         if st.req.eos_id is not None and tok == st.req.eos_id:
             return EOS
+        if st.req.constraint is not None:
+            alive = st.req.constraint.advance(tok)
+            if st.req.constraint.finished or not alive:
+                return STOP    # match complete (or an unmasked escape-
+                #                hatch token killed it) — stream is done
         if st.generated >= st.req.max_new_tokens:
             return LENGTH
         if st.length >= self.max_len:

@@ -146,6 +146,21 @@ class PagedKVCache:
     ``kv_fragmentation`` percentage (share of used-block capacity not
     holding a live token) through the StatRegistry, aggregated over
     shards.
+
+    Refcounted sharing (ISSUE 11, the radix prefix cache): every
+    allocated block carries a reference count. ``grow``/``alloc_block``
+    hand out blocks at refcount 1; :meth:`ref_block` lets another owner
+    (a second slot's table, or the prefix tree itself) pin the same
+    block, and releasing a table *unrefs* instead of freeing — a block
+    only returns to its shard's free list when the LAST reference drops
+    (``free_slot``-decrements-instead-of-freeing is what lets one
+    prefilled system prompt fan out under thousands of streams).
+    Writers never mutate a shared block: a slot that must extend a
+    partially-filled shared block first :meth:`replace_block`\\ s it
+    with a copy-on-write duplicate (the device-side copy is the
+    engine's one-compile ``_cow_jit`` program). ``kv_fragmentation``
+    counts each pool block's capacity once however many slots read it,
+    so heavy sharing legitimately drives the gauge toward 0.
     """
 
     def __init__(self, cfg, n_slots: int, n_blocks: Optional[int] = None,
@@ -190,6 +205,7 @@ class PagedKVCache:
                        (d + 1) * self.blocks_per_shard))
             for d in range(self.shards)]
         self._free_set = set(b for free in self._free for b in free)
+        self._refs: dict = {}      # allocated block -> reference count
         self._slot_free: List[int] = list(range(self.n_slots))
         self._update_gauges()
 
@@ -269,6 +285,11 @@ class PagedKVCache:
         return best
 
     @property
+    def free_slot_shards(self) -> set:
+        """Shards that currently have at least one free slot."""
+        return {self.shard_of(s) for s in self._slot_free}
+
+    @property
     def free_blocks_count(self) -> int:
         return sum(len(free) for free in self._free)
 
@@ -283,7 +304,7 @@ class PagedKVCache:
         """Extend ``slot``'s table to cover positions < n_tokens, from
         its OWN shard's free list. All-or-nothing: returns False
         (allocating nothing) when that list cannot supply every needed
-        block."""
+        block. Fresh blocks start at refcount 1 (this table)."""
         need = self.blocks_for(n_tokens)
         table = self.block_tables[slot]
         extra = need - len(table)
@@ -295,22 +316,94 @@ class PagedKVCache:
         for _ in range(extra):
             b = free.pop(0)
             self._free_set.discard(b)
+            self._refs[b] = 1
             table.append(b)
         self._update_gauges()
         return True
 
+    def alloc_block(self, shard: int) -> Optional[int]:
+        """One free block from ``shard``'s list at refcount 1 (the
+        copy-on-write destination), or None when the shard is dry."""
+        free = self._free[shard]
+        if not free:
+            return None
+        b = free.pop(0)
+        self._free_set.discard(b)
+        self._refs[b] = 1
+        self._update_gauges()
+        return b
+
+    def ref_block(self, block: int) -> None:
+        """Pin one more reference on an allocated block (a second slot's
+        table, or the prefix tree adopting it)."""
+        b = int(block)
+        if b not in self._refs:
+            raise AssertionError(
+                f"KV block {b} ref'd while not allocated (use-after-free)")
+        self._refs[b] += 1
+
+    def ref_count(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
+    def unref_block(self, block: int) -> None:
+        """Drop one reference; the LAST drop returns the block to its
+        shard's free list (this is ``free_slot`` decrementing instead of
+        freeing — shared prefix blocks survive their first owner)."""
+        b = int(block)
+        if b in self._free_set:
+            raise AssertionError(
+                f"KV block {b} double-freed (free-list corruption)")
+        shard, local = divmod(b, self.blocks_per_shard)
+        if not 0 <= shard < self.shards or local == 0:
+            raise AssertionError(f"KV block {b} outside pool or a "
+                                 "reserved shard sink")
+        refs = self._refs.get(b)
+        if refs is None:
+            raise AssertionError(
+                f"KV block {b} unref'd while not allocated "
+                "(refcount corruption)")
+        if refs > 1:
+            self._refs[b] = refs - 1
+            return
+        del self._refs[b]
+        self._free[shard].append(b)
+        self._free_set.add(b)
+
     def free_blocks(self, blocks: Sequence[int]) -> None:
         for b in blocks:
-            if b in self._free_set:
-                raise AssertionError(
-                    f"KV block {b} double-freed (free-list corruption)")
-            shard, local = divmod(int(b), self.blocks_per_shard)
-            if not 0 <= shard < self.shards or local == 0:
-                raise AssertionError(f"KV block {b} outside pool or a "
-                                     "reserved shard sink")
-            self._free[shard].append(b)
-            self._free_set.add(b)
+            self.unref_block(b)
         self._update_gauges()
+
+    def splice(self, slot: int, blocks: Sequence[int]) -> None:
+        """Seed an empty slot table with already-allocated (shared)
+        blocks, taking one reference per block — the prefix-cache hit
+        path. Blocks must belong to the slot's shard (the decode
+        step's lookups stay chip-local)."""
+        table = self.block_tables[slot]
+        if table:
+            raise AssertionError(
+                f"splice into slot {slot} with a non-empty table")
+        shard = self.shard_of(slot)
+        for b in blocks:
+            if int(b) // self.blocks_per_shard != shard:
+                raise AssertionError(
+                    f"KV block {b} spliced across shards "
+                    f"(slot {slot} is shard {shard})")
+            self.ref_block(b)
+            table.append(int(b))
+        self._update_gauges()
+
+    def replace_block(self, slot: int, index: int, new_block: int) -> int:
+        """Swap one table entry for ``new_block`` (the copy-on-write
+        commit: the caller has already device-copied the old block's
+        rows into ``new_block`` via the engine's cow program). Drops
+        this table's reference on the old block and returns it."""
+        table = self.block_tables[slot]
+        old = table[index]
+        table[index] = int(new_block)
+        self.unref_block(old)
+        self._update_gauges()
+        return old
 
     def table_row(self, slot: int) -> np.ndarray:
         """This slot's table as a fixed-width int32 row, sink-padded

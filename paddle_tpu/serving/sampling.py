@@ -23,6 +23,14 @@ the target distribution exactly, and greedy reduces to "accept while the
 draft token equals the target argmax" — token-identical to the
 non-speculative engine by construction.
 
+Constrained decoding (ISSUE 11): every sampling entry point takes an
+optional per-row token MASK (B, V) bool — False entries are suppressed
+BEFORE temperature/top-k/top-p, so the filter chain renormalizes over
+the allowed set and greedy rows argmax the masked logits. The serving
+engine feeds masks from per-request token-mask automata
+(serving.constrained); ``mask=None`` (and an all-True mask) leave every
+path bit-identical to the unmasked code.
+
 Everything here is pure jnp, so the FLAGS_serving_jit=0 reference path
 runs the SAME code un-jitted.
 """
@@ -32,7 +40,21 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["sample_tokens", "sample_tokens_streams", "stream_keys",
-           "spec_accept"]
+           "spec_accept", "MASKED_LOGIT"]
+
+# suppression value for masked-out vocabulary entries: finite (softmax
+# over an all-masked row stays NaN-free long enough to be caught
+# host-side) but far below any real logit
+MASKED_LOGIT = -1e30
+
+
+def _apply_mask(logits, mask):
+    """Suppress disallowed tokens; ``mask`` (B, V) bool or None. An
+    all-True mask is the identity (jnp.where copies through), keeping
+    unconstrained engines bit-identical."""
+    if mask is None:
+        return logits
+    return jnp.where(mask, logits, jnp.float32(MASKED_LOGIT))
 
 
 def _filter_logits(logits, temperature, top_k, top_p):
@@ -87,15 +109,17 @@ def _finish(logits, scaled, gumbel, temperature):
                      sampled).astype(jnp.int32)
 
 
-def sample_tokens(logits, key, temperature, top_k, top_p):
+def sample_tokens(logits, key, temperature, top_k, top_p, mask=None):
     """logits (B, V) fp32 → token ids (B,) int32; ONE key for the batch.
 
-    temperature/top_p: (B,) float32; top_k: (B,) int32. The historical
-    shared-key entry point — unconditional math, safe to call eagerly
-    (the reference-decode escape hatch and one-off host-side draws); the
-    engine's jitted steps use :func:`sample_tokens_streams`, which adds
-    the runtime greedy/filter short-circuits."""
-    logits = logits.astype(jnp.float32)
+    temperature/top_p: (B,) float32; top_k: (B,) int32; ``mask`` (B, V)
+    bool suppresses disallowed tokens ahead of the filter chain
+    (constrained decoding). The historical shared-key entry point —
+    unconditional math, safe to call eagerly (the reference-decode
+    escape hatch and one-off host-side draws); the engine's jitted
+    steps use :func:`sample_tokens_streams`, which adds the runtime
+    greedy/filter short-circuits."""
+    logits = _apply_mask(logits.astype(jnp.float32), mask)
     scaled = _filter_logits(logits, temperature, top_k, top_p)
     gumbel = jax.random.gumbel(key, logits.shape, jnp.float32)
     return _finish(logits, scaled, gumbel, temperature)
@@ -114,13 +138,15 @@ def stream_keys(base_key, req_ids, draws):
     return jax.vmap(one)(req_ids, draws)
 
 
-def sample_tokens_streams(logits, keys, temperature, top_k, top_p):
+def sample_tokens_streams(logits, keys, temperature, top_k, top_p,
+                          mask=None):
     """Like :func:`sample_tokens` but each row draws from its OWN key
-    (see :func:`stream_keys`); logits (B, V), keys (B,). All-greedy
-    batches short-circuit to argmax (no filters, no RNG). JIT-context
-    only — the short-circuits are ``lax.cond``, which re-compiles per
-    call when run eagerly."""
-    logits = logits.astype(jnp.float32)
+    (see :func:`stream_keys`); logits (B, V), keys (B,); ``mask``
+    (B, V) bool suppresses disallowed tokens first (greedy rows argmax
+    the masked logits). All-greedy batches short-circuit to argmax (no
+    filters, no RNG). JIT-context only — the short-circuits are
+    ``lax.cond``, which re-compiles per call when run eagerly."""
+    logits = _apply_mask(logits.astype(jnp.float32), mask)
     V = logits.shape[1]
 
     def sampled(logits):

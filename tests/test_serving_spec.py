@@ -359,7 +359,7 @@ class TestMultiChipDecode:
         hlo = jax.jit(eng._decode_fn).lower(
             eng._params, eng.cache.k, eng.cache.v, z, z, eng._base_key,
             z, z, np.zeros(B, np.float32), z,
-            np.ones(B, np.float32)).compile().as_text()
+            np.ones(B, np.float32), eng._ones_mask).compile().as_text()
         assert "all-reduce" in hlo or "all-gather" in hlo
 
     def test_paged_mesh_per_shard_block_accounting(self, engine):

@@ -404,6 +404,77 @@ def shard_balance_report(events: list, file=None) -> dict:
     return out
 
 
+def frontend_report(events: list, file=None) -> dict:
+    """Multi-tenant front-end verdict from the frontend spans (ISSUE 11).
+
+    The HTTP front end emits one ``frontend.request`` span per
+    generation request (args: tenant, lane, status, ms, and the
+    prefix_hit_rate gauge at completion) and one ``frontend.queue_wait``
+    span per ADMITTED request (args: tenant, lane, wait_ms — the time
+    spent in the weighted-fair-queuing lane before engine submission).
+    Aggregated per tenant they answer the SLO questions: who is waiting,
+    who is being throttled (429s), and whether the radix prefix cache is
+    actually absorbing the prompt traffic."""
+    reqs = [e for e in events if e.get("name") == "frontend.request"]
+    waits = [e for e in events if e.get("name") == "frontend.queue_wait"]
+    if not reqs and not waits:
+        return {}
+    tenants: dict = {}
+    for e in reqs:
+        a = e.get("args") or {}
+        t = tenants.setdefault(str(a.get("tenant", "?")), {
+            "lane": a.get("lane", "?"), "requests": 0, "throttled_429": 0,
+            "queue_wait_ms": [], "ok": 0})
+        t["requests"] += 1
+        status = int(a.get("status", 0))
+        if status == 429:
+            t["throttled_429"] += 1
+        elif status == 200:
+            t["ok"] += 1
+    for e in waits:
+        a = e.get("args") or {}
+        t = tenants.setdefault(str(a.get("tenant", "?")), {
+            "lane": a.get("lane", "?"), "requests": 0, "throttled_429": 0,
+            "queue_wait_ms": [], "ok": 0})
+        t["queue_wait_ms"].append(float(a.get("wait_ms", 0.0)))
+    rows_out = []
+    for name, t in sorted(tenants.items()):
+        ws = t.pop("queue_wait_ms")
+        t["tenant"] = name
+        t["queue_wait_ms_avg"] = round(sum(ws) / len(ws), 3) if ws else 0.0
+        t["queue_wait_ms_max"] = round(max(ws), 3) if ws else 0.0
+        rows_out.append(t)
+    hit = next((float((e.get("args") or {}).get("prefix_hit_rate", 0))
+                for e in reversed(reqs)
+                if (e.get("args") or {}).get("prefix_hit_rate")
+                is not None), 0.0)
+    total_429 = sum(t["throttled_429"] for t in rows_out)
+    worst = max(rows_out, key=lambda t: t["queue_wait_ms_max"],
+                default=None)
+    out = {"tenants": rows_out, "throttled_429_total": total_429,
+           "prefix_hit_rate_pct": hit}
+    healthy = worst is None or worst["queue_wait_ms_max"] < 1000.0
+    out["verdict"] = (
+        f"lanes healthy: worst queue wait "
+        f"{0.0 if worst is None else worst['queue_wait_ms_max']:.1f}ms"
+        + (f", {total_429} request(s) throttled per tenant contract"
+           if total_429 else "")
+        + f"; prefix cache serving {hit:.0f}% of prompt tokens"
+        if healthy else
+        f"SLO pressure: tenant {worst['tenant']} ({worst['lane']}) waited "
+        f"up to {worst['queue_wait_ms_max']:.0f}ms in its lane — raise its "
+        "weight, shed load (lower rate/burst), or grow the engine pool")
+    print("\nServing front end:", file=file)
+    for t in rows_out:
+        print(f"  {t['tenant']:<16}{t['lane']:<8}req={t['requests']:<6}"
+              f"429={t['throttled_429']:<5}"
+              f"wait avg/max={t['queue_wait_ms_avg']:.1f}/"
+              f"{t['queue_wait_ms_max']:.1f}ms", file=file)
+    print(f"  prefix_hit_rate: {hit:.0f}%", file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
 def resilience_report(events: list, rows: list, file=None,
                       gauges: dict | None = None) -> dict:
     """Self-healing verdict from the resilience spans (ISSUE 5).
@@ -496,6 +567,7 @@ def main(argv=None):
     serving_report(rows, events=events)
     spec_report(events)
     shard_balance_report(events)
+    frontend_report(events)
     resilience_report(events, rows)
     recompile_report(events)
     pipeline_report(events)
