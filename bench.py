@@ -465,6 +465,82 @@ def bench_gpt_tiny_serving(on_accel):
         eng.shutdown(drain=False)
 
 
+def bench_resilience(on_accel):
+    """Guardian snapshot overhead A/B at gpt_tiny (ISSUE 12): steps/s of
+    (a) an unguarded loop, (b) a guardian with BLOCKING interval-gated
+    disk snapshots, (c) the same cadence with async double-buffered
+    snapshots — the orbax serialization moves to the snapshot thread, so
+    (c) should sit near (a) while (b) pays the write on the loop."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import (gpt_init, gpt_loss, gpt_param_specs,
+                                   gpt_tiny)
+    from paddle_tpu.parallel.mesh import create_mesh, set_mesh
+    from paddle_tpu.parallel.train_step import DistributedTrainStep
+    from paddle_tpu.resilience.guardian import TrainGuardian
+
+    cfg = gpt_tiny(seq_len=128, param_dtype=jnp.float32)
+    B = 8
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                      (B, cfg.seq_len)).astype("int32"))
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                      (B, cfg.seq_len)).astype("int32"))
+
+    def loss_fn(params, batch):
+        return gpt_loss(cfg, params, batch)
+
+    n_steps, warm, cadence = 16, 3, 4
+
+    def leg(mode):
+        set_mesh(None)
+        mesh = create_mesh(dp=min(len(jax.devices()), B))
+        step = DistributedTrainStep(loss_fn, gpt_init(cfg, seed=0),
+                                    gpt_param_specs(cfg),
+                                    optimizer="adamw", lr=1e-3, mesh=mesh,
+                                    sentinel=True)
+        g = None
+        if mode != "no_guardian":
+            g = TrainGuardian(step, ckpt_dir=tempfile.mkdtemp(),
+                              snapshot_every=cadence,
+                              save_interval_steps=cadence,
+                              async_snapshot=(mode == "async_snapshot"))
+        for i in range(warm):
+            loss = step((tokens, labels))
+            if g is not None:
+                g.after_step(i, loss)
+        jax.block_until_ready(step.params)
+        t0 = time.perf_counter()
+        for i in range(warm, warm + n_steps):
+            loss = step((tokens, labels))
+            if g is not None:
+                g.after_step(i, loss)
+        jax.block_until_ready(step.params)
+        dt = time.perf_counter() - t0
+        if g is not None:
+            g.drain_snapshots()
+            g.close()
+        set_mesh(None)
+        return n_steps / dt
+
+    sps = {m: round(leg(m), 3)
+           for m in ("no_guardian", "blocking_snapshot", "async_snapshot")}
+    return {
+        "steps_per_s": sps,
+        "snapshot_every": cadence,
+        "async_vs_blocking": round(
+            sps["async_snapshot"] / sps["blocking_snapshot"], 3),
+        "async_overhead_frac": round(
+            1.0 - sps["async_snapshot"] / sps["no_guardian"], 3),
+        "note": ("interval-gated orbax writes: blocking pays them on the "
+                 "step loop, async only pays the in-loop device->host "
+                 "offload (guardian double buffer + snapshot thread)"),
+    }
+
+
 def bench_serving_load(on_accel):
     """ISSUE 7: serving load generator — Poisson arrivals at several
     offered-load levels against (a) the fixed-slot engine and (b) the
@@ -1305,7 +1381,8 @@ def main():
                      ("gpt_tiny_fused", bench_gpt_tiny_fused),
                      ("gpt_tiny_serving", bench_gpt_tiny_serving),
                      ("serving_spec", bench_serving_spec),
-                     ("serving_load", bench_serving_load)):
+                     ("serving_load", bench_serving_load),
+                     ("resilience", bench_resilience)):
         if over_budget():
             configs[name] = "skipped: time budget (BENCH_TIME_BUDGET)"
             continue

@@ -29,7 +29,18 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..monitor import stats as _mstats
+from ..resilience import faults as _faults
+
 __all__ = ["FileKVStore", "ElasticManager", "ElasticStatus"]
+
+
+def _partition_check() -> None:
+    """kv_partition fault-injection point (resilience.faults): raise the
+    OSError a partitioned NFS/GCS-fuse mount would, for the injected
+    window. One list-index check when no faults are configured."""
+    if _faults.ENABLED[0] and _faults.kv_partition_active():
+        raise OSError("injected kv partition: shared store unreachable")
 
 
 class ElasticStatus:
@@ -67,6 +78,7 @@ class FileKVStore:
         for attempt in range(self.PUT_RETRIES + 1):
             tmp = path + f".tmp.{os.getpid()}.{time.monotonic_ns()}"
             try:
+                _partition_check()   # injected partitions ride the retry path
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 with open(tmp, "wb") as f:
                     f.write(value)
@@ -83,6 +95,7 @@ class FileKVStore:
         raise last
 
     def get(self, key: str) -> Optional[bytes]:
+        _partition_check()
         try:
             with open(self._path(key), "rb") as f:
                 return f.read()
@@ -90,6 +103,7 @@ class FileKVStore:
             return None
 
     def delete(self, key: str) -> None:
+        _partition_check()
         try:
             os.remove(self._path(key))
         except FileNotFoundError:
@@ -97,6 +111,7 @@ class FileKVStore:
 
     def get_prefix(self, prefix: str) -> Dict[str, bytes]:
         """{key: value} for every key under prefix (one directory level)."""
+        _partition_check()
         base = self._path(prefix)
         out = {}
         try:
@@ -183,16 +198,28 @@ class ElasticManager:
         hosts, NTP steps, and NFS server time drift therefore cannot
         kill a live node (or resurrect a dead one) — the cost is that a
         pre-existing stale record counts as alive for one ttl after this
-        manager first sees it."""
+        manager first sees it.
+
+        A host whose record VANISHES (deregistration, or a partition that
+        wiped the lease) has its ``_hb_seen`` entry pruned, so a later
+        re-registration — even one carrying an identical heartbeat
+        payload (frozen/coarse clock, a stale NFS cache replaying the old
+        file) — is a fresh observation, not "the same payload seen a ttl
+        ago": without the prune, a host re-registering after a transient
+        KV partition would come back permanently stale, and the stale
+        bookkeeping row would shadow (double-count against) its live
+        registration."""
         now_m = time.monotonic()
         dead = set(self.dead_hosts())
         alive = []
+        present = set()
         for key, raw in self.kv.get_prefix(self.node_prefix).items():
             try:
                 rec = json.loads(raw.decode())
             except (ValueError, UnicodeDecodeError):
                 continue
             host = rec.get("host")
+            present.add(host)
             if host in dead or rec.get("status") == "dead":
                 continue
             ts = float(rec.get("ts", 0))
@@ -202,7 +229,30 @@ class ElasticManager:
             elif now_m - seen[1] > self.ttl:
                 continue
             alive.append(host)
+        for host in [h for h in self._hb_seen if h not in present]:
+            del self._hb_seen[host]
+        _mstats.POD_HOSTS_ALIVE.set(len(alive))
         return sorted(alive)
+
+    def last_seen_age(self, host: str) -> Optional[float]:
+        """Seconds of LOCAL monotonic time since this manager last
+        observed a NEW heartbeat payload from ``host`` (None = never
+        observed). This is the staleness input to :meth:`alive_hosts` —
+        a host is declared stale once its age exceeds the ttl."""
+        seen = self._hb_seen.get(host)
+        if seen is None:
+            return None
+        return time.monotonic() - seen[1]
+
+    def host_ages(self) -> Dict[str, float]:
+        """{host: last-seen age in seconds} for every registered host
+        (tombstoned hosts included — the caller filters). Refreshes the
+        observation bookkeeping first, so ages reflect the current store
+        contents."""
+        self.alive_hosts()
+        now_m = time.monotonic()
+        return {h: now_m - first_m
+                for h, (_, first_m) in self._hb_seen.items()}
 
     # -- quorum / scale (reference _match :247, np watch :205) ---------------
     def match(self) -> Tuple[bool, List[str]]:

@@ -29,8 +29,9 @@ device arrays, no jit sinks (pinned by tests/test_fleet_auto.py).
 from .cost_model import (HardwareSpec, ModelStats, PlanCandidate,  # noqa: F401
                          enumerate_plans, estimate)
 from .planner import ParallelPlan, explain, last_plan, plan  # noqa: F401
+from .resize import replan_for_devices  # noqa: F401
 from .zero import ShardedOptimizer  # noqa: F401
 
 __all__ = ["HardwareSpec", "ModelStats", "PlanCandidate", "enumerate_plans",
            "estimate", "ParallelPlan", "plan", "explain", "last_plan",
-           "ShardedOptimizer"]
+           "ShardedOptimizer", "replan_for_devices"]

@@ -1,0 +1,48 @@
+"""Elastic resize: re-plan a job over a SURVIVING device set.
+
+fleet.auto made "what mesh fits N-k hosts" a solved query — the planner
+already enumerates and ranks every legal (dp, sharding, pp, mp, micro,
+zero) factorisation for an arbitrary device count. This module is the
+thin bridge the resilience stack drives on host loss: take the devices
+that are still alive, re-run :func:`~.planner.plan` over exactly that
+many, and install the chosen mesh over exactly those devices. The
+TrainGuardian then reshards the pod-agreed snapshot onto the new plan
+via the ZeRO sharded<->unsharded checkpoint round-trip (snapshots hold
+full unsharded host arrays; ``device_put`` under the new step's
+NamedShardings is the reshard) and resumes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from .cost_model import HardwareSpec, ModelStats
+from .planner import ParallelPlan, plan
+
+__all__ = ["replan_for_devices"]
+
+
+def replan_for_devices(devices: Sequence, *, global_batch: int,
+                       params=None, stats: Optional[ModelStats] = None,
+                       hardware: Optional[HardwareSpec] = None,
+                       install: bool = True,
+                       **plan_kw) -> Tuple[ParallelPlan, "object"]:
+    """Re-run the planner over ``devices`` (the survivors of a host
+    loss) and build the 4-axis mesh over exactly those devices.
+
+    Returns ``(plan, mesh)``. ``install=True`` (default) also registers
+    the mesh with the parallel/fleet state, so a subsequently-built
+    DistributedTrainStep picks it up. Raises ``ValueError`` when no
+    legal candidate fits the shrunken pod — the caller's last rung.
+    """
+    devices = list(devices)
+    if not devices:
+        raise ValueError("replan_for_devices: no surviving devices")
+    p = plan(params=params, stats=stats, global_batch=global_batch,
+             n_devices=len(devices), hardware=hardware, **plan_kw)
+    from ....parallel.mesh import create_mesh, set_mesh
+
+    mesh = create_mesh(dp=p.dp, sharding=p.sharding, pp=p.pp, mp=p.mp,
+                       devices=devices)
+    if not install:
+        set_mesh(None)
+    return p, mesh

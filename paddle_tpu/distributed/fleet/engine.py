@@ -969,6 +969,16 @@ class FleetEngine:
     def train_step(self) -> DistributedTrainStep:
         return self._step
 
+    def adopt_train_step(self, step: DistributedTrainStep) -> None:
+        """Swap in a rebuilt inner step (TrainGuardian elastic resize:
+        the pod lost a host, fleet.auto re-planned over the survivors and
+        a fresh DistributedTrainStep was built on the new mesh). The
+        eager Layer mirrors the adopted device params immediately, so
+        state_dict/save readers never see the dead mesh's arrays."""
+        self._step = step
+        self._write_back(step.params)
+        self._write_back_buffers(step.aux)
+
     def _emit_pipeline_ticks(self):
         """One ``pipeline.tick`` span per schedule tick with the stage
         occupancy of the STATIC schedule actually compiled (the in-jit

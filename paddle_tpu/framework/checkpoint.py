@@ -137,12 +137,22 @@ class CheckpointManager:
 
     def __init__(self, directory: str, save_interval_steps: int = 1,
                  max_to_keep: Optional[int] = 3, async_save: bool = True,
-                 save_retries: int = 3):
+                 save_retries: int = 3, keep_last: Optional[int] = None):
         import orbax.checkpoint as ocp
 
         self.directory = os.path.abspath(directory)
         self.save_interval_steps = max(1, int(save_interval_steps))
         self.save_retries = int(save_retries)
+        # keep_last: OUR retention sweep over the step dirs on disk, on
+        # top of orbax's max_to_keep. Orbax only garbage-collects steps
+        # it tracks — a crash mid-write, a force-save retry, or a step
+        # dir corrupted after the fact leaves directories all_steps()
+        # never lists, and a long resilient run (rollbacks, preemption
+        # relaunches) accumulates them without bound. The sweep removes
+        # every step dir and stale ``*.tmp-*`` leftover older than the
+        # newest ``keep_last`` steps; the tmp+atomic-rename discipline is
+        # untouched (renames happen first, the sweep only ever deletes).
+        self.keep_last = int(keep_last) if keep_last else None
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=self.save_interval_steps,
@@ -186,10 +196,18 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         state = self._state_of(obj)
-        return _with_io_retry(
+        out = _with_io_retry(
             lambda: self._mgr.save(step, args=ocp.args.StandardSave(state),
                                    force=force),
             f"checkpoint save (step {step})", retries=self.save_retries)
+        if self.keep_last is not None:
+            self._gc(just_saved=step)
+        return out
+
+    def should_save(self, step: int) -> bool:
+        """Whether :meth:`maybe_save` would write at this step (public so
+        an async caller can gate BEFORE paying for the host offload)."""
+        return bool(self._mgr.should_save(step))
 
     def maybe_save(self, step: int, obj) -> bool:
         """Interval-gated snapshot; returns False when skipped. Transient
@@ -199,6 +217,33 @@ class CheckpointManager:
         if not self._mgr.should_save(step):
             return False
         return self._save(step, obj, force=False)
+
+    # -- retention ----------------------------------------------------------
+    def _gc(self, just_saved: Optional[int] = None) -> None:
+        """The ``keep_last`` sweep: delete every numeric step dir older
+        than the newest ``keep_last`` (corrupt ones included — age is the
+        step NUMBER, so a garbage-filled old dir cannot pin itself by
+        mtime) plus any stale ``*.tmp-*`` leftovers. The dir just saved
+        is never deleted even if retention math would pick it."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        steps = []
+        for n in names:
+            p = os.path.join(self.directory, n)
+            if ".tmp-" in n or n.startswith("tmp"):
+                shutil.rmtree(p, ignore_errors=True)
+                continue
+            if n.isdigit() and os.path.isdir(p):
+                steps.append(int(n))
+        keep = set(sorted(steps)[-self.keep_last:])
+        if just_saved is not None:
+            keep.add(int(just_saved))
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(os.path.join(self.directory, str(s)),
+                              ignore_errors=True)
 
     def save(self, step: int, obj) -> bool:
         """Unconditional snapshot (bypasses save_interval_steps) — for the

@@ -293,6 +293,7 @@ class Model:
         # priority checkpoint and stops cleanly. Pass a TrainGuardian, a
         # kwargs dict for one, or True for defaults.
         guardian = None
+        guardian_owned = False
         if resilience is not None and resilience is not False \
                 and getattr(self, "_static", None) is None and self._use_jit:
             from ..resilience.guardian import TrainGuardian
@@ -302,6 +303,7 @@ class Model:
             else:
                 kwargs = {} if resilience is True else dict(resilience)
                 guardian = TrainGuardian(**kwargs)
+                guardian_owned = True   # fit created it -> fit closes it
             if self._train_step is None:
                 self._train_step = self._build_train_step(
                     sentinel=guardian.sentinel_config)
@@ -342,9 +344,10 @@ class Model:
                 if guardian is not None:
                     action = guardian.after_step(
                         self._train_step._step_count - 1, raw)
-                    if action == "rollback":
-                        # state rewound to the snapshot; replay the epoch
-                        # with a fresh batch order
+                    if action in ("rollback", "resize"):
+                        # state rewound to the snapshot (possibly on a
+                        # re-planned mesh after host loss); replay the
+                        # epoch with a fresh batch order
                         pending = None
                         restart_epoch = True
                         break
@@ -368,6 +371,15 @@ class Model:
                 break
             epoch += 1
         self._sync_train_step()
+        if guardian is not None:
+            # the async snapshot thread must not outlive the fit that
+            # spawned it (a pending background save at interpreter exit
+            # dies in orbax's shut-down executor); user-passed guardians
+            # stay open — their loop may continue — but drain here
+            if guardian_owned:
+                guardian.close()
+            else:
+                guardian.drain_snapshots()
         cbks.on_train_end({})
 
     def _sync_train_step(self):

@@ -244,6 +244,8 @@ class TrainStep:
         self._compiled_fast = None
         self._buffer_tensors: Dict[str, Tensor] = {}
         self._lr_cache = (None, None)
+        # guardian lr_backoff multiplier (scale_lr); 1.0 = untouched
+        self._lr_scale = 1.0
         self._slots_dirty = False
         # FLAGS_sanitize: batch aval signatures already compiled — a new
         # one is a recompile; the explainer names the differing leaf
@@ -350,7 +352,7 @@ class TrainStep:
             return self._call_fast(batch)
         params = {k: self._params[k]._data for k in self._param_names}
         buffers = {k: b._data for k, b in self.model.named_buffers() if b is not None}
-        lr = self.optimizer.get_lr()
+        lr = self.optimizer.get_lr() * self._lr_scale
         arr_batch = _tree_tensor_to_array(batch)
         donated = None
         if _sanitize[0]:
@@ -384,7 +386,7 @@ class TrainStep:
         step itself never reads."""
         params = {k: self._params[k]._data for k in self._param_names}
         buffers = {k: t._data for k, t in self._buffer_tensors.items()}
-        lr = self.optimizer.get_lr()
+        lr = self.optimizer.get_lr() * self._lr_scale
         if self._lr_cache[0] != lr:
             # device-cache the lr scalar: a python-float jit arg is a
             # fresh host->device transfer every step
@@ -413,6 +415,13 @@ class TrainStep:
             out.health = {"trip": self.sentinel_state["last_trip"],
                           "trips": self.sentinel_state["trips"]}
         return out
+
+    def scale_lr(self, scale: float) -> None:
+        """Set the ABSOLUTE learning-rate multiplier (TrainGuardian's
+        post-rollback backoff). The lr enters the compiled step as a
+        traced scalar, so rescaling never recompiles; optimizer
+        schedules keep their shape, scaled."""
+        self._lr_scale = float(scale)
 
     def _note_batch_sig(self, arr_batch):
         """FLAGS_sanitize recompile explainer: a batch aval signature not

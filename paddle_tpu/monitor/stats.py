@@ -204,6 +204,11 @@ DEFAULT_STATS = (
     "frontend_active_streams",  # gauge: generation streams currently open
     "constrained_requests",     # requests decoding under a token-mask automaton
     "constrained_fallback_ticks",  # spec ticks dropped to the plain program
+    # pod-level resilience (ISSUE 12)
+    "pod_hosts_alive",          # gauge: hosts with a fresh, non-tombstoned lease
+    "elastic_resizes",          # pod resizes (replan+reshard+resume) after host loss
+    "serving_watchdog_trips",   # serving sentinel verdicts (NaN tick / latency stall)
+    "serving_watchdog_restarts",  # engine restarts from the last healthy state
 )
 
 for _n in DEFAULT_STATS:
@@ -254,6 +259,10 @@ PLAN_CANDIDATES_CONSIDERED = _registry.get_stat("plan_candidates_considered")
 ZERO_LEVEL = _registry.get_stat("zero_level")
 PIPELINE_BUBBLE_FRAC = _registry.get_stat("pipeline_bubble_frac")
 PLANNER_HBM_HEADROOM_BYTES = _registry.get_stat("planner_hbm_headroom_bytes")
+POD_HOSTS_ALIVE = _registry.get_stat("pod_hosts_alive")
+ELASTIC_RESIZES = _registry.get_stat("elastic_resizes")
+SERVING_WATCHDOG_TRIPS = _registry.get_stat("serving_watchdog_trips")
+SERVING_WATCHDOG_RESTARTS = _registry.get_stat("serving_watchdog_restarts")
 PREFIX_MATCHED_TOKENS = _registry.get_stat("prefix_matched_tokens")
 PREFIX_LOOKUP_TOKENS = _registry.get_stat("prefix_lookup_tokens")
 PREFIX_HIT_RATE = _registry.get_stat("prefix_hit_rate")

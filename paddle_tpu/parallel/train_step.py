@@ -590,11 +590,20 @@ class DistributedTrainStep:
         # fresh jnp.float32 per call is a host->device transfer per step
         # that the compiled program then waits on
         self._lr_cache = (None, None)
+        # guardian lr_backoff multiplier (scale_lr); 1.0 = untouched
+        self._lr_scale = 1.0
 
     def current_lr(self) -> float:
         if callable(self._lr):
-            return float(self._lr(self._step_count))
-        return float(self._lr)
+            return float(self._lr(self._step_count)) * self._lr_scale
+        return float(self._lr) * self._lr_scale
+
+    def scale_lr(self, scale: float) -> None:
+        """Set the ABSOLUTE learning-rate multiplier (TrainGuardian's
+        post-rollback backoff). The lr enters the compiled step as a
+        traced scalar, so rescaling never recompiles; schedules keep
+        their shape, scaled."""
+        self._lr_scale = float(scale)
 
     def __call__(self, batch):
         if _faults.ENABLED[0]:

@@ -33,13 +33,36 @@ stalled step     watchdog thread vs the      ``watchdog_stalls`` gauge,
                                              flush
 flaky ckpt I/O   OSError during save         retry with exponential backoff
                                              (framework/checkpoint.py)
+host loss        heartbeat staleness /       pod-coordinated ELASTIC RESIZE
+(pod)            tombstone via               (:mod:`.pod` + guardian
+                 :class:`PodCoordinator`     ``rebuild=``): fleet.auto
+                                             replans over the survivors,
+                                             the agreed snapshot reshards
+                                             through the ZeRO checkpoint
+                                             round-trip, training resumes
+KV-store         OSError from the shared     FileKVStore put retry budget;
+partition        FileKVStore                 liveness probes report
+                                             "unknowable", never all-dead
+poisoned decode  per-tick NaN/latency        serving engine auto-restart:
+tick (serving)   sentinel                    poisoned requests fail, healthy
+                 (``serving/engine.py        streams resume token-identical
+                 watchdog=``)                from replayed history
 ===============  ==========================  ================================
 
+The pod escalation ladder, cheapest rung first:
+**skip** (in-jit gate) -> **rollback** to the pod-agreed snapshot step
+(+LR backoff) -> **resize** over the surviving hosts ->
+:class:`TrainingAborted`.
+
 Gauges: ``faults_injected``, ``sentinel_trips``, ``rollbacks``,
-``preempt_saves``, ``watchdog_stalls``, ``guardian_heartbeat_ms``.
-Trace spans: ``resilience.snapshot`` / ``resilience.rollback`` /
-``resilience.preempt_save`` + ``resilience.trip`` instants —
-``tools/trace_report.py`` renders them as a resilience timeline.
+``preempt_saves``, ``watchdog_stalls``, ``guardian_heartbeat_ms``,
+``pod_hosts_alive``, ``elastic_resizes``, ``serving_watchdog_trips``,
+``serving_watchdog_restarts``.
+Trace spans: ``resilience.snapshot`` / ``resilience.snapshot_async`` /
+``resilience.rollback`` / ``resilience.pod_agree`` /
+``resilience.resize`` / ``resilience.preempt_save`` +
+``resilience.trip`` instants — ``tools/trace_report.py`` renders them as
+a resilience timeline with a per-host pod section.
 
 Wired in: ``hapi.Model.fit(resilience=...)`` and
 ``FleetEngine(..., sentinel=...)`` + ``TrainGuardian.attach(engine)``;
@@ -53,17 +76,25 @@ from . import sentinel  # noqa: F401
 __all__ = [
     "faults", "sentinel", "FAULTS", "FaultSpec", "InjectedCrash",
     "configure_faults", "TrainGuardian", "TrainingAborted", "guardian",
+    "PodCoordinator", "PodAgreementError", "pod",
 ]
 
 
 def __getattr__(name):
     # guardian pulls in framework.checkpoint (orbax) — lazy so fault
-    # hooks in hot paths never pay for it
+    # hooks in hot paths never pay for it; pod stays lazy with it
     if name in ("TrainGuardian", "TrainingAborted", "guardian"):
         import importlib
 
         mod = importlib.import_module(".guardian", __name__)
         if name == "guardian":
+            return mod
+        return getattr(mod, name)
+    if name in ("PodCoordinator", "PodAgreementError", "pod"):
+        import importlib
+
+        mod = importlib.import_module(".pod", __name__)
+        if name == "pod":
             return mod
         return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -12,6 +12,7 @@ Spec grammar (comma/semicolon-separated)::
     FLAGS_fault_inject="nan_grad@step=50:repeat=3,crash@step=120"
     FLAGS_fault_inject="ckpt_io_error@p=0.5:seed=7:repeat=4"
     FLAGS_fault_inject="stall@step=80:secs=2,preempt@step=200"
+    FLAGS_fault_inject="host_loss@step=40:host=h2,kv_partition@step=10:secs=0.5"
 
 Each fault is ``kind@trigger[:opt=value]*`` where trigger is either
 ``step=N`` (fires on the first ``repeat`` step-encounters with index >=
@@ -19,7 +20,8 @@ N — consecutive steps, and NOT again after the budget is spent, so a
 rollback replay of the same step indices runs clean) or ``p=F`` (fires
 per encounter with probability F from a private ``seed``-ed RNG —
 deterministic across runs). Options: ``repeat`` (default 1 for step
-faults, unlimited for p faults), ``secs`` (stall duration), ``seed``.
+faults, unlimited for p faults), ``secs`` (stall duration), ``seed``,
+``host`` (which simulated host a pod fault hits).
 
 Kinds and their hook points:
 
@@ -30,6 +32,15 @@ nan_grad       float leaves of the batch become NaN        train steps
 crash          raises :class:`InjectedCrash`               train steps
 preempt        ``signal.raise_signal(SIGTERM)``            train steps
 stall          ``time.sleep(secs)`` inside the step        train steps
+host_loss      tombstones ``host`` in the elastic KV       resilience/pod.py
+               store (the pod sees a dead host and
+               escalates to elastic resize)
+kv_partition   FileKVStore raises OSError for ``secs``     resilience/pod.py +
+               (a transient shared-filesystem partition;   distributed/elastic.py
+               heartbeats ride the put retry budget)
+serving_nan    NaNs one slot's KV rows at the first        serving/engine.py
+               decode tick of request id >= N (keyed by
+               REQUEST id, not train step)
 input_stall    ``time.sleep(secs)`` in the prefetcher      io/prefetch.py
 ckpt_io_error  raises ``OSError`` during checkpoint save   framework/checkpoint.py
 =============  ==========================================  ===============
@@ -57,12 +68,32 @@ from ..core import native as _native
 from ..monitor import stats as _mstats
 
 __all__ = ["FaultSpec", "FaultRegistry", "InjectedCrash", "FAULTS",
-           "ENABLED", "configure_faults"]
+           "ENABLED", "configure_faults", "begin_kv_partition",
+           "kv_partition_active"]
 
 # fast-path gate: hook sites read ENABLED[0] before touching the registry
 ENABLED = [False]
 
-_STEP_KINDS = ("nan_grad", "crash", "preempt", "stall")
+_STEP_KINDS = ("nan_grad", "crash", "preempt", "stall", "host_loss",
+               "kv_partition")
+# request-id-keyed kinds live in their OWN index space: a serving request
+# id must never consume a step-keyed budget (or vice versa) when training
+# and serving share a process
+_RID_KINDS = ("serving_nan",)
+
+# monotonic deadline of the currently-injected KV-store partition window
+# (0.0 = none). FileKVStore consults kv_partition_active() on every op.
+_PARTITION_UNTIL = [0.0]
+
+
+def begin_kv_partition(secs: float) -> None:
+    """Open an injected shared-filesystem partition window: every
+    FileKVStore op raises OSError until it closes."""
+    _PARTITION_UNTIL[0] = time.monotonic() + float(secs)
+
+
+def kv_partition_active() -> bool:
+    return ENABLED[0] and time.monotonic() < _PARTITION_UNTIL[0]
 
 
 class InjectedCrash(RuntimeError):
@@ -73,18 +104,23 @@ class InjectedCrash(RuntimeError):
 class FaultSpec:
     """One parsed fault clause."""
 
-    __slots__ = ("kind", "step", "p", "repeat", "secs", "seed",
+    __slots__ = ("kind", "step", "p", "repeat", "secs", "seed", "host",
                  "remaining", "_rng")
 
     def __init__(self, kind: str, step: Optional[int] = None,
                  p: Optional[float] = None, repeat: Optional[int] = None,
-                 secs: float = 1.0, seed: int = 0):
+                 secs: float = 1.0, seed: int = 0,
+                 host: Optional[str] = None):
         if (step is None) == (p is None):
             raise ValueError(
                 f"fault {kind!r} needs exactly one trigger: step=N or p=F")
+        if kind == "host_loss" and not host:
+            raise ValueError("host_loss needs host=H (which simulated host "
+                             "dies)")
         self.kind = kind
         self.step = step
         self.p = p
+        self.host = host
         # step faults default to firing once; p faults to unlimited
         self.repeat = repeat if repeat is not None else (1 if p is None
                                                         else -1)
@@ -128,7 +164,8 @@ def parse_spec(text: str) -> List[FaultSpec]:
             p=float(kw["p"]) if "p" in kw else None,
             repeat=int(kw["repeat"]) if "repeat" in kw else None,
             secs=float(kw.get("secs", 1.0)),
-            seed=int(kw.get("seed", 0))))
+            seed=int(kw.get("seed", 0)),
+            host=kw.get("host")))
     return out
 
 
@@ -169,6 +206,8 @@ class FaultRegistry:
         self.faults: List[FaultSpec] = []
         self._cur_step: Optional[int] = None
         self._cur_fired: Dict[str, FaultSpec] = {}
+        self._cur_rid: Optional[int] = None
+        self._rid_fired: Dict[str, FaultSpec] = {}
 
     # -- configuration ------------------------------------------------------
     def configure(self, text: str) -> None:
@@ -177,6 +216,9 @@ class FaultRegistry:
         self.faults = parse_spec(text or "")
         self._cur_step = None
         self._cur_fired = {}
+        self._cur_rid = None
+        self._rid_fired = {}
+        _PARTITION_UNTIL[0] = 0.0
         ENABLED[0] = bool(self.faults)
 
     # -- evaluation ---------------------------------------------------------
@@ -203,6 +245,20 @@ class FaultRegistry:
         firing, or already claimed by an outer hook)."""
         self._eval_step(step)
         return self._cur_fired.pop(kind, None)
+
+    def take_request(self, kind: str, rid: int) -> Optional[FaultSpec]:
+        """Claim a REQUEST-id-keyed fault (serving hooks). Request ids
+        live in their own index space so a serving fault never consumes a
+        train-step budget and vice versa."""
+        if rid != self._cur_rid:
+            self._cur_rid = rid
+            self._rid_fired = {}
+            for f in self.faults:
+                if f.kind in _RID_KINDS and f.step is not None \
+                        and self._fires(f, rid):
+                    f.consume()
+                    self._rid_fired[f.kind] = f
+        return self._rid_fired.pop(kind, None)
 
     def chance(self, kind: str) -> Optional[FaultSpec]:
         """Per-encounter (p=...) fault draw."""

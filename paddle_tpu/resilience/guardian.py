@@ -5,19 +5,45 @@ The guardian composes the pieces that already existed in isolation
 gauges) into a training loop that survives NaNs, stalls, crashes and
 preemption:
 
-- **rolling snapshot**: every ``snapshot_every`` healthy steps the full
-  training state (params, optimizer state, buffers, scaler, RNG, step
-  count) is offloaded to HOST memory — O(model) RAM, no filesystem — so
-  a rollback never waits on storage. ``resilience.snapshot`` trace span.
+- **rolling snapshot ring**: every ``snapshot_every`` healthy steps the
+  full training state (params, optimizer state, buffers, scaler, RNG,
+  step count) is offloaded to HOST memory — O(model) RAM per kept
+  snapshot (``keep_snapshots`` of them), no filesystem — so a rollback
+  never waits on storage. ``resilience.snapshot`` trace span. With
+  ``async_snapshot=True`` the interval-gated ON-DISK checkpoint writes
+  move to a snapshot thread fed from an alternating two-deep buffer of
+  host copies: the device->host offload is the only in-loop cost, the
+  orbax serialization overlaps the following steps
+  (``resilience.snapshot_async`` spans measure it), and
+  ``step_async_syncs`` stays flat — the thread reads host arrays, never
+  the AsyncLoss.
 - **escalation ladder** on sentinel trips (read at ``check_every``
   cadence from the device-resident trip counter): the in-jit gate has
   already SKIPPED the poisoned update (GradScaler-style, params
   untouched); after ``skip_limit`` consecutive tripped steps the
   guardian REWINDS to the last snapshot (``resilience.rollback`` span,
-  ``rollbacks`` gauge) and bumps ``data_seed`` so the caller re-seeds
-  its data order; after ``max_rollbacks`` rewinds it raises
-  :class:`TrainingAborted` — a babysitter would have paged a human long
-  ago.
+  ``rollbacks`` gauge), multiplies the learning rate by ``lr_backoff``
+  (default 1.0 = off; the replay runs gentler each rewind) and bumps
+  ``data_seed`` so the caller re-seeds its data order; after
+  ``max_rollbacks`` rewinds it raises :class:`TrainingAborted` — a
+  babysitter would have paged a human long ago.
+- **pod coordination** (``pod=PodCoordinator(...)``): the rollback step
+  is AGREED pod-wide first — each host proposes the snapshot steps it
+  holds through the elastic FileKVStore, the commit is the highest step
+  every host holds, a laggard adopts the committed step, and an ack
+  barrier aligns the replay — so every host restores the SAME step and
+  the pod-wide replay stays bit-exact (see :mod:`.pod`).
+- **elastic resize** (``pod=`` + ``rebuild=``): a lost host (heartbeat
+  staleness, a tombstone, or an injected ``host_loss`` fault) no longer
+  aborts the pod. The guardian agrees a snapshot step with the
+  survivors, re-plans over the surviving device set (``rebuild`` — see
+  ``fleet.auto.replan_for_devices``), reshards the agreed snapshot onto
+  the new mesh through the ZeRO sharded<->unsharded checkpoint
+  round-trip (full host arrays device_put under the new step's
+  shardings), and resumes (``resilience.resize`` span,
+  ``elastic_resizes`` gauge). :class:`TrainingAborted` is the LAST rung
+  of the ladder — skip -> rollback (+LR backoff) -> resize -> abort —
+  not the first.
 - **preemption**: ``install_preemption_handler()`` catches SIGTERM (the
   Cloud TPU preemption notice); the next ``after_step`` forces a
   priority orbax save (``preempt_saves`` gauge), marks
@@ -37,11 +63,11 @@ Usage::
     start = g.restore_latest() or 0          # crash auto-resume
     i = start
     while i < n_steps:
-        loss = step(batch_at(i, seed=g.data_seed))
+        loss = g.step(batch_at(i, seed=g.data_seed))
         action = g.after_step(i, loss)
-        if action == "rollback":
+        if action in ("rollback", "resize"):
             i = g.resume_step                # replay from the snapshot
-            continue
+            continue                         # (resize also swapped g.step)
         if action == "preempt":
             break                            # priority save already done
         i += 1
@@ -87,7 +113,9 @@ class TrainGuardian:
                  max_rollbacks: int = 3, check_every: int = 1,
                  sentinel=True, watchdog_timeout: Optional[float] = None,
                  elastic=None, save_interval_steps: int = 1,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, keep_snapshots: int = 1,
+                 async_snapshot: bool = False, lr_backoff: float = 1.0,
+                 pod=None, rebuild=None):
         self.snapshot_every = max(1, int(snapshot_every))
         self.skip_limit = int(skip_limit)
         self.max_rollbacks = int(max_rollbacks)
@@ -96,13 +124,31 @@ class TrainGuardian:
                                 if sentinel else None)
         self.watchdog_timeout = watchdog_timeout
         self.elastic = elastic
+        self.pod = pod              # PodCoordinator: rollback agreement,
+        #                             host-loss detection, resize devices
+        self.rebuild = rebuild      # callable(devices) -> new step object
+        self.keep_snapshots = max(1, int(keep_snapshots))
+        self.async_snapshot = bool(async_snapshot)
+        self.lr_backoff = float(lr_backoff)
+        self._lr_scale = 1.0        # cumulative backoff applied so far
         self.data_seed = 0          # bumped by every rollback
         self.ckpt_dir = ckpt_dir
         self._ckpt = None
         self._ckpt_opts = (int(save_interval_steps), int(max_to_keep))
         self._obj = None            # as attached (may be a FleetEngine)
         self._step_obj = None       # the underlying train step
-        self._snap = None           # (step_idx, host state tree)
+        self._snaps: dict = {}      # step_idx -> host state tree (ring)
+        self._resizes = 0
+        # async-snapshot writer state: an alternating two-deep buffer of
+        # (step, host tree) pending disk serialization; the loop drops
+        # the OLDEST pending entry when both buffers are in use (the
+        # newest state wins — a slow filesystem thins the cadence, it
+        # never stalls the step loop)
+        self._snap_pending: list = []
+        self._snap_cv = threading.Condition()
+        self._snap_busy = False
+        self._snap_thread = None
+        self._snap_stop = False
         self._consec = 0            # consecutive tripped check windows
         self._trips_seen = 0
         self._rollbacks = 0
@@ -121,6 +167,14 @@ class TrainGuardian:
             self.attach(step)
 
     # -- attachment ---------------------------------------------------------
+    @property
+    def step(self):
+        """The CURRENT attached step/engine — an elastic resize swaps in
+        a rebuilt one, so pod-aware loops drive ``guardian.step(batch)``
+        (or re-read this after a ``"resize"`` action) instead of holding
+        the construction-time reference."""
+        return self._obj
+
     def attach(self, obj) -> "TrainGuardian":
         """Bind a train step or FleetEngine; takes the initial snapshot so
         a rollback is possible from step 0."""
@@ -134,6 +188,8 @@ class TrainGuardian:
                 self.ckpt_dir, save_interval_steps=interval,
                 max_to_keep=keep, async_save=False)
         self.snapshot(-1)
+        if self.async_snapshot and self._ckpt is not None:
+            self._start_snap_thread()
         if self.watchdog_timeout:
             self._start_watchdog()
         return self
@@ -220,21 +276,33 @@ class TrainGuardian:
                 eng._write_back_buffers(getattr(self._step_obj, "aux", None))
 
     # -- snapshot / rollback -------------------------------------------------
+    def _span_args(self, **kw) -> dict:
+        if self.pod is not None:
+            kw["host"] = self.pod.host
+        return kw
+
     def snapshot(self, step_idx: int) -> None:
-        """Host-offloaded rolling snapshot (keeps exactly one)."""
+        """Host-offloaded rolling snapshot into the ring (keeps the
+        newest ``keep_snapshots``). The device->host copy happens here,
+        on the loop thread — the arrays it captures are donated to the
+        very next step, so offloading later would read freed buffers."""
         with _mtrace.span("resilience.snapshot", cat="resilience",
-                          args={"step": step_idx}):
-            self._snap = (int(step_idx), _host_tree(self._capture()))
+                          args=self._span_args(step=step_idx)):
+            self._snaps[int(step_idx)] = _host_tree(self._capture())
+            for old in sorted(self._snaps)[:-self.keep_snapshots]:
+                del self._snaps[old]
 
     @property
     def resume_step(self) -> int:
         """First step index to (re)run after a rollback/restore."""
-        return (self._snap[0] + 1) if self._snap is not None else 0
+        return (max(self._snaps) + 1) if self._snaps else 0
 
     def rollback(self) -> int:
-        """Rewind to the last snapshot; returns the step index to resume
-        from. Raises :class:`TrainingAborted` past ``max_rollbacks``."""
-        if self._snap is None:
+        """Rewind to the last snapshot — pod-AGREED when a coordinator is
+        attached, so every host restores the same step. Returns the step
+        index to resume from; raises :class:`TrainingAborted` past
+        ``max_rollbacks``."""
+        if not self._snaps:
             raise TrainingAborted("sentinel tripped but no snapshot exists")
         self._rollbacks += 1
         _mstats.ROLLBACKS.add()
@@ -242,11 +310,13 @@ class TrainGuardian:
             raise TrainingAborted(
                 f"aborting: {self._rollbacks} rollbacks exceed "
                 f"max_rollbacks={self.max_rollbacks}")
-        step_idx, state = self._snap
+        step_idx = self._agree_step()
+        state = self._snaps[step_idx]
         with _mtrace.span("resilience.rollback", cat="resilience",
-                          args={"to_step": step_idx,
-                                "rollback": self._rollbacks}):
+                          args=self._span_args(to_step=step_idx,
+                                               rollback=self._rollbacks)):
             self._install(state)
+            self._discard_after(step_idx)
             s = self._step_obj
             if getattr(s, "sentinel_state", None) is not None:
                 # fresh verdict baseline — the EMA saw the fault window
@@ -254,21 +324,71 @@ class TrainGuardian:
             self._consec = 0
             self._trips_seen = 0
             self.data_seed += 1
+            self._backoff_lr()
         return self.resume_step
+
+    def _agree_step(self, expected=None) -> int:
+        """The snapshot step to restore: pod-committed when coordinated
+        (a laggard host adopts the commit even when it is older than its
+        own newest snapshot), else simply the newest held."""
+        if self.pod is None:
+            return max(self._snaps)
+        from .pod import PodAgreementError
+
+        try:
+            step_idx = self.pod.agree_rollback(sorted(self._snaps),
+                                               expected=expected)
+        except PodAgreementError as e:
+            raise TrainingAborted(f"pod rollback agreement failed: {e}") \
+                from e
+        if step_idx not in self._snaps:
+            # the protocol commits a COMMON step, so this is a local
+            # bookkeeping bug or a snapshot dropped mid-agreement
+            raise TrainingAborted(
+                f"pod committed step {step_idx} but this host holds "
+                f"{sorted(self._snaps)}")
+        return step_idx
+
+    def _discard_after(self, step_idx: int) -> None:
+        """Drop ring snapshots NEWER than the restored step — they were
+        taken on the poisoned timeline the pod just agreed to abandon."""
+        for s in [s for s in self._snaps if s > step_idx]:
+            del self._snaps[s]
+
+    def _backoff_lr(self) -> None:
+        """Apply the post-rollback LR backoff (``lr_backoff=1.0``
+        disables — the replay stays bit-exact vs a fault-free run)."""
+        if self.lr_backoff == 1.0:
+            return
+        self._lr_scale *= self.lr_backoff
+        s = self._step_obj
+        if hasattr(s, "scale_lr"):
+            s.scale_lr(self._lr_scale)
+        else:
+            warnings.warn(
+                f"lr_backoff={self.lr_backoff} set but "
+                f"{type(s).__name__} has no scale_lr(); learning rate "
+                "left unchanged")
 
     # -- per-step driver ------------------------------------------------------
     def after_step(self, step_idx: int, loss=None) -> str:
         """Call once per completed step. Returns ``"ok"``, ``"skip"`` (the
         in-jit gate discarded a poisoned update), ``"rollback"`` (state
         rewound — resume from :attr:`resume_step` with re-seeded data
-        order), or ``"preempt"`` (priority checkpoint written — exit)."""
+        order), ``"resize"`` (a host was lost; the pod re-planned over the
+        survivors, resharded the agreed snapshot and swapped in the
+        rebuilt step — resume from :attr:`resume_step`), or ``"preempt"``
+        (priority checkpoint written — exit)."""
         del loss  # the verdict is read from device state, not the handle
         self._beat()
         if self._preempted:
             self._priority_save(step_idx)
             return "preempt"
         if self._ckpt is not None:
-            self._ckpt.maybe_save(step_idx, self._capture())
+            if self.async_snapshot:
+                self._enqueue_disk_save(step_idx)
+            else:
+                self._ckpt.maybe_save(step_idx, self._capture())
         action = "ok"
         st = getattr(self._step_obj, "sentinel_state", None)
         if st is not None and (step_idx % self.check_every == 0):
@@ -288,10 +408,147 @@ class TrainGuardian:
                 action = "skip"
             else:
                 self._consec = 0
+        if self.pod is not None and step_idx % self.check_every == 0:
+            self.pod.maybe_heartbeat()
+            lost = self.pod.lost_hosts(step_idx)
+            if lost:
+                self.resize(lost)
+                return "resize"
         if action == "ok" and step_idx >= 0 \
                 and step_idx % self.snapshot_every == 0:
             self.snapshot(step_idx)
         return action
+
+    # -- elastic resize -------------------------------------------------------
+    def resize(self, lost) -> int:
+        """Host loss -> replan + reshard + resume instead of aborting.
+
+        The survivors agree the snapshot step to restore, ``rebuild``
+        re-plans over the surviving device set (typically
+        ``fleet.auto.replan_for_devices`` + a fresh DistributedTrainStep
+        on the new mesh), and the agreed snapshot — full unsharded host
+        arrays, exactly what the ZeRO-2/3 checkpoint round-trip emits —
+        is device_put under the NEW step's shardings. Returns the step
+        index to resume from; :class:`TrainingAborted` only when no
+        rebuild hook exists, no snapshot is restorable, or the rebuild
+        itself fails (e.g. fleet.auto finds no plan that fits N-k
+        hosts) — the LAST rung of the ladder."""
+        if self.rebuild is None:
+            raise TrainingAborted(
+                f"host(s) {sorted(lost)} lost and no rebuild= hook is "
+                "attached — cannot resize, aborting")
+        if not self._snaps:
+            raise TrainingAborted(
+                f"host(s) {sorted(lost)} lost before any snapshot exists")
+        self.drain_snapshots()
+        survivors = [h for h in (self.pod.hosts if self.pod else [])
+                     if h not in set(lost)]
+        step_idx = self._agree_step(expected=survivors or None)
+        devices = (self.pod.surviving_devices(lost)
+                   if self.pod is not None else None)
+        with _mtrace.span("resilience.resize", cat="resilience",
+                          args=self._span_args(
+                              step=step_idx, lost=sorted(lost),
+                              devices=len(devices or []))):
+            if self.pod is not None:
+                self.pod.remove_hosts(lost)
+            try:
+                new_step = self.rebuild(devices)
+            except Exception as e:  # noqa: BLE001 — planner no-fit etc.
+                raise TrainingAborted(
+                    f"resize rebuild over {len(devices or [])} surviving "
+                    f"device(s) failed: {type(e).__name__}: {e}") from e
+            self._adopt_step(new_step)
+            self._install(self._snaps[step_idx])
+            self._discard_after(step_idx)
+            s = self._step_obj
+            if getattr(s, "sentinel_state", None) is not None:
+                s.sentinel_state = _sentinel.init_state()
+            self._consec = 0
+            self._trips_seen = 0
+            self._resizes += 1
+            _mstats.ELASTIC_RESIZES.add()
+        return self.resume_step
+
+    def _adopt_step(self, new_step) -> None:
+        """Swap in the rebuilt train step. A FleetEngine attachment keeps
+        the engine as the façade and hands it the new inner step (eager
+        mirrors refresh on the next write-back)."""
+        if self._obj is not self._step_obj \
+                and hasattr(self._obj, "adopt_train_step"):
+            self._obj.adopt_train_step(
+                getattr(new_step, "train_step", new_step))
+            self._step_obj = self._obj.train_step
+        else:
+            self._obj = new_step
+            self._step_obj = getattr(new_step, "train_step", new_step)
+        if self._lr_scale != 1.0 and hasattr(self._step_obj, "scale_lr"):
+            self._step_obj.scale_lr(self._lr_scale)
+
+    # -- async snapshot writer -----------------------------------------------
+    def _enqueue_disk_save(self, step_idx: int) -> None:
+        """Hand the interval-gated on-disk save to the snapshot thread.
+        The host offload happens HERE, on the loop thread — the captured
+        device arrays are donated to the very next step, so the thread
+        must only ever see host copies."""
+        if not self._ckpt.should_save(step_idx):
+            return
+        state = _host_tree(self._capture())
+        with self._snap_cv:
+            if len(self._snap_pending) >= 2:
+                # both buffers in use: the filesystem is slower than the
+                # save cadence — keep the newest state, thin the cadence
+                self._snap_pending.pop(0)
+            self._snap_pending.append((int(step_idx), state))
+            self._snap_cv.notify_all()
+
+    def _snap_loop(self) -> None:
+        while True:
+            with self._snap_cv:
+                while not self._snap_pending and not self._snap_stop:
+                    self._snap_cv.wait(0.1)
+                if self._snap_stop and not self._snap_pending:
+                    return
+                step_idx, state = self._snap_pending.pop(0)
+                self._snap_busy = True
+            try:
+                with _mtrace.span("resilience.snapshot_async",
+                                  cat="resilience",
+                                  args=self._span_args(step=step_idx)):
+                    # already interval-gated on the loop thread
+                    self._ckpt.save(step_idx, state)
+            except Exception as e:  # noqa: BLE001 — a failed background
+                # save must not kill training; the next cadence retries
+                warnings.warn(f"async checkpoint save at step {step_idx} "
+                              f"failed: {type(e).__name__}: {e}")
+            finally:
+                with self._snap_cv:
+                    self._snap_busy = False
+                    self._snap_cv.notify_all()
+
+    def _start_snap_thread(self) -> None:
+        if self._snap_thread is not None:
+            return
+        self._snap_stop = False
+        self._snap_thread = threading.Thread(
+            target=self._snap_loop, name="train-guardian-snapshot",
+            daemon=True)
+        self._snap_thread.start()
+
+    def drain_snapshots(self, timeout: float = 60.0) -> None:
+        """Block until the snapshot thread has no pending/in-flight disk
+        writes (rollback, resize, restore and shutdown all wait here —
+        state decisions must not race a half-written checkpoint)."""
+        if self._snap_thread is None:
+            return
+        deadline = time.monotonic() + timeout
+        with self._snap_cv:
+            while self._snap_pending or self._snap_busy:
+                if not self._snap_cv.wait(0.05) \
+                        and time.monotonic() > deadline:
+                    warnings.warn("drain_snapshots timed out with a "
+                                  "disk write still in flight")
+                    return
 
     # -- crash auto-resume ----------------------------------------------------
     def restore_latest(self) -> Optional[int]:
@@ -300,6 +557,7 @@ class TrainGuardian:
         step dirs are skipped with a warning."""
         if self._ckpt is None:
             return None
+        self.drain_snapshots()
         got = self._ckpt.restore_latest_tree(self._capture())
         if got is None:
             return None
@@ -330,8 +588,9 @@ class TrainGuardian:
         return self._preempted
 
     def _priority_save(self, step_idx: int) -> None:
+        self.drain_snapshots()
         with _mtrace.span("resilience.preempt_save", cat="resilience",
-                          args={"step": step_idx}):
+                          args=self._span_args(step=step_idx)):
             if self._ckpt is not None:
                 self._ckpt.save(max(step_idx, 0), self._capture())
                 self._ckpt.wait_until_finished()
@@ -410,6 +669,13 @@ class TrainGuardian:
         if self._closed:
             return
         self._closed = True
+        if self._snap_thread is not None:
+            self.drain_snapshots()
+            with self._snap_cv:
+                self._snap_stop = True
+                self._snap_cv.notify_all()
+            self._snap_thread.join(timeout=5.0)
+            self._snap_thread = None
         self._watchdog_stop.set()
         if self._watchdog is not None:
             self._watchdog.join(timeout=1.0)

@@ -1,0 +1,232 @@
+"""Pod-wide agreement + host-loss detection for coordinated resilience.
+
+A single-host TrainGuardian (PR 5) rewinds to ITS snapshot; on a pod that
+is not enough — every host must restore the SAME step or the replicated
+optimizer states diverge and the replay stops being bit-exact. This
+module supplies the two pod-level primitives the guardian composes:
+
+- :class:`PodCoordinator.agree_rollback` — a propose/commit/ack protocol
+  over the elastic :class:`~paddle_tpu.distributed.elastic.FileKVStore`
+  (the same shared directory the ElasticManager heartbeats through, with
+  the same transient-OSError retry discipline). Each host proposes the
+  snapshot steps it holds; once every live host has proposed, the commit
+  is the HIGHEST step present in every proposal (deterministic, so the
+  racing committers all write the same value and the atomic-rename put
+  makes the overwrite benign); a laggard host that arrives after the
+  commit simply adopts it. An ack barrier holds everyone at the commit
+  until the whole pod has restored, so the replay restarts aligned.
+- :class:`PodCoordinator.lost_hosts` — membership verdict from the
+  ElasticManager's monotonic heartbeat staleness (plus tombstones), with
+  the ``host_loss@step=N:host=H`` / ``kv_partition@step=N:secs=S`` fault
+  specs claimed here so the resize and partition paths are testable
+  without real multi-host runs. A store partition makes liveness
+  UNKNOWABLE, not everyone-dead: reads that raise OSError report no
+  losses for that probe.
+
+The protocol keys live under ``jobs/<job>/rollback/<round>/`` — one
+round per pod-wide rollback or resize, numbered locally in lockstep
+(every host initiates the same rollback: the sentinel verdict that
+triggers it is replicated device state).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..monitor import trace as _mtrace
+from . import faults as _faults
+
+__all__ = ["PodCoordinator", "PodAgreementError"]
+
+
+class PodAgreementError(RuntimeError):
+    """The pod could not agree a rollback step (timeout waiting for
+    proposals/acks, or no snapshot step common to every host)."""
+
+
+class PodCoordinator:
+    """One per host; all instances of a job share the FileKVStore.
+
+    Args:
+      kv: the shared :class:`FileKVStore` (NFS/GCS-fuse dir on real pods,
+        a tmpdir in tests).
+      job_id: job namespace inside the store.
+      host: THIS host's name.
+      hosts: full expected pod membership (all hosts, this one included).
+      elastic: optional :class:`ElasticManager` for heartbeat-staleness
+        liveness; without it only tombstones (``mark_dead``) count as
+        losses.
+      device_map: ``{host: [jax devices]}`` — which devices each host
+        contributes to the mesh. Only needed for elastic resize, where
+        the surviving device set seeds the fleet.auto replan. Its keys
+        may be a SUPERSET of ``hosts``: the single-process virtual-mesh
+        rig drives one coordinating agent that watches several simulated
+        device-hosts (the membership checks cover the union).
+      timeout / poll: agreement deadline and poll cadence (seconds).
+    """
+
+    def __init__(self, kv, job_id: str, host: str,
+                 hosts: Sequence[str], elastic=None,
+                 device_map: Optional[Dict[str, list]] = None,
+                 timeout: float = 30.0, poll: float = 0.005):
+        self.kv = kv
+        self.job_id = str(job_id)
+        self.host = str(host)
+        self.hosts: List[str] = sorted(str(h) for h in hosts)
+        if self.host not in self.hosts:
+            raise ValueError(f"host {self.host!r} not in pod {self.hosts}")
+        self.elastic = elastic
+        self.device_map = dict(device_map or {})
+        self.timeout = float(timeout)
+        self.poll = float(poll)
+        self.prefix = f"jobs/{self.job_id}"
+        self._round = 0
+
+    # -- kv helpers (partition-tolerant) -------------------------------------
+    def _get(self, key: str) -> Optional[bytes]:
+        try:
+            return self.kv.get(key)
+        except OSError:
+            return None
+
+    def _get_prefix(self, prefix: str) -> dict:
+        try:
+            return self.kv.get_prefix(prefix)
+        except OSError:
+            return {}
+
+    def _put(self, key: str, value) -> bool:
+        try:
+            self.kv.put(key, value)
+            return True
+        except OSError:
+            return False   # partition outlived the retry budget: re-poll
+
+    # -- rollback agreement --------------------------------------------------
+    def agree_rollback(self, held_steps: Sequence[int],
+                       expected: Optional[Sequence[str]] = None) -> int:
+        """Propose the snapshot steps this host holds; return the
+        pod-committed rollback step (the highest step EVERY live host
+        holds). Blocks until commit + full ack barrier, or raises
+        :class:`PodAgreementError` at ``timeout``."""
+        self._round += 1
+        r = self._round
+        base = f"{self.prefix}/rollback/{r}"
+        expected = sorted(expected) if expected is not None else self.hosts
+        proposal = json.dumps(sorted(int(s) for s in set(held_steps)))
+        deadline = time.monotonic() + self.timeout
+        proposed = False
+        committed: Optional[int] = None
+        with _mtrace.span("resilience.pod_agree", cat="resilience",
+                          args={"host": self.host, "round": r}):
+            while time.monotonic() < deadline:
+                if not proposed:
+                    proposed = self._put(f"{base}/prop/{self.host}", proposal)
+                raw = self._get(f"{base}/commit")
+                if raw is not None:
+                    # a laggard adopts the committed step even if its own
+                    # proposal never made the decision
+                    committed = int(raw.decode())
+                    break
+                props = self._get_prefix(f"{base}/prop")
+                if proposed and len(props) >= len(expected):
+                    sets = [set(json.loads(v.decode()))
+                            for v in props.values()]
+                    common = set.intersection(*sets) if sets else set()
+                    step = max(common) if common else -1
+                    # every decider computes the same value from the same
+                    # full proposal set — concurrent commits are idempotent
+                    if self._put(f"{base}/commit", str(step)):
+                        committed = step
+                        break
+                time.sleep(self.poll)
+        if committed is None:
+            raise PodAgreementError(
+                f"pod rollback round {r}: no commit within "
+                f"{self.timeout}s (have "
+                f"{sorted(self._get_prefix(f'{base}/prop'))}, need "
+                f"{expected})")
+        if committed < 0:
+            raise PodAgreementError(
+                f"pod rollback round {r}: no snapshot step common to "
+                f"every host")
+        # ack barrier: nobody replays until the whole pod has restored
+        self._put(f"{base}/ack/{self.host}", b"1")
+        while time.monotonic() < deadline:
+            if len(self._get_prefix(f"{base}/ack")) >= len(expected):
+                return committed
+            time.sleep(self.poll)
+        raise PodAgreementError(
+            f"pod rollback round {r}: ack barrier timed out at step "
+            f"{committed}")
+
+    # -- membership ----------------------------------------------------------
+    def maybe_heartbeat(self) -> None:
+        """Refresh this host's lease (partition-tolerant: a blip rides the
+        put retry budget; a longer one just skips the beat)."""
+        if self.elastic is not None:
+            try:
+                self.elastic.heartbeat(self.host)
+            except OSError:
+                pass
+
+    def lost_hosts(self, step: Optional[int] = None) -> List[str]:
+        """Hosts of this pod that are gone (tombstoned, or heartbeat-stale
+        when an ElasticManager is attached). ``step`` additionally claims
+        the step-keyed ``host_loss`` / ``kv_partition`` fault specs, so an
+        injected pod failure surfaces through the SAME detection path a
+        real one would."""
+        if step is not None and _faults.ENABLED[0]:
+            f = _faults.FAULTS.take("kv_partition", step)
+            if f is not None:
+                from ..monitor import stats as _mstats
+
+                _mstats.FAULTS_INJECTED.add()
+                _faults.begin_kv_partition(f.secs)
+            f = _faults.FAULTS.take("host_loss", step)
+            if f is not None:
+                from ..monitor import stats as _mstats
+
+                _mstats.FAULTS_INJECTED.add()
+                try:
+                    if self.elastic is not None:
+                        self.elastic.mark_dead(f.host)
+                    else:
+                        self._put(f"{self.prefix}/dead/{f.host}", b"1")
+                except OSError:
+                    pass   # partitioned store: the tombstone lands later
+        watch = sorted(set(self.hosts) | set(self.device_map))
+        dead: set = set()
+        if self.elastic is not None:
+            try:
+                dead.update(self.elastic.dead_hosts())
+                alive = set(self.elastic.alive_hosts())
+                dead.update(h for h in watch
+                            if h not in alive and self.elastic.last_seen_age(h)
+                            is not None)
+            except OSError:
+                return []   # partition: liveness unknowable, not all-dead
+        else:
+            dead.update(k.rsplit("/", 1)[1] for k in
+                        self._get_prefix(f"{self.prefix}/dead"))
+        return sorted(h for h in watch if h in dead)
+
+    def remove_hosts(self, lost: Sequence[str]) -> List[str]:
+        """Shrink the expected membership after a resize; returns the
+        surviving coordinating-host list."""
+        lost_set = set(lost)
+        self.hosts = [h for h in self.hosts if h not in lost_set]
+        for h in lost_set:
+            self.device_map.pop(h, None)
+        return list(self.hosts)
+
+    def surviving_devices(self, lost: Sequence[str]) -> list:
+        """Devices contributed by the device-map hosts NOT in ``lost``
+        (device_map order preserved) — the fleet.auto replan input."""
+        lost_set = set(lost)
+        out = []
+        for h in self.device_map:
+            if h not in lost_set:
+                out.extend(self.device_map[h])
+        return out

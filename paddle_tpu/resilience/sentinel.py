@@ -30,7 +30,17 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["default_config", "init_state", "update", "global_grad_norm",
-           "gate"]
+           "gate", "logits_finite"]
+
+
+def logits_finite(logits) -> jnp.ndarray:
+    """Per-row all-finite verdict over a ``(batch, vocab)`` logits block
+    — the SERVING side's NaN sentinel. The engine's decode ticks return
+    it (one bool per slot) when ``InferenceEngine(watchdog=...)`` is
+    armed, so a poisoned stream is identified inside the already-running
+    program, the same zero-extra-work discipline as the training
+    sentinel's in-jit verdict."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)
 
 
 def default_config(z_thresh: float = 8.0, warmup: int = 20,

@@ -135,20 +135,26 @@ from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
                           gpt_prefill_chunk, gpt_prefill_prefix,
                           gpt_verify_step, gpt_verify_step_paged)
 from ..monitor.stats import (CONSTRAINED_FALLBACK_TICKS,
-                             CONSTRAINED_REQUESTS, PREFIX_COW_COPIES,
+                             CONSTRAINED_REQUESTS, FAULTS_INJECTED,
+                             PREFIX_COW_COPIES,
                              SERVING_DECODE_MS, SERVING_EVICTIONS,
                              SERVING_PREEMPTIONS, SERVING_PREFILL_MS,
                              SERVING_QUEUE_DEPTH, SERVING_SHARDS,
                              SERVING_SLOT_OCCUPANCY, SERVING_TOKENS_PER_S,
+                             SERVING_WATCHDOG_RESTARTS,
+                             SERVING_WATCHDOG_TRIPS,
                              SPEC_ACCEPTANCE_RATE, SPEC_ACCEPTED,
                              SPEC_PROPOSED)
-from ..monitor.trace import span
+from ..resilience import faults as _faults
+from ..resilience.sentinel import logits_finite
+from ..monitor.trace import TRACING, get_writer, span
 from .kv_cache import KVCache, PagedKVCache, cache_insert
 from .prefix_cache import RadixPrefixCache
 from .sampling import (DRAFT_SALT, sample_tokens, sample_tokens_streams,
                        spec_accept, stream_keys)
 
-__all__ = ["InferenceEngine", "GenerationRequest", "QueueFull"]
+__all__ = ["InferenceEngine", "GenerationRequest", "QueueFull",
+           "WatchdogTripped"]
 
 _CACHE_SPEC = P("data", None, "model", None, None)
 
@@ -166,6 +172,14 @@ SHUTDOWN = "shutdown"
 ERROR = "error"
 STOP = "stop"        # constrained decoding: the token-mask automaton
 #                      reached a complete match — nothing more to emit
+WATCHDOG = "watchdog"  # the per-tick NaN sentinel found this stream's
+#                        logits poisoned; the engine restarted around it
+
+
+class WatchdogTripped(RuntimeError):
+    """Carried as the ``error`` of a request the serving watchdog failed:
+    its decode logits went non-finite (poisoned KV/weights/activations).
+    Healthy streams in the same batch are resumed, token-identical."""
 
 
 class GenerationRequest:
@@ -356,6 +370,20 @@ class InferenceEngine:
     blocks instead of re-prefilling, with copy-on-write on a
     partially-used last block and LRU-by-leaf reclaim ahead of
     preemption. Greedy output stays token-identical to the cold cache.
+
+    ``watchdog`` (True or a dict; default off, and when off every
+    compiled program is bit-identical to a watchdog-free build) arms the
+    per-tick NaN/latency sentinel: each decode tick also returns a
+    per-slot all-finite verdict over the logits; a poisoned slot FAILS
+    only its own request (finish_reason ``"watchdog"``, error
+    :class:`WatchdogTripped`) and the engine auto-restarts from the last
+    healthy state — healthy streams are requeued with their token
+    history and replayed through the preemption-resume path
+    (token-identical continuations), the device cache and prefix tree
+    are rebuilt from scratch. Options: ``latency_budget_ms`` (None
+    disables the latency rung) with ``latency_trips`` consecutive slow
+    ticks per stall verdict, and ``max_restarts`` before the engine
+    fails open requests loudly. Not combinable with ``draft=``.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
@@ -365,7 +393,28 @@ class InferenceEngine:
                  block_size: int = 16, n_blocks: Optional[int] = None,
                  prefill_chunk: int = 64, tps_window_ticks: int = 64,
                  draft=None, spec_k: int = 4, mesh=None, tokenizer=None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None, watchdog=None):
+        # per-tick NaN/latency sentinel + auto-restart (off by default;
+        # when off the engine's compiled programs are bit-identical to a
+        # build without it — the health output is gated at trace time)
+        if watchdog:
+            defaults = {"latency_budget_ms": None, "latency_trips": 3,
+                        "max_restarts": 3}
+            if watchdog is not True:
+                unknown = set(dict(watchdog)) - set(defaults)
+                if unknown:
+                    raise ValueError(f"unknown watchdog option(s) "
+                                     f"{sorted(unknown)}")
+                defaults.update(dict(watchdog))
+            if draft is not None:
+                raise ValueError(
+                    "watchdog and draft= are not combinable yet: the "
+                    "speculative tick carries no per-slot health output")
+            self._watchdog = defaults
+        else:
+            self._watchdog = None
+        self._restarts = 0
+        self._slow_ticks = 0
         if getattr(cfg, "fused_mlp", None) is None:
             # pin the fused-MLP choice NOW (graftlint GL002): prefill
             # programs compile lazily per prompt-length bucket, so a
@@ -402,6 +451,9 @@ class InferenceEngine:
         else:
             self._decode_params = self._params
         self.paged = native.paged_kv[0] if paged is None else bool(paged)
+        # cache construction args, kept for the watchdog's restart path
+        # (a restart rebuilds the device cache from scratch)
+        self._cache_args = (max_len, n_blocks, block_size)
         if self.paged:
             self.cache = PagedKVCache(cfg, n_slots, n_blocks=n_blocks,
                                       block_size=block_size,
@@ -583,6 +635,10 @@ class InferenceEngine:
                                          positions, tokens)
         toks = self._sample_args(logits, base_key, rids, steps, temps,
                                  top_ks, top_ps, mask)
+        if self._watchdog is not None:
+            # per-slot finite verdict — gated at TRACE time, so a
+            # watchdog-off engine compiles the exact historical program
+            return toks, logits_finite(logits), k, v
         return toks, k, v
 
     def _prefill_fn(self, params, k, v, tokens, slot, true_len, key, temp,
@@ -618,6 +674,8 @@ class InferenceEngine:
             self.cfg, params, (kb, vb), tables, positions, tokens)
         toks = self._sample_args(logits, base_key, rids, steps, temps,
                                  top_ks, top_ps, mask)
+        if self._watchdog is not None:
+            return toks, logits_finite(logits), kb, vb
         return toks, kb, vb
 
     def _tail_fn(self, params, kb, vb, table_row, tokens, start):
@@ -1064,14 +1122,25 @@ class InferenceEngine:
         return out
 
     def _prefill(self, req: GenerationRequest, slot: int) -> None:
-        S = int(req.prompt.size)
+        # a watchdog restart requeues fixed-mode streams with a resume
+        # record: re-prefill prompt+generated[:-1] and rebuild decode
+        # state without re-emitting — the paged preemption-resume
+        # contract on the fixed cache
+        resume = req._resume
+        req._resume = None
+        seq = resume[0] if resume is not None else req.prompt
+        S = int(seq.size)
+        if resume is not None and S + 1 > self.max_len:
+            self.cache.release(slot)
+            req._finish(LENGTH)
+            return
         t0 = time.perf_counter()
         with span("serving.prefill", cat="serving",
                   args={"slot": slot, "prompt_len": S}):
             if native.serving_jit[0]:
                 s_pad = self._bucket(S)
                 toks = np.zeros((1, s_pad), np.int32)
-                toks[0, :S] = req.prompt
+                toks[0, :S] = seq
                 key = self._stream_key(req.rid, 0)
                 if self.draft is not None:
                     (tok, self.cache.k, self.cache.v, self.draft_cache.k,
@@ -1092,7 +1161,7 @@ class InferenceEngine:
                         jnp.asarray(self._mask_row(req)))
             else:
                 logits = gpt_forward(self.cfg, self._params,
-                                     jnp.asarray(req.prompt[None]))
+                                     jnp.asarray(seq[None]))
                 tok = sample_tokens(
                     logits[:, -1], self._stream_key(req.rid, 0),
                     jnp.float32(req.temperature)[None],
@@ -1105,6 +1174,12 @@ class InferenceEngine:
         st = _Slot(req, length=S, last_token=tok)
         self._slots[slot] = st
         self.cache.lengths[slot] = S
+        if resume is not None:
+            # tokens through resume[1] were already streamed before the
+            # restart — rebuild decode state, emit nothing
+            st.last_token = resume[1]
+            st.generated = len(req.tokens)
+            return
         req._push(tok)
         self._note_tokens(1)
         reason = self._finish_reason(st, tok)
@@ -1327,6 +1402,17 @@ class InferenceEngine:
                 if not active:
                     return
 
+        if _faults.ENABLED[0]:
+            # serving_nan fault (FLAGS_fault_inject, keyed by REQUEST id):
+            # NaN the slot's cached K/V — the deterministic stand-in for
+            # poisoned HBM — so the watchdog path is testable on CPU
+            for s in active:
+                f = _faults.FAULTS.take_request("serving_nan",
+                                               self._slots[s].req.rid)
+                if f is not None:
+                    FAULTS_INJECTED.add()
+                    self._poison_slot(s)
+
         positions = np.zeros(self.n_slots, np.int32)
         tokens = np.zeros(self.n_slots, np.int32)
         temps = np.zeros(self.n_slots, np.float32)
@@ -1361,6 +1447,7 @@ class InferenceEngine:
         if use_spec:
             span_args["spec_k"] = self.spec_k
         t0 = time.perf_counter()
+        health = None
         # span_args is serialized when the span closes, so the spec
         # proposed/accepted counts added below land in the trace event
         with span("serving.decode_step", cat="serving", args=span_args):
@@ -1378,28 +1465,40 @@ class InferenceEngine:
                     tables = tables[:, :self._width_bucket(
                         max(len(self.cache.block_tables[s])
                             for s in active))]
-                    out, self.cache.kb, self.cache.vb = \
-                        self._decode_paged_jit(
-                            self._decode_params, self.cache.kb,
-                            self.cache.vb, tables, positions, tokens,
-                            self._base_key, rids, steps, temps, top_ks,
-                            top_ps, mask_arg)
+                    got = self._decode_paged_jit(
+                        self._decode_params, self.cache.kb,
+                        self.cache.vb, tables, positions, tokens,
+                        self._base_key, rids, steps, temps, top_ks,
+                        top_ps, mask_arg)
+                    if self._watchdog is not None:
+                        out, health, self.cache.kb, self.cache.vb = got
+                    else:
+                        out, self.cache.kb, self.cache.vb = got
                 else:
-                    out, self.cache.k, self.cache.v = self._decode_jit(
+                    got = self._decode_jit(
                         self._decode_params, self.cache.k, self.cache.v,
                         positions, tokens, self._base_key, rids, steps,
                         temps, top_ks, top_ps, mask_arg)
+                    if self._watchdog is not None:
+                        out, health, self.cache.k, self.cache.v = got
+                    else:
+                        out, self.cache.k, self.cache.v = got
                 out = np.asarray(out)
                 n_emit = None
             else:
                 # reference decode: full recompute per sequence, no cache
                 out = np.zeros(self.n_slots, np.int32)
+                if self._watchdog is not None:
+                    health = np.ones(self.n_slots, bool)
                 for s in active:
                     st = self._slots[s]
                     seq = np.concatenate(
                         [st.req.prompt, np.asarray(st.req.tokens, np.int32)])
                     logits = gpt_forward(self.cfg, self._params,
                                          jnp.asarray(seq[None]))
+                    if health is not None:
+                        health[s] = bool(np.all(np.isfinite(
+                            np.asarray(logits[:, -1]))))
                     out[s] = int(sample_tokens(
                         logits[:, -1],
                         self._stream_key(int(rids[s]), int(steps[s])),
@@ -1410,8 +1509,19 @@ class InferenceEngine:
                 span_args["proposed"] = self.spec_k * len(active)
                 span_args["accepted"] = int(sum(int(n_emit[s]) - 1
                                                for s in active))
-        self._note_ms(SERVING_DECODE_MS, "_decode_ms",
-                      (time.perf_counter() - t0) * 1e3)
+        tick_ms = (time.perf_counter() - t0) * 1e3
+        self._note_ms(SERVING_DECODE_MS, "_decode_ms", tick_ms)
+        if self._watchdog is not None:
+            poisoned = [] if health is None else \
+                [s for s in active if not bool(np.asarray(health)[s])]
+            if poisoned:
+                SERVING_WATCHDOG_TRIPS.add(len(poisoned))
+                # the whole tick's outputs are dropped: poisoned streams
+                # fail, healthy ones resume by replay — token-identical,
+                # the same exactness contract as preemption-resume
+                self._watchdog_restart(poisoned)
+                return
+            self._watchdog_latency(tick_ms)
 
         emitted = 0
         for s in active:
@@ -1485,6 +1595,113 @@ class InferenceEngine:
         SERVING_EVICTIONS.add(1)
         SERVING_SLOT_OCCUPANCY.set(self.cache.occupancy)
         st.req._finish(reason)
+
+    # -- watchdog: NaN/latency sentinel + auto-restart -----------------------
+    def _poison_slot(self, slot: int) -> None:
+        """serving_nan fault effect: overwrite the slot's cached K/V rows
+        with NaN (the deterministic stand-in for poisoned HBM / a bad
+        collective). Only the jitted cache-decode paths read these rows —
+        the FLAGS_serving_jit=0 reference decode recomputes from tokens
+        and never sees them."""
+        nan = float("nan")
+        if self.paged:
+            rows = jnp.asarray(self.cache.block_tables[slot], jnp.int32)
+            self.cache.kb = self.cache.kb.at[rows].set(nan)
+            self.cache.vb = self.cache.vb.at[rows].set(nan)
+        else:
+            self.cache.k = self.cache.k.at[slot].set(nan)
+            self.cache.v = self.cache.v.at[slot].set(nan)
+
+    def _watchdog_latency(self, tick_ms: float) -> None:
+        """Latency rung of the sentinel: ``latency_trips`` consecutive
+        decode ticks over ``latency_budget_ms`` is a stall verdict —
+        counted and timestamped for the trace, not restarted (a restart
+        cannot make compute faster; an operator can)."""
+        budget = self._watchdog["latency_budget_ms"]
+        if not budget:
+            return
+        if tick_ms <= float(budget):
+            self._slow_ticks = 0
+            return
+        self._slow_ticks += 1
+        if self._slow_ticks >= int(self._watchdog["latency_trips"]):
+            self._slow_ticks = 0
+            SERVING_WATCHDOG_TRIPS.add()
+            if TRACING[0]:
+                get_writer().add_instant("serving.watchdog_stall",
+                                         time.perf_counter(), cat="serving")
+
+    def _watchdog_restart(self, poisoned: List[int]) -> None:
+        """Engine auto-restart from the last healthy state: fail ONLY the
+        poisoned requests, requeue every healthy open stream with its
+        token history (admission replays it through the preemption-resume
+        path — continuations are token-identical because the per-request
+        RNG streams are pure functions of (seed, rid, draw)), and rebuild
+        the device cache + prefix tree from scratch — the old pool may
+        hold NaN rows behind shared blocks or the garbage sink."""
+        self._restarts += 1
+        if self._restarts > int(self._watchdog["max_restarts"]):
+            # the last rung: a persistently-poisoned engine fails loudly
+            # (scheduler _abort fails every open request with this cause)
+            raise WatchdogTripped(
+                f"watchdog restart budget exhausted "
+                f"(max_restarts={self._watchdog['max_restarts']})")
+        SERVING_WATCHDOG_RESTARTS.add()
+        bad = set(poisoned)
+        healthy = sorted(
+            ((st.admit_order, s) for s, st in enumerate(self._slots)
+             if st is not None and s not in bad), reverse=True)
+        with span("serving.watchdog_restart", cat="serving",
+                  args={"poisoned": sorted(bad), "healthy": len(healthy),
+                        "restart": self._restarts, "tick": self._ticks}):
+            for s in bad:
+                st = self._slots[s]
+                self._slots[s] = None
+                SERVING_EVICTIONS.add(1)
+                st.req._finish(WATCHDOG, WatchdogTripped(
+                    f"non-finite decode logits (request {st.req.rid})"))
+            # youngest first through appendleft => oldest ends up at the
+            # queue head, preserving admission order on replay
+            for _, s in healthy:
+                st = self._slots[s]
+                self._slots[s] = None
+                if st.req.tokens:
+                    seq = np.concatenate(
+                        [st.req.prompt,
+                         np.asarray(st.req.tokens[:-1],
+                                    np.int32)]).astype(np.int32)
+                    st.req._resume = (seq, int(st.req.tokens[-1]))
+                else:
+                    st.req._resume = None   # mid-prefill: just start over
+                with self._cv:
+                    self._queue.appendleft(st.req)
+            self._reset_cache()
+        with self._cv:
+            SERVING_QUEUE_DEPTH.set(len(self._queue))
+        SERVING_SLOT_OCCUPANCY.set(0)
+
+    def _reset_cache(self) -> None:
+        """Fresh zeroed cache buffers + accounting (and a fresh prefix
+        tree — cached prefixes may reference poisoned blocks; dropping
+        the cache costs recompute, never correctness)."""
+        max_len, n_blocks, block_size = self._cache_args
+        if self.paged:
+            self.cache = PagedKVCache(self.cfg, self.n_slots,
+                                      n_blocks=n_blocks,
+                                      block_size=block_size,
+                                      shards=self._shards)
+            if self._mesh is not None:
+                self.cache.kb = self._put_cache(self.cache.kb)
+                self.cache.vb = self._put_cache(self.cache.vb)
+        else:
+            self.cache = KVCache(self.cfg, self.n_slots, max_len)
+            if self._mesh is not None:
+                self.cache.k = self._put_cache(self.cache.k)
+                self.cache.v = self._put_cache(self.cache.v)
+        if self._prefix is not None:
+            self._prefix = RadixPrefixCache(self.cache)
+        if hasattr(self.cache, "update_gauges"):
+            self.cache.update_gauges()
 
     # -- gauges --------------------------------------------------------------
     def _note_ms(self, gauge, attr: str, ms: float) -> None:

@@ -31,6 +31,10 @@ def pytest_configure(config):
         "markers",
         "kernels: Pallas kernel parity suite (interpret mode on CPU) — "
         "select with `pytest -m kernels` after touching ops/ kernels")
+    config.addinivalue_line(
+        "markers",
+        "pod: multi-PROCESS elastic/pod tests (select with `pytest -m "
+        "pod`); tier-1 keeps the threaded single-process simulations")
 
 
 @pytest.fixture(autouse=True)

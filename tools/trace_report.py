@@ -503,8 +503,41 @@ def resilience_report(events: list, rows: list, file=None,
     if gauges:
         out["gauges"] = {k: gauges[k] for k in
                          ("faults_injected", "sentinel_trips", "rollbacks",
-                          "preempt_saves", "watchdog_stalls")
+                          "preempt_saves", "watchdog_stalls",
+                          "elastic_resizes", "pod_hosts_alive",
+                          "serving_watchdog_trips",
+                          "serving_watchdog_restarts")
                          if k in gauges}
+    # pod timeline (ISSUE 12): pod-attached guardians tag their spans
+    # with a host arg — merge them into a per-host event matrix plus an
+    # elastic-resize verdict, so an on-call human sees which host
+    # snapshotted/rolled back/resized when, in ONE view
+    hosts = sorted({(e.get("args") or {}).get("host") for e in res
+                    if (e.get("args") or {}).get("host") is not None})
+    resizes = [e for e in res if e.get("name") == "resilience.resize"]
+    if hosts or resizes:
+        per_host: dict = {h: {} for h in hosts}
+        merged = []
+        for e in sorted(res, key=lambda e: float(e.get("ts", 0))):
+            name = e["name"].split(".", 1)[1]
+            a = e.get("args") or {}
+            h = a.get("host")
+            if h is not None:
+                per_host.setdefault(h, {})
+                per_host[h][name] = per_host[h].get(name, 0) + 1
+            if name in ("rollback", "resize", "pod_agree", "preempt_save"):
+                row = {"t_ms": float(e.get("ts", 0)) / 1e3, "event": name}
+                row.update(a)
+                merged.append(row)
+        if resizes:
+            a = resizes[-1].get("args") or {}
+            rv = (f"resized: lost {a.get('lost')} -> replanned over "
+                  f"{a.get('devices')} device(s), resumed from step "
+                  f"{a.get('step')}")
+        else:
+            rv = "no resize: pod membership stable"
+        out["pod"] = {"hosts": hosts, "per_host": per_host,
+                      "timeline": merged, "resize_verdict": rv}
     # spans are authoritative (scoped to this trace); gauges are process-
     # cumulative, so they only speak when the trace has no spans at all
     src = counts if res else {
@@ -536,6 +569,19 @@ def resilience_report(events: list, rows: list, file=None,
         print(f"  t={entry['t_ms']:>12.3f}ms  {entry['event']}"
               + (f"  {extra}" if extra else ""), file=file)
     print(f"  verdict: {out['verdict']}", file=file)
+    if "pod" in out:
+        pod = out["pod"]
+        print("  Pod timeline:", file=file)
+        for h in pod["hosts"]:
+            ev = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(pod["per_host"][h].items()))
+            print(f"    {h:<10}{ev}", file=file)
+        for row in pod["timeline"]:
+            extra = {k: v for k, v in row.items()
+                     if k not in ("t_ms", "event")}
+            print(f"    t={row['t_ms']:>12.3f}ms  {row['event']}"
+                  + (f"  {extra}" if extra else ""), file=file)
+        print(f"    resize verdict: {pod['resize_verdict']}", file=file)
     return out
 
 
