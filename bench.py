@@ -772,6 +772,149 @@ def bench_serving_load(on_accel):
     return result
 
 
+def bench_serving_chaos(on_accel):
+    """ISSUE 13: serving chaos leg — Poisson load through a 2-replica
+    EngineRouter under injected faults (``replica_crash`` mid-run,
+    ``slow_tick`` latency storms, ``conn_drop``-style abandoned
+    streams) with a shared brownout controller. The acceptance gate:
+
+    - zero healthy-stream token corruption: every stream that COMPLETES
+      is token-identical to the same prompt on a fault-free engine;
+    - no silent drops: every request ends with an explicit
+      finish_reason (deadline sheds included — the 503 material);
+    - bounded first-token tail: p99 first-token latency recorded.
+    """
+    import threading
+
+    import jax.numpy as jnp
+
+    from paddle_tpu import monitor
+    from paddle_tpu.models import gpt_init, gpt_tiny
+    from paddle_tpu.resilience.faults import configure_faults
+    from paddle_tpu.serving import (EngineRouter, InferenceEngine,
+                                    OverloadController)
+
+    cfg = gpt_tiny(seq_len=256,
+                   dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    params = gpt_init(cfg, seed=0)
+    max_new = 16
+    n_req = 20
+    rng = np.random.default_rng(1301)
+    plens = [12, 24, 40, 72]
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            plens[i % len(plens)]).astype(np.int32)
+               for i in range(n_req)]
+    gaps = rng.exponential(1 / 24.0, n_req)    # ~24 rps Poisson
+    # a slice of the offered load carries a tight deadline — under the
+    # injected storm some of it MUST be shed (503 material), loudly
+    tight = {i for i in range(n_req) if i % 5 == 4}
+
+    def make_engine(ctl=None):
+        return InferenceEngine(cfg, params, n_slots=4, paged=True,
+                               block_size=16, n_blocks=65,
+                               prefill_chunk=64, queue_size=4 * n_req,
+                               overload=ctl, seed=0)
+
+    # fault-free reference: the token-corruption oracle
+    ref = make_engine()
+    try:
+        expected = [ref.generate(p, max_new_tokens=max_new)
+                    for p in prompts]
+    finally:
+        ref.shutdown(drain=False)
+
+    ctl = OverloadController(queue_wait_budget_ms=150.0,
+                             tick_budget_ms=120.0, step_up_after=2,
+                             step_down_after=6)
+    shed0 = monitor.stat_get("serving_deadline_sheds")
+    fo0 = monitor.stat_get("router_failovers")
+    configure_faults("replica_crash@step=20:replica=0,"
+                     "slow_tick@step=8:secs=0.15:repeat=3:replica=1,"
+                     "conn_drop@step=3")
+    try:
+        router = EngineRouter([make_engine(ctl), make_engine(ctl)])
+        first_t = [None] * n_req
+        results: list = [None] * n_req
+        finishes: list = [None] * n_req
+        sub_t = [None] * n_req
+
+        def consume(i, req):
+            from paddle_tpu.resilience import faults as _f
+            dropped = _f.FAULTS.take_conn(i + 1) is not None
+            try:
+                it = req.stream(timeout=120)
+                toks = []
+                for n, tok in enumerate(it):
+                    if first_t[i] is None:
+                        first_t[i] = time.perf_counter()
+                    toks.append(tok)
+                    if dropped and n >= 1:
+                        # the abandoning client: stop consuming and
+                        # cancel (the frontend's disconnect path does
+                        # exactly this on reader EOF)
+                        req.cancel()
+                        try:
+                            req.result(timeout=60)   # wait for eviction
+                        except (TimeoutError, RuntimeError):
+                            pass
+                        break
+                results[i] = toks if not dropped else None
+            except (TimeoutError, RuntimeError):
+                results[i] = None
+            finishes[i] = req.finish_reason
+
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            sub_t[i] = time.perf_counter()
+            req = router.submit(
+                prompts[i], max_new_tokens=max_new,
+                deadline_s=0.4 if i in tight else 60.0)
+            th = threading.Thread(target=consume, args=(i, req))
+            th.start()
+            threads.append(th)
+            if gaps[i] > 0:
+                time.sleep(gaps[i])
+        for th in threads:
+            th.join(timeout=300)
+        wall = time.perf_counter() - t0
+        router.shutdown(drain=True, timeout=120)
+    finally:
+        configure_faults("")
+
+    completed = [i for i in range(n_req)
+                 if finishes[i] in ("length", "eos")
+                 and results[i] is not None]
+    corrupt = [i for i in completed if results[i] != expected[i]]
+    shed = [i for i in range(n_req) if finishes[i] == "deadline"]
+    silent = [i for i in range(n_req) if finishes[i] is None]
+    ftl = np.asarray([(first_t[i] - sub_t[i]) * 1e3 for i in range(n_req)
+                      if first_t[i] is not None])
+    identity = 1.0 if completed and not corrupt else 0.0
+    return {
+        "value": identity,
+        "unit": "healthy-stream token-identity under chaos (1.0 = exact)",
+        "completed": len(completed), "corrupt": len(corrupt),
+        "deadline_shed": len(shed), "silent_drops": len(silent),
+        "failovers": monitor.stat_get("router_failovers") - fo0,
+        "engine_deadline_sheds":
+            monitor.stat_get("serving_deadline_sheds") - shed0,
+        "brownout_rung_final": monitor.stat_get("brownout_rung"),
+        "brownout_steps": monitor.stat_get("brownout_steps"),
+        "first_token_ms_p50": round(float(np.percentile(ftl, 50)), 2)
+        if ftl.size else None,
+        "first_token_ms_p99": round(float(np.percentile(ftl, 99)), 2)
+        if ftl.size else None,
+        "wall_s": round(wall, 2),
+        "note": f"{n_req} req x {max_new} tokens at ~24rps Poisson over "
+                "2 paged replicas (shared 64-block pools), faults: "
+                "replica 0 crashes at tick 40, replica 1 eats 3x150ms "
+                "slow ticks, stream 3 abandoned mid-generation; every "
+                "fifth request carries a 0.4s deadline; identity = all "
+                "completed streams token-equal to a fault-free engine",
+    }
+
+
 def bench_serving_spec(on_accel):
     """ISSUE 10/11: speculative-decoding A/B — tokens/s spec vs non-spec
     at three temperatures on gpt_tiny, with the measured draft
@@ -1382,6 +1525,7 @@ def main():
                      ("gpt_tiny_serving", bench_gpt_tiny_serving),
                      ("serving_spec", bench_serving_spec),
                      ("serving_load", bench_serving_load),
+                     ("serving_chaos", bench_serving_chaos),
                      ("resilience", bench_resilience)):
         if over_budget():
             configs[name] = "skipped: time budget (BENCH_TIME_BUDGET)"

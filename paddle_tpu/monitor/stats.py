@@ -209,6 +209,13 @@ DEFAULT_STATS = (
     "elastic_resizes",          # pod resizes (replan+reshard+resume) after host loss
     "serving_watchdog_trips",   # serving sentinel verdicts (NaN tick / latency stall)
     "serving_watchdog_restarts",  # engine restarts from the last healthy state
+    # overload-hardened serving (ISSUE 13)
+    "serving_deadline_sheds",   # requests shed deadline-expired BEFORE any prefill
+    "frontend_load_sheds",      # HTTP requests answered 503 (overload/deadline shed)
+    "brownout_rung",            # gauge: current degradation-ladder rung (0=healthy)
+    "brownout_steps",           # ladder transitions (up or down) taken
+    "router_failovers",         # streams requeued to a survivor replica
+    "serving_replicas_healthy",  # gauge: routable replicas behind the EngineRouter
 )
 
 for _n in DEFAULT_STATS:
@@ -275,6 +282,12 @@ FRONTEND_QUEUE_WAIT_MS = _registry.get_stat("frontend_queue_wait_ms")
 FRONTEND_ACTIVE_STREAMS = _registry.get_stat("frontend_active_streams")
 CONSTRAINED_REQUESTS = _registry.get_stat("constrained_requests")
 CONSTRAINED_FALLBACK_TICKS = _registry.get_stat("constrained_fallback_ticks")
+SERVING_DEADLINE_SHEDS = _registry.get_stat("serving_deadline_sheds")
+FRONTEND_LOAD_SHEDS = _registry.get_stat("frontend_load_sheds")
+BROWNOUT_RUNG = _registry.get_stat("brownout_rung")
+BROWNOUT_STEPS = _registry.get_stat("brownout_steps")
+ROUTER_FAILOVERS = _registry.get_stat("router_failovers")
+SERVING_REPLICAS_HEALTHY = _registry.get_stat("serving_replicas_healthy")
 
 
 # per-mesh-axis device-memory gauges published by the last

@@ -21,7 +21,16 @@ rollback replay of the same step indices runs clean) or ``p=F`` (fires
 per encounter with probability F from a private ``seed``-ed RNG —
 deterministic across runs). Options: ``repeat`` (default 1 for step
 faults, unlimited for p faults), ``secs`` (stall duration), ``seed``,
-``host`` (which simulated host a pod fault hits).
+``host`` (which simulated host a pod fault hits), ``replica`` (which
+EngineRouter replica a serving tick fault hits).
+
+Serving chaos (ISSUE 13) adds three kinds whose "step" counts something
+other than a train step: ``replica_crash`` / ``slow_tick`` fire on an
+engine's SCHEDULER TICK index (per replica), ``conn_drop`` on the front
+end's streaming-connection index::
+
+    FLAGS_fault_inject="replica_crash@step=30:replica=0,slow_tick@step=5:secs=0.2:repeat=3"
+    FLAGS_fault_inject="conn_drop@step=2"
 
 Kinds and their hook points:
 
@@ -41,6 +50,19 @@ kv_partition   FileKVStore raises OSError for ``secs``     resilience/pod.py +
 serving_nan    NaNs one slot's KV rows at the first        serving/engine.py
                decode tick of request id >= N (keyed by
                REQUEST id, not train step)
+replica_crash  serving scheduler raises InjectedCrash at   serving/engine.py
+               engine tick N (``replica=R`` limits it to
+               one EngineRouter replica; keyed by TICK,
+               its own index space per replica)
+slow_tick      ``time.sleep(secs)`` in the scheduler loop  serving/engine.py
+               at tick >= N (``repeat=K`` consecutive
+               ticks; drives the brownout EWMA and the
+               watchdog latency rung)
+conn_drop      the SSE client "vanishes" mid-stream: the   serving/frontend.py
+               front end aborts connection index >= N
+               after its first piece (exercises the
+               disconnect-cancel block-release path);
+               bench chaos consumers claim the same spec
 input_stall    ``time.sleep(secs)`` in the prefetcher      io/prefetch.py
 ckpt_io_error  raises ``OSError`` during checkpoint save   framework/checkpoint.py
 =============  ==========================================  ===============
@@ -80,6 +102,11 @@ _STEP_KINDS = ("nan_grad", "crash", "preempt", "stall", "host_loss",
 # id must never consume a step-keyed budget (or vice versa) when training
 # and serving share a process
 _RID_KINDS = ("serving_nan",)
+# serving-TICK-keyed kinds (per engine replica) and the connection-index
+# kind — each evaluated at a single hook site, so per-spec budgets
+# suffice (no claimed-once index bookkeeping needed)
+_TICK_KINDS = ("replica_crash", "slow_tick")
+_CONN_KINDS = ("conn_drop",)
 
 # monotonic deadline of the currently-injected KV-store partition window
 # (0.0 = none). FileKVStore consults kv_partition_active() on every op.
@@ -105,12 +132,13 @@ class FaultSpec:
     """One parsed fault clause."""
 
     __slots__ = ("kind", "step", "p", "repeat", "secs", "seed", "host",
-                 "remaining", "_rng")
+                 "replica", "remaining", "_rng")
 
     def __init__(self, kind: str, step: Optional[int] = None,
                  p: Optional[float] = None, repeat: Optional[int] = None,
                  secs: float = 1.0, seed: int = 0,
-                 host: Optional[str] = None):
+                 host: Optional[str] = None,
+                 replica: Optional[int] = None):
         if (step is None) == (p is None):
             raise ValueError(
                 f"fault {kind!r} needs exactly one trigger: step=N or p=F")
@@ -121,6 +149,7 @@ class FaultSpec:
         self.step = step
         self.p = p
         self.host = host
+        self.replica = None if replica is None else int(replica)
         # step faults default to firing once; p faults to unlimited
         self.repeat = repeat if repeat is not None else (1 if p is None
                                                         else -1)
@@ -165,7 +194,8 @@ def parse_spec(text: str) -> List[FaultSpec]:
             repeat=int(kw["repeat"]) if "repeat" in kw else None,
             secs=float(kw.get("secs", 1.0)),
             seed=int(kw.get("seed", 0)),
-            host=kw.get("host")))
+            host=kw.get("host"),
+            replica=int(kw["replica"]) if "replica" in kw else None))
     return out
 
 
@@ -259,6 +289,37 @@ class FaultRegistry:
                     f.consume()
                     self._rid_fired[f.kind] = f
         return self._rid_fired.pop(kind, None)
+
+    def take_tick(self, kind: str, replica: Optional[int],
+                  tick: int) -> Optional[FaultSpec]:
+        """Claim a serving-TICK-keyed fault (replica_crash / slow_tick)
+        for one engine replica's scheduler loop. Ticks live in their own
+        per-replica index space; ``replica=R`` in the spec limits the
+        fault to the EngineRouter replica with that id (None in the
+        spec = any replica, first to reach the tick claims it)."""
+        for f in self.faults:
+            if f.kind != kind or f.kind not in _TICK_KINDS or f.spent() \
+                    or f.step is None:
+                continue
+            if f.replica is not None and (replica is None
+                                          or int(replica) != f.replica):
+                continue
+            if tick >= f.step:
+                f.consume()
+                return f
+        return None
+
+    def take_conn(self, index: int) -> Optional[FaultSpec]:
+        """Claim a connection-indexed fault (conn_drop) for the front
+        end's Nth streaming response (its own index space)."""
+        for f in self.faults:
+            if f.kind not in _CONN_KINDS or f.spent():
+                continue
+            if (f.step is not None and index >= f.step) or \
+                    (f.p is not None and f._rng.random() < f.p):
+                f.consume()
+                return f
+        return None
 
     def chance(self, kind: str) -> Optional[FaultSpec]:
         """Per-encounter (p=...) fault draw."""

@@ -56,30 +56,53 @@ Layers, bottom up:
   (``draft=``), multi-chip decode (``mesh=``/``FLAGS_serving_mesh``),
   eviction without draining, deadlines/cancellation, graceful shutdown,
   and the serving_*/prefix_*/constrained_* gauges + trace spans;
+- :mod:`overload` — the brownout degradation ladder (ISSUE 13):
+  :class:`~overload.OverloadController` EWMAs queue wait and decode
+  tick latency against budgets and, with hysteresis, steps healthy →
+  no_spec → small_chunks → capped_tokens → shed_bronze → shed_silver;
+  the engine consults it for speculation/chunking, the front end for
+  per-lane token caps and 503 sheds. No controller attached = pinned
+  bit-identical serving;
+- :mod:`router` — :class:`~router.EngineRouter` fronts N replica
+  engines: least-loaded placement with radix-prefix affinity, health
+  from scheduler liveness + tick-age heartbeat, and on replica death
+  the open healthy streams are ADOPTED by survivors through the
+  preemption-resume contract (token-identical continuations; only
+  watchdog-poisoned requests fail). One replica, no faults = a
+  pass-through pinned token-identical to the bare engine;
 - :mod:`frontend` — the network surface (``python -m
   paddle_tpu.serving.frontend``): a stdlib-asyncio HTTP server with
   OpenAI-style ``/v1/completions`` and ``/v1/chat/completions`` (SSE
-  streaming), ``/v1/models``, and a ``/metrics`` StatRegistry dump;
-  per-tenant API-key auth with token-bucket admission (429 +
-  Retry-After on exhaustion, ``max_streams`` caps) and SLO lanes
-  drained by weighted fair queuing over prefill chunks.
+  streaming), ``/v1/models``, ``/metrics`` (StatRegistry dump), and
+  ``/healthz`` / ``/readyz`` probes; per-tenant API-key auth with
+  token-bucket admission and SLO lanes drained by weighted fair
+  queuing over prefill chunks. The status contract: **429** = the
+  tenant broke its own rate/stream budget; **503 + Retry-After** =
+  the server shed the work (engine queue saturated, ``deadline_s``
+  expired before generation started, brownout shed rung). Deadlines
+  propagate end to end (HTTP admission → WFQ lane → engine admission →
+  response waits), an SSE client that disconnects has its engine
+  request cancelled (slot/blocks/prefix refs released), and
   ``response_format`` compiles to a :mod:`constrained` automaton.
-  ``tools/trace_report.py frontend_report`` turns its spans into a
-  per-tenant queue-wait/throttle/prefix-hit verdict.
+  ``tools/trace_report.py frontend_report`` / ``overload_report`` turn
+  its spans into per-tenant SLO and brownout/replica verdicts.
 
 Escape hatches: ``paddle.set_flags({"FLAGS_serving_jit": 0})`` swaps the
 jitted cache path for an un-jitted full-recompute reference decode;
 ``FLAGS_paged_kv=0`` (default) keeps the fixed-slot cache;
 ``FLAGS_prefix_cache=0`` (default) keeps every prefill cache-cold;
 ``FLAGS_serving_mesh=0`` + ``draft=None`` (defaults) pin the
-single-chip non-speculative engine.
+single-chip non-speculative engine; ``overload=None`` + no router
+(defaults) pin the PR-11 front end bit-identical.
 """
 from .constrained import (ConstraintCursor, TokenConstraint,
                           compile_constraint, compile_regex,
                           schema_to_regex)
 from .engine import GenerationRequest, InferenceEngine, QueueFull
 from .kv_cache import KVCache, PagedKVCache, cache_insert
+from .overload import RUNG_NAMES, OverloadController
 from .prefix_cache import RadixPrefixCache
+from .router import EngineRouter
 from .sampling import sample_tokens, sample_tokens_streams, spec_accept, \
     stream_keys
 from .tokenizer import ByteTokenizer, StreamDetokenizer
@@ -87,6 +110,7 @@ from .tokenizer import ByteTokenizer, StreamDetokenizer
 __all__ = [
     "InferenceEngine", "GenerationRequest", "QueueFull",
     "KVCache", "PagedKVCache", "cache_insert", "RadixPrefixCache",
+    "OverloadController", "RUNG_NAMES", "EngineRouter",
     "sample_tokens", "sample_tokens_streams", "stream_keys", "spec_accept",
     "ByteTokenizer", "StreamDetokenizer",
     "TokenConstraint", "ConstraintCursor", "compile_constraint",

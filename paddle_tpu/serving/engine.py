@@ -105,6 +105,23 @@ the plain one-token program (counted by ``constrained_fallback_ticks``)
 — a draft proposing through an automaton would otherwise get
 unconstrained tokens accepted.
 
+Overload hardening (ISSUE 13): deadlines propagate end to end —
+``submit(deadline_s=...)`` stamps an absolute monotonic deadline, and a
+request that expires while QUEUED is shed at the next tick before any
+prefill is spent on it (``serving_deadline_sheds``; the front end turns
+an empty-handed deadline finish into 503 + Retry-After). An attached
+:class:`~paddle_tpu.serving.overload.OverloadController` (``overload=``)
+gets queue-wait and tick-latency observations and steps the brownout
+ladder: rung 1 drops speculative decode, rung 2 shrinks prefill chunks;
+lane-aware rungs (token caps, sheds) are applied by the front end.
+``overload=None`` (default) is pinned bit-identical. A
+:class:`~paddle_tpu.serving.router.EngineRouter` fronts N replicas:
+``replica_id`` tags this engine's spans and fault specs, ``failover``
+holds the router's adoption hook (stamped onto every request), and
+``adopt_request`` replays another replica's stream here through the
+preemption-resume contract, token-identical because replicas share the
+seed and the request keeps its rid.
+
 Observability: gauges serving_queue_depth / serving_slot_occupancy /
 serving_prefill_ms / serving_decode_ms / serving_tokens_per_s (sliding
 window over the last N ticks) / serving_evictions /
@@ -136,7 +153,7 @@ from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
                           gpt_verify_step, gpt_verify_step_paged)
 from ..monitor.stats import (CONSTRAINED_FALLBACK_TICKS,
                              CONSTRAINED_REQUESTS, FAULTS_INJECTED,
-                             PREFIX_COW_COPIES,
+                             PREFIX_COW_COPIES, SERVING_DEADLINE_SHEDS,
                              SERVING_DECODE_MS, SERVING_EVICTIONS,
                              SERVING_PREEMPTIONS, SERVING_PREFILL_MS,
                              SERVING_QUEUE_DEPTH, SERVING_SHARDS,
@@ -214,6 +231,11 @@ class GenerationRequest:
         # paged-mode preemption: (cached-prefix tokens, last token) to
         # re-prefill from when the request is re-admitted
         self._resume = None
+        # EngineRouter failover hook: called (req, err) when the OWNING
+        # replica dies; True = a survivor adopted this request and the
+        # error must NOT finish it (see router.py)
+        self._failover = None
+        self._t_submit = 0.0              # monotonic enqueue time (queue-wait)
         self._cv = threading.Condition()
 
     # -- scheduler side ------------------------------------------------------
@@ -223,6 +245,16 @@ class GenerationRequest:
             self._cv.notify_all()
 
     def _finish(self, reason: str, error: Optional[BaseException] = None):
+        if reason == ERROR and self._failover is not None:
+            # replica-level death (never a per-request verdict like
+            # watchdog/deadline): offer the stream to the router before
+            # failing it — adoption replays it on a survivor
+            handler, self._failover = self._failover, None
+            try:
+                if handler(self, error):
+                    return          # adopted: a survivor owns this now
+            except BaseException:  # noqa: BLE001 — failover must never mask
+                pass               # the original error; fall through to it
         with self._cv:
             if self.finish_reason is None:
                 self.finish_reason = reason
@@ -393,7 +425,8 @@ class InferenceEngine:
                  block_size: int = 16, n_blocks: Optional[int] = None,
                  prefill_chunk: int = 64, tps_window_ticks: int = 64,
                  draft=None, spec_k: int = 4, mesh=None, tokenizer=None,
-                 prefix_cache: Optional[bool] = None, watchdog=None):
+                 prefix_cache: Optional[bool] = None, watchdog=None,
+                 overload=None, replica_id: Optional[int] = None):
         # per-tick NaN/latency sentinel + auto-restart (off by default;
         # when off the engine's compiled programs are bit-identical to a
         # build without it — the health output is gated at trace time)
@@ -530,6 +563,15 @@ class InferenceEngine:
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1, 2))
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
         SERVING_SHARDS.set(self._shards)
+        # overload-hardening surface (ISSUE 13): the brownout controller
+        # (None = every schedule decision bit-identical to a build
+        # without it), the router-assigned replica identity, the
+        # router-installed failover hook stamped onto each request, and
+        # the scheduler heartbeat behind the router's tick-age health
+        self.overload = overload
+        self.replica_id = replica_id
+        self.failover = None
+        self._last_tick_t = time.monotonic()
         self._thread = threading.Thread(target=self._run,
                                         name="serving-scheduler", daemon=True)
         self._thread.start()
@@ -850,10 +892,63 @@ class InferenceEngine:
             # function of (seed, rid) — batch neighbors can't perturb it
             req.rid = self._rid
             self._rid += 1
+            req._failover = self.failover
+            req._t_submit = time.monotonic()
             self._queue.append(req)
             SERVING_QUEUE_DEPTH.set(len(self._queue))
             self._cv.notify_all()
         return req
+
+    def adopt_request(self, req: GenerationRequest) -> None:
+        """Router failover entry: enqueue a request ANOTHER replica was
+        serving when it died. The preemption-resume contract rebuilds
+        decode state from ``prompt + generated[:-1]`` with the last
+        token restored, and the request KEEPS its rid — with replicas
+        sharing a seed, the continuation is token-identical to the run
+        the dead replica would have produced. Bypasses the queue bound
+        (failover must not drop work a user already holds a handle to)."""
+        with self._cv:
+            self._check_open()
+            if req.tokens:
+                seq = np.concatenate(
+                    [req.prompt, np.asarray(req.tokens[:-1],
+                                            np.int32)]).astype(np.int32)
+                req._resume = (seq, int(req.tokens[-1]))
+            else:
+                req._resume = None      # nothing emitted: just start over
+            req._failover = self.failover
+            req._t_submit = time.monotonic()
+            # keep future rids clear of the adopted one: rid collisions
+            # would alias two requests onto one RNG stream
+            self._rid = max(self._rid, req.rid + 1)
+            self._queue.append(req)
+            SERVING_QUEUE_DEPTH.set(len(self._queue))
+            self._cv.notify_all()
+
+    # -- health surface (EngineRouter / frontend readyz) ---------------------
+    @property
+    def alive(self) -> bool:
+        """Scheduler running and able to make progress."""
+        return self._thread.is_alive() and not self._stop \
+            and self._error is None
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def tick_age(self) -> float:
+        """Seconds since the scheduler last completed a loop iteration
+        (fresh even when idle — the idle wait beats every 50ms)."""
+        with self._cv:
+            return time.monotonic() - self._last_tick_t
+
+    def pool_headroom(self) -> float:
+        """Free fraction of the KV capacity (blocks when paged, slots
+        otherwise) — the /readyz admission-headroom signal."""
+        if self.paged:
+            total = self.cache.n_blocks - self.cache.shards
+            return self.cache.free_blocks_count / max(1, total)
+        return self.cache.free_count / max(1, self.n_slots)
 
     def generate(self, prompt: Sequence[int] = None, **kw) -> List[int]:
         """Blocking convenience wrapper: submit + result."""
@@ -883,6 +978,7 @@ class InferenceEngine:
         try:
             while True:
                 with self._cv:
+                    self._last_tick_t = time.monotonic()
                     busy = bool(self._queue) or any(
                         s is not None for s in self._slots)
                     if self._stop and (not self._drain or not busy):
@@ -891,6 +987,23 @@ class InferenceEngine:
                         self._cv.wait(0.05)
                         continue
                 self._ticks += 1
+                if _faults.ENABLED[0]:
+                    # serving chaos hooks (tick-keyed, per replica):
+                    # slow_tick stalls the scheduler (drives the brownout
+                    # EWMA and the watchdog latency rung), replica_crash
+                    # kills it (drives router failover)
+                    f = _faults.FAULTS.take_tick(
+                        "slow_tick", self.replica_id, self._ticks)
+                    if f is not None:
+                        FAULTS_INJECTED.add()
+                        time.sleep(f.secs)
+                    f = _faults.FAULTS.take_tick(
+                        "replica_crash", self.replica_id, self._ticks)
+                    if f is not None:
+                        FAULTS_INJECTED.add()
+                        raise _faults.InjectedCrash(
+                            f"injected replica crash (replica "
+                            f"{self.replica_id}, tick {self._ticks})")
                 self._admit()
                 if self.paged and native.serving_jit[0]:
                     self._prefill_chunk_tick()
@@ -936,9 +1049,44 @@ class InferenceEngine:
             self._cv.notify_all()
         for s, st in enumerate(self._slots):
             if st is not None:
+                # clear the slot FIRST: a router failover may leave the
+                # request unfinished (adopted by a survivor), and the
+                # _run finally block must not re-finish it as SHUTDOWN
+                self._slots[s] = None
                 st.req._finish(ERROR, err)
         for req in leftovers:
             req._finish(ERROR, err)
+
+    def _shed_expired(self) -> None:
+        """Shed queued work that can no longer finish — deadline-expired
+        or cancelled requests leave the queue at the NEXT tick, before
+        any prefill is spent on them, wherever they sit in line (not
+        just at the head). The front end maps an empty-handed deadline
+        finish to 503 + Retry-After; ``serving_deadline_sheds`` counts
+        the sheds so overload_report can tell shed load from served."""
+        now = time.monotonic()
+        shed: List[GenerationRequest] = []
+        with self._cv:
+            if not self._queue:
+                return
+            keep: collections.deque = collections.deque()
+            for req in self._queue:
+                if req._cancelled or (req.deadline is not None
+                                      and now > req.deadline):
+                    shed.append(req)
+                else:
+                    keep.append(req)
+            if not shed:
+                return
+            self._queue = keep
+            SERVING_QUEUE_DEPTH.set(len(self._queue))
+            self._cv.notify_all()   # wake submitters blocked on full
+        for req in shed:
+            if req._cancelled:
+                req._finish(CANCELLED)
+            else:
+                SERVING_DEADLINE_SHEDS.add(1)
+                req._finish(DEADLINE)
 
     def _admit(self) -> None:
         """Move queued requests into free slots. Fixed mode: prefill-and-
@@ -948,6 +1096,7 @@ class InferenceEngine:
         evictions instead of being rejected; multi-chip admission lands
         in the shard with the most free blocks), then park the prompt on
         the slot for the chunked-prefill tick."""
+        self._shed_expired()
         paged = self.paged and native.serving_jit[0]
         while self.cache.free_count > 0:
             shard = None
@@ -970,8 +1119,13 @@ class InferenceEngine:
                 req._finish(CANCELLED)
                 continue
             if req.deadline is not None and time.monotonic() > req.deadline:
+                # expired while queued: shed BEFORE spending any prefill
+                SERVING_DEADLINE_SHEDS.add(1)
                 req._finish(DEADLINE)
                 continue
+            if self.overload is not None:
+                self.overload.observe_queue_wait(
+                    (time.monotonic() - req._t_submit) * 1e3)
             slot = self.cache.alloc(prefer_shard=shard) if paged \
                 else self.cache.alloc()
             if paged:
@@ -1209,7 +1363,15 @@ class InferenceEngine:
 
     def _prefill_one_chunk(self, slot: int, st: _Slot) -> None:
         pending = st.pending
-        c_true = min(int(pending.size), self.prefill_chunk)
+        chunk_cap = self.prefill_chunk
+        if self.overload is not None:
+            # brownout rung 2: shrink chunks so long prompts yield the
+            # scheduler to open streams more often (re-rounded to the
+            # block size, floored at one block)
+            chunk_cap = max(self.block_size,
+                            (self.overload.prefill_chunk(chunk_cap)
+                             // self.block_size) * self.block_size)
+        c_true = min(int(pending.size), chunk_cap)
         bs = self.block_size
         c_pad = -(-c_true // bs) * bs    # one compile per padded length
         if st.tail_mode:
@@ -1389,6 +1551,8 @@ class InferenceEngine:
         constrained = [s for s in active
                        if self._slots[s].req.constraint is not None]
         use_spec = (self.draft is not None and native.serving_jit[0]
+                    and (self.overload is None
+                         or self.overload.spec_allowed())
                     and all(self._slots[s].length + self.spec_k + 1
                             <= self.max_len for s in active))
         if use_spec and constrained:
@@ -1441,6 +1605,8 @@ class InferenceEngine:
             mask_arg = self._mask_dev
 
         span_args = {"batch": len(active), "tick": self._ticks}
+        if self.replica_id is not None:
+            span_args["replica"] = self.replica_id
         if self._shards > 1:
             span_args["shards"] = self._shards
             span_args["shard_load"] = self._shard_load(active)
@@ -1511,6 +1677,8 @@ class InferenceEngine:
                                                for s in active))
         tick_ms = (time.perf_counter() - t0) * 1e3
         self._note_ms(SERVING_DECODE_MS, "_decode_ms", tick_ms)
+        if self.overload is not None:
+            self.overload.observe_tick(tick_ms)
         if self._watchdog is not None:
             poisoned = [] if health is None else \
                 [s for s in active if not bool(np.asarray(health)[s])]

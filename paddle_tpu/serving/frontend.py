@@ -47,6 +47,30 @@ Structured output: ``response_format`` of ``{"type": "json_schema",
 serving.constrained into a token-mask automaton riding the engine's
 sampling program; the stream ends with ``finish_reason: "stop"`` when
 the match completes and the body is guaranteed-parseable JSON.
+
+Overload hardening (ISSUE 13) — the 429-vs-503 contract: **429** means
+YOUR tenant broke its own admission contract (token bucket, stream cap)
+and other tenants are unaffected; **503** + ``Retry-After`` means the
+SERVER cannot take the work — engine queue saturated, the request's
+``deadline_s`` expired before generation started (in the WFQ lane or in
+the engine queue; ``frontend_load_sheds``), or the brownout ladder
+(serving.overload) reached a shed rung for your lane. Deadlines
+propagate END TO END: ``deadline_s`` in the body starts the clock at
+HTTP admission, WFQ lane wait burns it, the ENGINE gets only the
+remainder, and the response waits (`result`/SSE pumps) use the
+remainder too instead of a hardcoded cap — a request that produced
+tokens before expiring returns them with ``finish_reason "deadline"``
+(or ``"timeout"`` when the wait itself lapsed), never a silent drop.
+A client that DISCONNECTS mid-stream is detected by the read-side EOF
+watcher and its engine request is cancelled, releasing its slot, paged
+blocks and prefix-tree references.
+
+``GET /healthz`` answers liveness (the loop is serving); ``GET
+/readyz`` answers readiness — engine (or >= 1 router replica) alive,
+block-pool headroom > 0, brownout ladder below its shed rungs — with
+the failing checks in the 503 body. Mounting an
+:class:`~paddle_tpu.serving.router.EngineRouter` instead of an engine
+makes every route replica-aware.
 """
 from __future__ import annotations
 
@@ -62,10 +86,12 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..monitor.stats import (FRONTEND_429S, FRONTEND_ACTIVE_STREAMS,
+from ..monitor.stats import (FAULTS_INJECTED, FRONTEND_429S,
+                             FRONTEND_ACTIVE_STREAMS, FRONTEND_LOAD_SHEDS,
                              FRONTEND_QUEUE_WAIT_MS, FRONTEND_REQUESTS,
                              stat_get, stat_snapshot)
 from ..monitor.trace import span
+from ..resilience import faults as _faults
 from .constrained import compile_constraint
 from .engine import QueueFull
 
@@ -181,13 +207,20 @@ class _WfqScheduler:
 class _Job:
     """One admitted generation request waiting in its WFQ lane."""
 
-    __slots__ = ("tenant", "kwargs", "future", "t_enqueued")
+    __slots__ = ("tenant", "kwargs", "future", "t_enqueued", "deadline_t")
 
-    def __init__(self, tenant: Tenant, kwargs: dict, future):
+    def __init__(self, tenant: Tenant, kwargs: dict, future,
+                 deadline_t: Optional[float] = None):
         self.tenant = tenant
         self.kwargs = kwargs
         self.future = future
         self.t_enqueued = time.monotonic()
+        self.deadline_t = deadline_t    # absolute monotonic, or None
+
+
+class _Shed(Exception):
+    """Server-side load shed (503 material): the request expired in the
+    WFQ lane before the engine ever saw it."""
 
 
 class _HttpError(Exception):
@@ -200,7 +233,8 @@ class _HttpError(Exception):
 
 _STATUS_TEXT = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
                 404: "Not Found", 405: "Method Not Allowed",
-                429: "Too Many Requests", 500: "Internal Server Error"}
+                429: "Too Many Requests", 500: "Internal Server Error",
+                503: "Service Unavailable"}
 
 
 class ServingFrontend:
@@ -222,15 +256,25 @@ class ServingFrontend:
     def __init__(self, engine, tenants: Optional[List[Tenant]] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  model_id: str = "paddle-tpu-gpt",
-                 default_max_tokens: int = 64):
+                 default_max_tokens: int = 64,
+                 default_timeout_s: float = 600.0):
         if engine.tokenizer is None:
             raise ValueError("ServingFrontend needs an engine with a "
                              "tokenizer (InferenceEngine(tokenizer=...))")
-        self.engine = engine
+        self.engine = engine            # an InferenceEngine OR EngineRouter
         self.host = host
         self.port = int(port)           # rewritten to the bound port
         self.model_id = model_id
         self.default_max_tokens = int(default_max_tokens)
+        # response-wait cap for requests WITHOUT a deadline_s; requests
+        # with one wait exactly their remaining budget instead
+        self.default_timeout_s = float(default_timeout_s)
+        # the brownout ladder rides in on the engine/router (engine
+        # constructor arg overload=); None = no ladder, no admission
+        # sheds, no token caps — the PR-11 front end exactly
+        self._overload = getattr(engine, "overload", None)
+        self._conn_seq = 0              # streaming-connection index
+        #                                 (the conn_drop fault key)
         tenants = tenants if tenants is not None else [
             Tenant("default", "demo-key")]
         self.tenants: Dict[str, Tenant] = {t.api_key: t for t in tenants}
@@ -310,6 +354,20 @@ class ServingFrontend:
         while True:
             job = await self._wfq.get()
             wait_ms = (time.monotonic() - job.t_enqueued) * 1e3
+            if self._overload is not None:
+                self._overload.observe_queue_wait(wait_ms)
+            if job.deadline_t is not None:
+                remaining = job.deadline_t - time.monotonic()
+                if remaining <= 0:
+                    # expired in the WFQ lane: shed before the engine
+                    # spends anything on it (503 + Retry-After upstream)
+                    if not job.future.done():
+                        job.future.set_exception(_Shed(
+                            "deadline expired while queued "
+                            f"({wait_ms:.0f}ms in lane)"))
+                    continue
+                # the engine gets the REMAINING budget, not a fresh one
+                job.kwargs["deadline_s"] = remaining
             try:
                 req = await loop.run_in_executor(
                     None, lambda: self.engine.submit(**job.kwargs))
@@ -339,13 +397,17 @@ class ServingFrontend:
                 status = await self._models(writer)
             elif path == "/metrics" and method == "GET":
                 status = await self._metrics(writer)
+            elif path == "/healthz" and method == "GET":
+                status = await self._healthz(writer)
+            elif path == "/readyz" and method == "GET":
+                status = await self._readyz(writer)
             elif path in ("/v1/completions", "/v1/chat/completions"):
                 if method != "POST":
                     raise _HttpError(405, "POST required")
                 tenant = self._authenticate(headers)
                 tenant_name, lane = tenant.name, tenant.lane
                 status = await self._generate(
-                    tenant, body, writer,
+                    tenant, body, writer, reader,
                     chat=path == "/v1/chat/completions")
             else:
                 raise _HttpError(404, f"no route {path}")
@@ -447,6 +509,43 @@ class ServingFrontend:
         await writer.drain()
         return 200
 
+    # -- health (k8s-style liveness/readiness probes) ------------------------
+    async def _healthz(self, writer) -> int:
+        """Liveness: the loop answered, the process serves."""
+        await self._send_json(writer, 200, {"status": "ok"})
+        return 200
+
+    def _engine_checks(self) -> dict:
+        e = self.engine
+        checks: dict = {}
+        if hasattr(e, "healthy_replicas"):          # EngineRouter
+            healthy = e.healthy_replicas()
+            checks["engine_alive"] = bool(healthy)
+            checks["replicas"] = {str(k): v for k, v in e.health().items()}
+            heads = [e.engines[i].pool_headroom() for i in healthy]
+            checks["pool_headroom"] = round(max(heads), 4) if heads else 0.0
+        else:
+            checks["engine_alive"] = bool(e.alive)
+            checks["pool_headroom"] = round(e.pool_headroom(), 4)
+        if self._overload is not None:
+            checks["brownout"] = self._overload.snapshot()
+        return checks
+
+    async def _readyz(self, writer) -> int:
+        """Readiness: would a generation request admitted NOW be served?
+        Engine (or at least one router replica) alive, block-pool
+        headroom left, and the brownout ladder below its shed rungs."""
+        checks = self._engine_checks()
+        ready = checks["engine_alive"] and checks["pool_headroom"] > 0.0
+        if self._overload is not None and self._overload.sheds("bronze"):
+            ready = False           # shed rung: stop ADMITTING via the LB
+        status = 200 if ready else 503
+        await self._send_json(
+            writer, status,
+            {"status": "ok" if ready else "unready", "checks": checks},
+            extra=None if ready else {"Retry-After": "2"})
+        return status
+
     # -- generation ----------------------------------------------------------
     def _chat_prompt(self, messages) -> str:
         """Deterministic flattening: the shared system prompt becomes a
@@ -489,12 +588,21 @@ class ServingFrontend:
             raise _HttpError(400, f"bad response_format: {e}")
         raise _HttpError(400, f"unsupported response_format type {kind!r}")
 
-    async def _generate(self, tenant: Tenant, raw: bytes, writer,
+    async def _generate(self, tenant: Tenant, raw: bytes, writer, reader,
                         chat: bool) -> int:
         try:
             body = json.loads(raw.decode("utf-8")) if raw else {}
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             raise _HttpError(400, f"bad JSON body: {e}")
+        # -- brownout shed (503, server-side): checked BEFORE the token
+        # bucket so a shed never burns the tenant's own budget ----------
+        if self._overload is not None and self._overload.sheds(tenant.lane):
+            FRONTEND_LOAD_SHEDS.add(1)
+            raise _HttpError(
+                503, f"overloaded (brownout rung "
+                     f"{self._overload.rung_name}): {tenant.lane} lane "
+                     "admissions are shed",
+                headers={"Retry-After": "2"})
         if chat:
             prompt_ids = self.engine.tokenizer.encode(
                 self._chat_prompt(body.get("messages")))
@@ -522,34 +630,46 @@ class ServingFrontend:
         FRONTEND_REQUESTS.add(1)
         try:
             return await self._generate_admitted(
-                tenant, body, prompt_ids, writer, chat)
+                tenant, body, prompt_ids, writer, reader, chat)
         finally:
             tenant.release_stream()
 
     async def _generate_admitted(self, tenant, body, prompt_ids, writer,
-                                 chat: bool) -> int:
+                                 reader, chat: bool) -> int:
+        max_toks = int(body.get("max_tokens", self.default_max_tokens))
+        if self._overload is not None:
+            # brownout rung 3: non-gold generations are capped — they
+            # finish early instead of holding slots through the storm
+            max_toks = self._overload.cap_max_tokens(tenant.lane, max_toks)
         kwargs = dict(
             prompt=prompt_ids,
-            max_new_tokens=int(body.get("max_tokens",
-                                        self.default_max_tokens)),
+            max_new_tokens=max_toks,
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
             constraint=self._constraint_for(body),
             timeout=60.0)
+        # the deadline clock starts at HTTP admission: WFQ lane wait
+        # burns it, the engine receives only the remainder (dispatcher),
+        # and the response waits below use the remainder too
+        deadline_t = None
         if body.get("deadline_s") is not None:
-            kwargs["deadline_s"] = float(body["deadline_s"])
+            deadline_t = time.monotonic() + float(body["deadline_s"])
         if kwargs["constraint"] is None:
             kwargs["eos_id"] = self.engine.tokenizer.eos_id
         cost = max(1.0, -(-int(prompt_ids.size) // self._chunk))
         fut = asyncio.get_running_loop().create_future()
-        self._wfq.put(tenant.lane, cost, _Job(tenant, kwargs, fut))
+        self._wfq.put(tenant.lane, cost,
+                      _Job(tenant, kwargs, fut, deadline_t=deadline_t))
         try:
             req, wait_ms = await fut
         except QueueFull as e:
-            FRONTEND_429S.add(1)
-            raise _HttpError(429, f"engine queue saturated: {e}",
+            FRONTEND_LOAD_SHEDS.add(1)
+            raise _HttpError(503, f"engine queue saturated: {e}",
                              headers={"Retry-After": "1"})
+        except _Shed as e:
+            FRONTEND_LOAD_SHEDS.add(1)
+            raise _HttpError(503, str(e), headers={"Retry-After": "1"})
         with span("frontend.queue_wait", cat="frontend",
                   args={"tenant": tenant.name, "lane": tenant.lane,
                         "wait_ms": wait_ms,
@@ -559,12 +679,27 @@ class ServingFrontend:
         created = int(datetime.now(timezone.utc).timestamp())
         if body.get("stream"):
             return await self._stream_response(req, writer, rid, created,
-                                               chat)
+                                               chat, reader, deadline_t)
         loop = asyncio.get_running_loop()
-        tokens = await loop.run_in_executor(
-            None, lambda: req.result(timeout=600))
+        finish = None
+        try:
+            tokens = await loop.run_in_executor(
+                None, lambda: req.result(timeout=self._wait_s(deadline_t)))
+        except TimeoutError:
+            # the WAIT lapsed (deadline or default cap): cancel so the
+            # engine releases the slot/blocks, answer with what exists
+            req.cancel()
+            tokens = list(req.tokens)
+            finish = "timeout"
+        finish = finish or req.finish_reason
+        if finish in ("deadline", "timeout") and not tokens:
+            # expired before the first token: a shed, not a result —
+            # 503 + Retry-After, never a silent empty 200
+            FRONTEND_LOAD_SHEDS.add(1)
+            raise _HttpError(503, "deadline exceeded before generation "
+                                  "started", headers={"Retry-After": "1"})
         text = self.engine.tokenizer.decode(tokens, skip_special=True)
-        choice = {"index": 0, "finish_reason": req.finish_reason,
+        choice = {"index": 0, "finish_reason": finish,
                   "logprobs": None}
         if chat:
             choice["message"] = {"role": "assistant", "content": text}
@@ -580,9 +715,30 @@ class ServingFrontend:
                       "total_tokens": int(prompt_ids.size) + len(tokens)}})
         return 200
 
+    def _wait_s(self, deadline_t: Optional[float]) -> float:
+        """Response-wait budget: the request's REMAINING deadline, or
+        the configured default for deadline-less requests."""
+        if deadline_t is None:
+            return self.default_timeout_s
+        return max(1e-3, deadline_t - time.monotonic())
+
+    @staticmethod
+    async def _watch_disconnect(reader) -> None:
+        """Resolves when the CLIENT goes away: EOF or reset on the
+        connection's read side. Any stray pipelined bytes are drained
+        and ignored — SSE clients do not speak mid-stream."""
+        try:
+            while True:
+                data = await reader.read(4096)
+                if not data:
+                    return
+        except ConnectionError:
+            return
+
     # -- SSE streaming -------------------------------------------------------
     async def _stream_response(self, req, writer, rid: str, created: int,
-                               chat: bool) -> int:
+                               chat: bool, reader,
+                               deadline_t: Optional[float] = None) -> int:
         writer.write(self._head(200, {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -591,17 +747,25 @@ class ServingFrontend:
         await writer.drain()
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
+        wait_s = self._wait_s(deadline_t)
 
         def pump():
             """Executor thread: blockingly iterate the token stream and
             hand text pieces to the loop (utf-8-safe via the engine's
             streaming detokenizer)."""
             try:
-                for piece in req.stream_text(timeout=600):
+                try:
+                    for piece in req.stream_text(timeout=wait_s):
+                        loop.call_soon_threadsafe(queue.put_nowait,
+                                                  ("piece", piece))
                     loop.call_soon_threadsafe(queue.put_nowait,
-                                              ("piece", piece))
-                loop.call_soon_threadsafe(queue.put_nowait,
-                                          ("done", req.finish_reason))
+                                              ("done", req.finish_reason))
+                except TimeoutError:
+                    # the wait (deadline remainder) lapsed between
+                    # tokens: cancel and close the stream cleanly
+                    req.cancel()
+                    loop.call_soon_threadsafe(queue.put_nowait,
+                                              ("done", "timeout"))
             except BaseException as e:  # noqa: BLE001 — surface in-stream
                 try:
                     loop.call_soon_threadsafe(queue.put_nowait, ("err", e))
@@ -609,10 +773,30 @@ class ServingFrontend:
                     pass                # loop already closed
 
         task = loop.run_in_executor(None, pump)
+        # disconnect watcher: an SSE client that vanishes must CANCEL
+        # its engine request (slot + paged blocks + prefix refs) instead
+        # of leaving it decoding to nobody
+        eof = asyncio.ensure_future(self._watch_disconnect(reader))
+        # conn_drop chaos spec: the front end aborts this connection
+        # after its first piece — the deterministic stand-in for the
+        # vanished client above
+        self._conn_seq += 1
+        drop = _faults.ENABLED[0] \
+            and _faults.FAULTS.take_conn(self._conn_seq) is not None
+        if drop:
+            FAULTS_INJECTED.add()
+        sent = 0
         obj_type = "chat.completion.chunk" if chat else "text_completion"
         try:
             while True:
-                kind, payload = await queue.get()
+                getter = asyncio.ensure_future(queue.get())
+                done, _ = await asyncio.wait(
+                    {getter, eof}, return_when=asyncio.FIRST_COMPLETED)
+                if eof in done and getter not in done:
+                    getter.cancel()
+                    raise ConnectionResetError("client disconnected "
+                                               "mid-stream")
+                kind, payload = await getter
                 if kind == "piece":
                     if chat:
                         choice = {"index": 0, "finish_reason": None,
@@ -623,6 +807,10 @@ class ServingFrontend:
                     await self._sse(writer, {
                         "id": rid, "object": obj_type, "created": created,
                         "model": self.model_id, "choices": [choice]})
+                    sent += 1
+                    if drop and sent >= 1:
+                        writer.transport.abort()
+                        raise ConnectionResetError("injected conn_drop")
                 elif kind == "done":
                     choice = {"index": 0, "finish_reason": payload}
                     if chat:
@@ -641,8 +829,11 @@ class ServingFrontend:
             writer.write(b"0\r\n\r\n")      # chunked terminator
             await writer.drain()
         except ConnectionError:
+            # client is gone: cancel so the engine evicts the stream and
+            # returns its slot, paged blocks and prefix-tree references
             req.cancel()
         finally:
+            eof.cancel()
             if not task.done():
                 await asyncio.wait([task])
         return 200

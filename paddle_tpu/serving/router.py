@@ -1,0 +1,302 @@
+"""Replicated-engine router with failover (ISSUE 13).
+
+One :class:`~paddle_tpu.serving.engine.InferenceEngine` is one failure
+domain: a poisoned batch, a wedged scheduler or an exhausted watchdog
+budget takes every open stream with it. :class:`EngineRouter` fronts N
+replicas (same config, same params, same ``seed`` — that sameness is
+what makes failover exact) and gives the traffic layer one ``submit``
+surface with three behaviors a single engine cannot offer:
+
+**Placement** — each request routes to the replica with the longest
+cached RADIX PREFIX match for its prompt (a shared system prompt keeps
+landing where its blocks already live, so the PR-11 prefix cache keeps
+paying across replicas), falling back to least-loaded (queue depth +
+slot occupancy) when no replica holds a match. The router tracks prefix
+residency in its own block-aligned LRU map, updated as it routes — a
+thread-safe mirror of where each prefix was prefilled — rather than
+walking the engines' radix trees from outside their scheduler threads
+(those structures are scheduler-owned; reading them cross-thread would
+be the exact GL003 race the linter exists to catch).
+
+**Health** — a replica is routable while its scheduler thread is alive,
+not shut down, not crash-errored (the watchdog's restart-budget
+exhaustion lands here), and its TICK-AGE heartbeat is fresh: an engine
+with open work whose scheduler has not completed a loop iteration
+within ``tick_age_budget_s`` is wedged and stops receiving NEW work
+(its open streams are left to its own watchdog/deadline machinery — a
+stall is not proof of death, and double-serving a stream would be
+worse than waiting).
+
+**Failover** — when a replica's scheduler DIES (crash, injected
+``replica_crash``, watchdog budget exhaustion), every open request it
+would have failed with ``error`` is intercepted via the request's
+failover hook and ADOPTED by a survivor through the PR-7/12
+preemption-resume contract: re-prefill ``prompt + generated[:-1]``,
+restore the last token, continue. The request id (= its RNG stream
+identity) and the shared seed ride along, so the survivor's
+continuation is TOKEN-IDENTICAL to the run the dead replica would have
+produced — greedy and sampled both. Only requests the watchdog already
+marked poisoned (finish_reason ``"watchdog"``) fail; a replica-level
+death never silently drops a healthy stream. ``router_failovers``
+counts adoptions, ``serving_replicas_healthy`` tracks the routable set,
+and a ``router.replica_down`` zero-duration span records each death for
+``tools/trace_report.py overload_report``.
+
+The router is a CLIENT of its engines — it owns no device state and no
+thread; health is evaluated at submit time and failover runs on the
+dying replica's scheduler thread as its last useful act. With one
+replica and no faults the router is a pass-through: output is pinned
+token-identical to calling the engine directly.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..monitor.stats import ROUTER_FAILOVERS, SERVING_REPLICAS_HEALTHY
+from ..monitor.trace import TRACING, get_writer
+
+__all__ = ["EngineRouter"]
+
+
+class EngineRouter:
+    """Route ``submit`` calls across replica InferenceEngines.
+
+    ::
+
+        ctl = OverloadController()                    # optional, shared
+        engines = [InferenceEngine(cfg, params, seed=0, overload=ctl)
+                   for _ in range(2)]
+        router = EngineRouter(engines)
+        req = router.submit(prompt, max_new_tokens=64)
+        req.result()        # survives a replica crash mid-generation
+
+    Replicas must share vocabulary, tokenizer surface and sampling seed
+    (identical constructor args is the supported shape). The router
+    re-assigns ``replica_id`` 0..N-1 — trace spans and fault specs
+    (``replica_crash@step=N:replica=R``) use these ids.
+
+    ``tick_age_budget_s``: how stale a BUSY replica's scheduler
+    heartbeat may grow before the router stops routing new work to it.
+
+    The front end mounts a router exactly like an engine
+    (``ServingFrontend(router)``) — tokenizer / config / prefill-chunk /
+    overload are proxied from the replicas, and ``/readyz`` degrades to
+    "any healthy replica".
+    """
+
+    def __init__(self, engines, tick_age_budget_s: float = 5.0,
+                 affinity_entries: int = 4096):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("EngineRouter needs at least one engine")
+        v0 = engines[0].cfg.vocab_size
+        for e in engines[1:]:
+            if e.cfg.vocab_size != v0:
+                raise ValueError(
+                    "replica configs diverge (vocab "
+                    f"{e.cfg.vocab_size} != {v0}) — replicas must serve "
+                    "one model")
+        self.engines: List = engines
+        self.tick_age_budget_s = float(tick_age_budget_s)
+        self._lock = threading.Lock()
+        self._dead: set = set()
+        # block-aligned prefix -> replica LRU map (see module docstring);
+        # affinity only matters when some replica actually caches prefixes
+        self._aff_block = None
+        for e in engines:
+            if getattr(e, "_prefix", None) is not None:
+                self._aff_block = int(e.block_size)
+                break
+        self._affinity: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._aff_cap = int(affinity_entries)
+        for i, e in enumerate(engines):
+            e.replica_id = i
+            e.failover = self._failover_hook(i)
+        SERVING_REPLICAS_HEALTHY.set(len(self.healthy_replicas()))
+
+    # -- frontend-facing proxies --------------------------------------------
+    @property
+    def tokenizer(self):
+        return self.engines[0].tokenizer
+
+    @property
+    def cfg(self):
+        return self.engines[0].cfg
+
+    @property
+    def prefill_chunk(self):
+        return self.engines[0].prefill_chunk
+
+    @property
+    def overload(self):
+        return self.engines[0].overload
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(e.queue_depth for e in self.engines)
+
+    @property
+    def occupancy(self) -> int:
+        return sum(e.occupancy for e in self.engines)
+
+    # -- health --------------------------------------------------------------
+    def healthy_replicas(self) -> List[int]:
+        """Replica ids the router will place NEW work on."""
+        out = []
+        for i, e in enumerate(self.engines):
+            if i in self._dead or not e.alive:
+                continue
+            if e.busy and e.tick_age() > self.tick_age_budget_s:
+                continue            # wedged: alive but not ticking
+            out.append(i)
+        return out
+
+    def health(self) -> Dict[int, dict]:
+        """Per-replica health view (the /readyz payload)."""
+        now_healthy = set(self.healthy_replicas())
+        out = {}
+        for i, e in enumerate(self.engines):
+            out[i] = {
+                "alive": bool(e.alive), "routable": i in now_healthy,
+                "failed_over": i in self._dead,
+                "tick_age_s": round(e.tick_age(), 3),
+                "queue_depth": int(e.queue_depth),
+                "occupancy": int(e.occupancy),
+                "pool_headroom": round(e.pool_headroom(), 4),
+            }
+        return out
+
+    # -- placement -----------------------------------------------------------
+    def _load(self, replica: int) -> int:
+        e = self.engines[replica]
+        return int(e.queue_depth) + int(e.occupancy)
+
+    def _affinity_match(self, ids: np.ndarray, healthy) -> Optional[tuple]:
+        """Longest block-aligned routed prefix of ``ids`` held by a
+        healthy replica -> (replica, matched_tokens)."""
+        if self._aff_block is None:
+            return None
+        B = self._aff_block
+        healthy = set(healthy)
+        with self._lock:
+            for n in range(min(ids.size // B, 64), 0, -1):
+                key = ids[:n * B].tobytes()
+                rep = self._affinity.get(key)
+                if rep is not None and rep in healthy:
+                    self._affinity.move_to_end(key)
+                    return rep, n * B
+        return None
+
+    def _affinity_note(self, ids: np.ndarray, replica: int) -> None:
+        if self._aff_block is None \
+                or getattr(self.engines[replica], "_prefix", None) is None:
+            return
+        B = self._aff_block
+        with self._lock:
+            for n in range(1, min(ids.size // B, 64) + 1):
+                self._affinity[ids[:n * B].tobytes()] = replica
+                self._affinity.move_to_end(ids[:n * B].tobytes())
+            while len(self._affinity) > self._aff_cap:
+                self._affinity.popitem(last=False)
+
+    def _purge_affinity(self, replica: int) -> None:
+        # lock held by caller
+        stale = [k for k, r in self._affinity.items() if r == replica]
+        for k in stale:
+            del self._affinity[k]
+
+    def place(self, prompt) -> Optional[int]:
+        """Replica for this prompt: longest cached prefix match first,
+        least-loaded otherwise. None = no healthy replica."""
+        healthy = self.healthy_replicas()
+        if not healthy:
+            return None
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        hit = self._affinity_match(ids, healthy)
+        if hit is not None:
+            return hit[0]
+        return min(healthy, key=self._load)
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt=None, text: Optional[str] = None, **kw):
+        """Route and submit; returns the engine's GenerationRequest.
+
+        Accepts the full ``InferenceEngine.submit`` surface. ``text`` is
+        encoded HERE (one tokenizer, shared by contract) so placement
+        sees token ids."""
+        if text is not None:
+            if prompt is not None:
+                raise ValueError("pass prompt OR text, not both")
+            if self.tokenizer is None:
+                raise ValueError("submit(text=...) needs engines built "
+                                 "with a tokenizer")
+            prompt = self.tokenizer.encode(text)
+            if kw.get("eos_id") is None:
+                kw["eos_id"] = self.tokenizer.eos_id
+        if prompt is None:
+            raise ValueError("provide a prompt (token ids) or text")
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        replica = self.place(ids)
+        if replica is None:
+            raise RuntimeError("EngineRouter: no healthy replica "
+                               f"(of {len(self.engines)})")
+        req = self.engines[replica].submit(prompt=ids, **kw)
+        req._replica = replica          # where it lives (failover moves it)
+        self._affinity_note(ids, replica)
+        return req
+
+    def generate(self, prompt=None, **kw):
+        """Blocking convenience wrapper: submit + result."""
+        return self.submit(prompt, **kw).result()
+
+    # -- failover ------------------------------------------------------------
+    def _failover_hook(self, replica: int):
+        def hook(req, err) -> bool:
+            return self._replica_failed(replica, req, err)
+        return hook
+
+    def _replica_failed(self, replica: int, req, err) -> bool:
+        """Runs on the DYING replica's scheduler thread, once per open
+        request it is failing. True = the request was adopted by a
+        survivor (the caller must not finish it)."""
+        with self._lock:
+            first = replica not in self._dead
+            if first:
+                self._dead.add(replica)
+                self._purge_affinity(replica)
+        if first:
+            SERVING_REPLICAS_HEALTHY.set(len(self.healthy_replicas()))
+            if TRACING[0]:
+                get_writer().add_complete(
+                    "router.replica_down", time.perf_counter(), 0.0,
+                    cat="serving",
+                    args={"replica": replica,
+                          "error": f"{type(err).__name__}: {err}"
+                          if err is not None else None})
+        survivors = self.healthy_replicas()
+        if not survivors:
+            return False        # nobody left: the error goes through
+        target = min(survivors, key=self._load)
+        try:
+            self.engines[target].adopt_request(req)
+        except RuntimeError:
+            return False        # survivor died in the window: fail loudly
+        req._replica = target
+        ROUTER_FAILOVERS.add(1)
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        for e in self.engines:
+            e.shutdown(drain=drain, timeout=timeout)
+
+    def __repr__(self):
+        return (f"EngineRouter(replicas={len(self.engines)}, "
+                f"healthy={self.healthy_replicas()})")

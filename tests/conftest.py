@@ -35,6 +35,12 @@ def pytest_configure(config):
         "markers",
         "pod: multi-PROCESS elastic/pod tests (select with `pytest -m "
         "pod`); tier-1 keeps the threaded single-process simulations")
+    config.addinivalue_line(
+        "markers",
+        "chaos: serving chaos-harness tests (fault-injected router/"
+        "brownout runs; select with `pytest -m chaos` after touching "
+        "serving overload paths — tier-1 keeps the fast deterministic "
+        "ones)")
 
 
 @pytest.fixture(autouse=True)

@@ -475,6 +475,85 @@ def frontend_report(events: list, file=None) -> dict:
     return out
 
 
+def overload_report(events: list, file=None) -> dict:
+    """Overload/brownout verdict (ISSUE 13).
+
+    Three sources: ``serving.brownout_step`` zero-duration spans from
+    the OverloadController (args: rung, rung_name, from, pressure) give
+    the RUNG TIMELINE; ``frontend.request`` spans with status 503 plus
+    the shed counters give the LOAD SHED view; ``serving.decode_step``
+    spans carrying a ``replica`` arg plus ``router.replica_down`` spans
+    give the PER-REPLICA health verdict (ticks served, died-or-healthy,
+    streams failed over). An on-call human reads one question off it:
+    did the ladder absorb the storm, and did anything get dropped
+    silently (it must never be — sheds are 503s, deaths are failovers)."""
+    steps = [e for e in events if e.get("name") == "serving.brownout_step"]
+    downs = [e for e in events if e.get("name") == "router.replica_down"]
+    decodes = [e for e in events if e.get("name") == "serving.decode_step"
+               and (e.get("args") or {}).get("replica") is not None]
+    sheds_503 = sum(1 for e in events
+                    if e.get("name") == "frontend.request"
+                    and int((e.get("args") or {}).get("status", 0)) == 503)
+    if not steps and not downs and not decodes and not sheds_503:
+        return {}
+    timeline = []
+    max_rung = 0
+    for e in sorted(steps, key=lambda e: float(e.get("ts", 0))):
+        a = e.get("args") or {}
+        rung = int(a.get("rung", 0))
+        max_rung = max(max_rung, rung)
+        timeline.append({"t_ms": float(e.get("ts", 0)) / 1e3,
+                         "rung": rung,
+                         "rung_name": a.get("rung_name", "?"),
+                         "from": a.get("from"),
+                         "pressure": a.get("pressure")})
+    final_rung = timeline[-1]["rung"] if timeline else 0
+    replicas: dict = {}
+    for e in decodes:
+        rep = int(e["args"]["replica"])
+        replicas.setdefault(rep, {"ticks": 0, "died": False,
+                                  "failed_over_streams": 0})
+        replicas[rep]["ticks"] += 1
+    for e in downs:
+        a = e.get("args") or {}
+        rep = int(a.get("replica", -1))
+        replicas.setdefault(rep, {"ticks": 0, "died": False,
+                                  "failed_over_streams": 0})
+        replicas[rep]["died"] = True
+    out = {"rung_timeline": timeline, "max_rung": max_rung,
+           "final_rung": final_rung, "sheds_503": sheds_503,
+           "replicas": {str(k): v for k, v in sorted(replicas.items())},
+           "replica_deaths": len(downs)}
+    bits = []
+    if timeline:
+        tail = "still there" if final_rung == max_rung \
+            else f"recovered to {final_rung}"
+        bits.append(f"ladder climbed to rung {max_rung}, {tail}")
+    else:
+        bits.append("ladder never stepped")
+    bits.append(f"{sheds_503} request(s) shed with 503+Retry-After"
+                if sheds_503 else "no load shed")
+    if replicas:
+        dead = sorted(r for r, v in replicas.items() if v["died"])
+        if dead:
+            bits.append(f"replica(s) {dead} died — open streams failed "
+                        "over to survivors")
+        else:
+            bits.append(f"{len(replicas)} replica(s) healthy")
+    out["verdict"] = "; ".join(bits)
+    print("\nOverload:", file=file)
+    for row in timeline:
+        print(f"  t={row['t_ms']:>12.3f}ms  rung {row['from']}->"
+              f"{row['rung']} ({row['rung_name']}) "
+              f"pressure={row['pressure']}", file=file)
+    for rep, v in sorted(replicas.items()):
+        state = "DIED" if v["died"] else "healthy"
+        print(f"  replica {rep:<4}{state:<10}ticks={v['ticks']}", file=file)
+    print(f"  sheds_503: {sheds_503}", file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
 def resilience_report(events: list, rows: list, file=None,
                       gauges: dict | None = None) -> dict:
     """Self-healing verdict from the resilience spans (ISSUE 5).
@@ -614,6 +693,7 @@ def main(argv=None):
     spec_report(events)
     shard_balance_report(events)
     frontend_report(events)
+    overload_report(events)
     resilience_report(events, rows)
     recompile_report(events)
     pipeline_report(events)
