@@ -772,6 +772,158 @@ def bench_serving_load(on_accel):
     return result
 
 
+def _serving_chaos_lifecycle_leg(cfg, params, rng):
+    """ISSUE 14: the lifecycle leg of serving_chaos — Poisson load over
+    a 2-replica prefix-caching router WITH a ReplicaSupervisor, under
+    ``replica_crash`` + ``spawn_fail``. Gates: identity 1.0, >= 1
+    successful restart-rejoin (through the backoff ladder — the first
+    respawn attempt is made to fail), >= 1 scale-up/scale-down cycle
+    (a slow_tick storm steps the brownout rung, recovery steps it
+    back), and the rejoined replica's first token served WARM (radix
+    re-warm replay) vs a cold engine's."""
+    import threading
+
+    from paddle_tpu import monitor
+    from paddle_tpu.resilience.faults import configure_faults
+    from paddle_tpu.serving import (EngineRouter, InferenceEngine,
+                                    OverloadController, ReplicaSupervisor)
+
+    max_new = 12
+    n_req = 16
+    head = rng.integers(0, cfg.vocab_size, 64).astype(np.int32)
+    tails = [np.concatenate(
+        [head, rng.integers(0, cfg.vocab_size, 8).astype(np.int32)])
+        for _ in range(n_req)]
+    gaps = rng.exponential(1 / 24.0, n_req)
+
+    ctl = OverloadController(queue_wait_budget_ms=150.0,
+                             tick_budget_ms=60.0, step_up_after=2,
+                             step_down_after=4)
+
+    def make_engine():
+        return InferenceEngine(cfg, params, n_slots=4, paged=True,
+                               block_size=16, n_blocks=129,
+                               prefill_chunk=64, queue_size=4 * n_req,
+                               prefix_cache=True, overload=ctl, seed=0)
+
+    # fault-free oracle + the COLD first-token sample (empty radix tree:
+    # the full shared head prefills before the first token)
+    ref = make_engine()
+    try:
+        t0 = time.perf_counter()
+        it = ref.submit(tails[0], max_new_tokens=max_new).stream(timeout=120)
+        next(it)
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        for _ in it:
+            pass
+        expected = [ref.generate(t, max_new_tokens=max_new) for t in tails]
+    finally:
+        ref.shutdown(drain=False)
+
+    rs0 = monitor.stat_get("serving_replica_restarts")
+    sc0 = monitor.stat_get("serving_scale_events")
+    warm0 = monitor.stat_get("prefix_warm_tokens")
+    # replica 0 crashes early (first respawn attempt spawn-fails, the
+    # ladder's backoff rung recovers it); replica 1 then eats a slow-tick
+    # storm that steps the brownout rung and triggers scale-up
+    configure_faults("replica_crash@step=12:replica=0,"
+                     "spawn_fail@restart=1:times=1,"
+                     "slow_tick@step=40:secs=0.12:repeat=3:replica=1")
+    results: list = [None] * n_req
+    try:
+        router = EngineRouter([make_engine(), make_engine()])
+        sup = ReplicaSupervisor(
+            router, make_engine, min_replicas=2, max_replicas=3,
+            poll_s=0.05, backoff_s=0.1, quarantine_s=1.0, stable_s=1.0,
+            scale_up_rung=1, scale_up_after=2, scale_down_after=6,
+            scale_down_occupancy=0.3, scale_cooldown_s=0.5,
+            drain_timeout_s=2.0)
+
+        def consume(i, req):
+            try:
+                results[i] = req.result(timeout=180)
+            except RuntimeError:
+                results[i] = None
+
+        threads = []
+        for i in range(n_req):
+            req = router.submit(tails[i], max_new_tokens=max_new)
+            th = threading.Thread(target=consume, args=(i, req))
+            th.start()
+            threads.append(th)
+            if gaps[i] > 0:
+                time.sleep(gaps[i])
+        for th in threads:
+            th.join(timeout=300)
+
+        # wait out the rejoin (and any in-flight scale-up)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            snap = sup.snapshot()
+            if snap["rejoins"] >= 1 and all(
+                    r["state"] == "live"
+                    for r in snap["replicas"].values()):
+                break
+            time.sleep(0.05)
+        # recovery trickle: fast ticks walk the rung back to 0 (the
+        # storm's queue-wait EWMA starts seconds over budget, and each
+        # rung needs step_down_after consecutive cool samples), then an
+        # idle fleet at rung 0 drains the scale-up replica back out
+        for _ in range(120):
+            router.generate(tails[0][:16], max_new_tokens=1)
+            if ctl.rung == 0:
+                break
+        t0 = time.monotonic()
+        while router.n_replicas > 2 and time.monotonic() - t0 < 60:
+            time.sleep(0.05)
+
+        # WARM first-token p50 on the re-warmed fleet (affinity routes
+        # the shared head to a replica whose radix tree holds it)
+        warm_samples = []
+        for _ in range(5):
+            t_new = np.concatenate(
+                [head, rng.integers(0, cfg.vocab_size, 8).astype(np.int32)])
+            t0 = time.perf_counter()
+            it = router.submit(t_new, max_new_tokens=2).stream(timeout=120)
+            next(it)
+            warm_samples.append((time.perf_counter() - t0) * 1e3)
+            for _ in it:
+                pass
+        snap = sup.snapshot()
+        n_final = router.n_replicas
+        router.shutdown(drain=True, timeout=120)
+    finally:
+        configure_faults("")
+
+    completed = [i for i in range(n_req) if results[i] is not None]
+    corrupt = [i for i in completed if results[i] != expected[i]]
+    warm_p50 = float(np.percentile(np.asarray(warm_samples), 50))
+    return {
+        "identity": 1.0 if completed and not corrupt else 0.0,
+        "completed": len(completed), "corrupt": len(corrupt),
+        "restarts": monitor.stat_get("serving_replica_restarts") - rs0,
+        "rejoins": snap["rejoins"],
+        "scale_events": monitor.stat_get("serving_scale_events") - sc0,
+        "scale_ups": snap["scale_ups"],
+        "scale_downs_completed": snap["scale_downs"],
+        "replicas_final": n_final,
+        "warm_tokens_replayed":
+            monitor.stat_get("prefix_warm_tokens") - warm0,
+        "first_token_cold_ms": round(cold_ms, 2),
+        "first_token_warm_p50_ms": round(warm_p50, 2),
+        "warm_vs_cold": round(warm_p50 / cold_ms, 3) if cold_ms else None,
+        "note": f"{n_req} shared-prefix req over 2 prefix-caching "
+                "replicas + supervisor; replica 0 crashes at tick 12 "
+                "(first respawn spawn-fails -> backoff rung), replica 1 "
+                "eats a 3x120ms slow-tick storm (rung climbs -> scale-up "
+                "to 3), recovery trickle walks the rung down (drain-"
+                "shrink back to 2); identity = all completed streams "
+                "token-equal to a fault-free engine; warm = first-token "
+                "p50 after the radix re-warm vs the cold full-head "
+                "prefill",
+    }
+
+
 def bench_serving_chaos(on_accel):
     """ISSUE 13: serving chaos leg — Poisson load through a 2-replica
     EngineRouter under injected faults (``replica_crash`` mid-run,
@@ -783,6 +935,12 @@ def bench_serving_chaos(on_accel):
     - no silent drops: every request ends with an explicit
       finish_reason (deadline sheds included — the 503 material);
     - bounded first-token tail: p99 first-token latency recorded.
+
+    The ISSUE-14 lifecycle leg (``_serving_chaos_lifecycle_leg``) then
+    adds a ReplicaSupervisor: restart-rejoin through the backoff ladder
+    under ``spawn_fail``, a brownout-driven scale-up/scale-down cycle,
+    and the warm-vs-cold first-token comparison for the re-warmed
+    radix tree — the top-level ``value`` gates BOTH legs' identity.
     """
     import threading
 
@@ -891,8 +1049,11 @@ def bench_serving_chaos(on_accel):
     ftl = np.asarray([(first_t[i] - sub_t[i]) * 1e3 for i in range(n_req)
                       if first_t[i] is not None])
     identity = 1.0 if completed and not corrupt else 0.0
+    lifecycle = _serving_chaos_lifecycle_leg(cfg, params, rng)
     return {
-        "value": identity,
+        "value": min(identity, lifecycle["identity"]),
+        "overload_leg_identity": identity,
+        "lifecycle": lifecycle,
         "unit": "healthy-stream token-identity under chaos (1.0 = exact)",
         "completed": len(completed), "corrupt": len(corrupt),
         "deadline_shed": len(shed), "silent_drops": len(silent),

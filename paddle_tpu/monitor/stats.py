@@ -216,6 +216,11 @@ DEFAULT_STATS = (
     "brownout_steps",           # ladder transitions (up or down) taken
     "router_failovers",         # streams requeued to a survivor replica
     "serving_replicas_healthy",  # gauge: routable replicas behind the EngineRouter
+    # elastic replica lifecycle (ISSUE 14)
+    "serving_replicas_target",   # gauge: replica count the supervisor steers toward
+    "serving_replica_restarts",  # replicas respawned after death/wedge/watchdog abort
+    "serving_scale_events",      # autoscale transitions (grow or drain-shrink) completed
+    "prefix_warm_tokens",        # prompt tokens replayed to re-warm a rejoined radix tree
 )
 
 for _n in DEFAULT_STATS:
@@ -288,6 +293,10 @@ BROWNOUT_RUNG = _registry.get_stat("brownout_rung")
 BROWNOUT_STEPS = _registry.get_stat("brownout_steps")
 ROUTER_FAILOVERS = _registry.get_stat("router_failovers")
 SERVING_REPLICAS_HEALTHY = _registry.get_stat("serving_replicas_healthy")
+SERVING_REPLICAS_TARGET = _registry.get_stat("serving_replicas_target")
+SERVING_REPLICA_RESTARTS = _registry.get_stat("serving_replica_restarts")
+SERVING_SCALE_EVENTS = _registry.get_stat("serving_scale_events")
+PREFIX_WARM_TOKENS = _registry.get_stat("prefix_warm_tokens")
 
 
 # per-mesh-axis device-memory gauges published by the last

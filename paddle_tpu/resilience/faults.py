@@ -32,6 +32,18 @@ end's streaming-connection index::
     FLAGS_fault_inject="replica_crash@step=30:replica=0,slow_tick@step=5:secs=0.2:repeat=3"
     FLAGS_fault_inject="conn_drop@step=2"
 
+Lifecycle chaos (ISSUE 14) adds two kinds keyed by the
+:class:`~paddle_tpu.serving.lifecycle.ReplicaSupervisor`'s OWN
+``restart=`` index spaces (spawn attempts / rejoins — never a train
+step, so training fault replay stays clean): ``spawn_fail`` makes the
+engine factory raise on the Nth respawn (exercising the
+backoff→quarantine ladder), ``replica_flap`` crashes a replica at its
+next busy scheduler tick after each of ``times`` rejoins starting at
+the Nth (the flapping replica that drives the quarantine rung)::
+
+    FLAGS_fault_inject="spawn_fail@restart=1:times=2"
+    FLAGS_fault_inject="replica_flap@restart=1:times=3"
+
 Kinds and their hook points:
 
 =============  ==========================================  ===============
@@ -63,6 +75,14 @@ conn_drop      the SSE client "vanishes" mid-stream: the   serving/frontend.py
                after its first piece (exercises the
                disconnect-cancel block-release path);
                bench chaos consumers claim the same spec
+spawn_fail     the supervisor's engine factory raises      serving/lifecycle.py
+               InjectedCrash on spawn attempt >= N
+               (``times=K`` attempts; keyed by the
+               supervisor's RESTART index, its own space)
+replica_flap   the freshly-rejoined replica crashes at     serving/lifecycle.py
+               its next busy tick after rejoin index >= N
+               (``times=K`` rejoins; the flapping-replica
+               chaos that drives the quarantine ladder)
 input_stall    ``time.sleep(secs)`` in the prefetcher      io/prefetch.py
 ckpt_io_error  raises ``OSError`` during checkpoint save   framework/checkpoint.py
 =============  ==========================================  ===============
@@ -107,6 +127,11 @@ _RID_KINDS = ("serving_nan",)
 # suffice (no claimed-once index bookkeeping needed)
 _TICK_KINDS = ("replica_crash", "slow_tick")
 _CONN_KINDS = ("conn_drop",)
+# supervisor-RESTART-keyed kinds (serving/lifecycle.py): spawn_fail fires
+# on the supervisor's spawn-attempt index, replica_flap on its rejoin
+# index — both counters the supervisor owns, so lifecycle chaos never
+# consumes a train-step budget and rollback replay stays clean
+_RESTART_KINDS = ("spawn_fail", "replica_flap")
 
 # monotonic deadline of the currently-injected KV-store partition window
 # (0.0 = none). FileKVStore consults kv_partition_active() on every op.
@@ -131,23 +156,34 @@ class InjectedCrash(RuntimeError):
 class FaultSpec:
     """One parsed fault clause."""
 
-    __slots__ = ("kind", "step", "p", "repeat", "secs", "seed", "host",
-                 "replica", "remaining", "_rng")
+    __slots__ = ("kind", "step", "p", "restart", "repeat", "secs", "seed",
+                 "host", "replica", "remaining", "_rng")
 
     def __init__(self, kind: str, step: Optional[int] = None,
                  p: Optional[float] = None, repeat: Optional[int] = None,
                  secs: float = 1.0, seed: int = 0,
                  host: Optional[str] = None,
-                 replica: Optional[int] = None):
-        if (step is None) == (p is None):
+                 replica: Optional[int] = None,
+                 restart: Optional[int] = None):
+        triggers = sum(t is not None for t in (step, p, restart))
+        if triggers != 1:
             raise ValueError(
-                f"fault {kind!r} needs exactly one trigger: step=N or p=F")
+                f"fault {kind!r} needs exactly one trigger: step=N, p=F or "
+                "restart=N")
+        if restart is not None and kind not in _RESTART_KINDS:
+            raise ValueError(
+                f"restart= only triggers lifecycle kinds {_RESTART_KINDS}, "
+                f"not {kind!r}")
+        if kind in _RESTART_KINDS and restart is None:
+            raise ValueError(f"{kind} needs restart=N (which supervisor "
+                             "spawn/rejoin index fires it)")
         if kind == "host_loss" and not host:
             raise ValueError("host_loss needs host=H (which simulated host "
                              "dies)")
         self.kind = kind
         self.step = step
         self.p = p
+        self.restart = None if restart is None else int(restart)
         self.host = host
         self.replica = None if replica is None else int(replica)
         # step faults default to firing once; p faults to unlimited
@@ -166,7 +202,12 @@ class FaultSpec:
             self.remaining -= 1
 
     def __repr__(self):
-        trig = f"step={self.step}" if self.step is not None else f"p={self.p}"
+        if self.step is not None:
+            trig = f"step={self.step}"
+        elif self.restart is not None:
+            trig = f"restart={self.restart}"
+        else:
+            trig = f"p={self.p}"
         return (f"FaultSpec({self.kind}@{trig}, repeat={self.repeat}, "
                 f"remaining={self.remaining})")
 
@@ -187,6 +228,8 @@ def parse_spec(text: str) -> List[FaultSpec]:
                 raise ValueError(f"bad fault option {part!r} in {clause!r}")
             k, v = part.split("=", 1)
             kw[k.strip()] = v.strip()
+        if "times" in kw:       # lifecycle-spec alias: times=K == repeat=K
+            kw.setdefault("repeat", kw.pop("times"))
         out.append(FaultSpec(
             kind.strip(),
             step=int(kw["step"]) if "step" in kw else None,
@@ -195,7 +238,8 @@ def parse_spec(text: str) -> List[FaultSpec]:
             secs=float(kw.get("secs", 1.0)),
             seed=int(kw.get("seed", 0)),
             host=kw.get("host"),
-            replica=int(kw["replica"]) if "replica" in kw else None))
+            replica=int(kw["replica"]) if "replica" in kw else None,
+            restart=int(kw["restart"]) if "restart" in kw else None))
     return out
 
 
@@ -305,6 +349,20 @@ class FaultRegistry:
                                           or int(replica) != f.replica):
                 continue
             if tick >= f.step:
+                f.consume()
+                return f
+        return None
+
+    def take_restart(self, kind: str, index: int) -> Optional[FaultSpec]:
+        """Claim a supervisor-RESTART-keyed fault (spawn_fail /
+        replica_flap) for one ReplicaSupervisor spawn-attempt or rejoin
+        index — the supervisor owns both counters, so these budgets are
+        untouchable from train-step or serving-tick hooks."""
+        for f in self.faults:
+            if f.kind != kind or f.kind not in _RESTART_KINDS \
+                    or f.spent() or f.restart is None:
+                continue
+            if index >= f.restart:
                 f.consume()
                 return f
         return None

@@ -68,8 +68,20 @@ Layers, bottom up:
   from scheduler liveness + tick-age heartbeat, and on replica death
   the open healthy streams are ADOPTED by survivors through the
   preemption-resume contract (token-identical continuations; only
-  watchdog-poisoned requests fail). One replica, no faults = a
-  pass-through pinned token-identical to the bare engine;
+  watchdog-poisoned requests fail). The replica set is DYNAMIC
+  (``add_replica`` / ``remove_replica`` under the router lock, warming
+  and draining states, orphan parking when the whole fleet dies). One
+  replica, no faults = a pass-through pinned token-identical to the
+  bare engine;
+- :mod:`lifecycle` — :class:`~lifecycle.ReplicaSupervisor` (ISSUE 14)
+  closes the health loop: replica death/wedge → respawn through an
+  immediate → exponential-backoff → quarantine → give-up-loudly
+  ladder, radix prefix RE-WARM from the router's hottest routed
+  prefixes before the replacement takes traffic, and brownout-driven
+  autoscaling (sustained rung >= ``scale_up_rung`` grows toward
+  ``max_replicas``; sustained rung 0 + low occupancy drains-and-
+  shrinks, migrating open streams to survivors token-identically).
+  No supervisor = bit-identical to the PR-13 router;
 - :mod:`frontend` — the network surface (``python -m
   paddle_tpu.serving.frontend``): a stdlib-asyncio HTTP server with
   OpenAI-style ``/v1/completions`` and ``/v1/chat/completions`` (SSE
@@ -98,8 +110,10 @@ single-chip non-speculative engine; ``overload=None`` + no router
 from .constrained import (ConstraintCursor, TokenConstraint,
                           compile_constraint, compile_regex,
                           schema_to_regex)
-from .engine import GenerationRequest, InferenceEngine, QueueFull
+from .engine import (GenerationRequest, InferenceEngine, QueueFull,
+                     ReplicaEvacuated, WatchdogTripped)
 from .kv_cache import KVCache, PagedKVCache, cache_insert
+from .lifecycle import ReplicaFailed, ReplicaSupervisor
 from .overload import RUNG_NAMES, OverloadController
 from .prefix_cache import RadixPrefixCache
 from .router import EngineRouter
@@ -109,8 +123,10 @@ from .tokenizer import ByteTokenizer, StreamDetokenizer
 
 __all__ = [
     "InferenceEngine", "GenerationRequest", "QueueFull",
+    "WatchdogTripped", "ReplicaEvacuated",
     "KVCache", "PagedKVCache", "cache_insert", "RadixPrefixCache",
     "OverloadController", "RUNG_NAMES", "EngineRouter",
+    "ReplicaSupervisor", "ReplicaFailed",
     "sample_tokens", "sample_tokens_streams", "stream_keys", "spec_accept",
     "ByteTokenizer", "StreamDetokenizer",
     "TokenConstraint", "ConstraintCursor", "compile_constraint",

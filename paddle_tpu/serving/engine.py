@@ -120,7 +120,13 @@ lane-aware rungs (token caps, sheds) are applied by the front end.
 holds the router's adoption hook (stamped onto every request), and
 ``adopt_request`` replays another replica's stream here through the
 preemption-resume contract, token-identical because replicas share the
-seed and the request keeps its rid.
+seed and the request keeps its rid. The replica lifecycle (ISSUE 14,
+serving/lifecycle.py) adds three supervisor-facing hooks:
+``warm_prefix`` (prefill-only radix re-warm in a dedicated rid space),
+``evacuate`` (fail every open stream with :class:`ReplicaEvacuated` so
+the router migrates them — the drain-shrink terminal step), and
+``fail_at_tick`` (deterministic crash for replica_flap chaos / manual
+replica kills).
 
 Observability: gauges serving_queue_depth / serving_slot_occupancy /
 serving_prefill_ms / serving_decode_ms / serving_tokens_per_s (sliding
@@ -171,9 +177,14 @@ from .sampling import (DRAFT_SALT, sample_tokens, sample_tokens_streams,
                        spec_accept, stream_keys)
 
 __all__ = ["InferenceEngine", "GenerationRequest", "QueueFull",
-           "WatchdogTripped"]
+           "WatchdogTripped", "ReplicaEvacuated"]
 
 _CACHE_SPEC = P("data", None, "model", None, None)
+
+# rid floor of the prefix re-warm request space (lifecycle.py): warm
+# prefills draw RNG streams that can never collide with, or shift the
+# numbering of, live request ids — rejoined replicas stay token-identical
+_WARM_RID_BASE = 2**30
 
 
 class QueueFull(RuntimeError):
@@ -197,6 +208,13 @@ class WatchdogTripped(RuntimeError):
     """Carried as the ``error`` of a request the serving watchdog failed:
     its decode logits went non-finite (poisoned KV/weights/activations).
     Healthy streams in the same batch are resumed, token-identical."""
+
+
+class ReplicaEvacuated(RuntimeError):
+    """Raised by the scheduler when :meth:`InferenceEngine.evacuate` asks
+    it to stop: every open stream fails with this cause, which a router
+    failover hook turns into survivor adoption (token-identical replay) —
+    the drain-shrink terminal step of the replica lifecycle (ISSUE 14)."""
 
 
 class GenerationRequest:
@@ -412,10 +430,13 @@ class InferenceEngine:
     healthy state — healthy streams are requeued with their token
     history and replayed through the preemption-resume path
     (token-identical continuations), the device cache and prefix tree
-    are rebuilt from scratch. Options: ``latency_budget_ms`` (None
-    disables the latency rung) with ``latency_trips`` consecutive slow
-    ticks per stall verdict, and ``max_restarts`` before the engine
-    fails open requests loudly. Not combinable with ``draft=``.
+    are rebuilt from scratch. Composes with ``draft=`` (ISSUE 14): the
+    speculative verify program computes the same per-slot verdict over
+    its k+1 verify positions, and a restart rebuilds the draft's KV
+    cache alongside the target's (the prefill paths re-seed both).
+    Options: ``latency_budget_ms`` (None disables the latency rung)
+    with ``latency_trips`` consecutive slow ticks per stall verdict,
+    and ``max_restarts`` before the engine fails open requests loudly.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
@@ -439,10 +460,6 @@ class InferenceEngine:
                     raise ValueError(f"unknown watchdog option(s) "
                                      f"{sorted(unknown)}")
                 defaults.update(dict(watchdog))
-            if draft is not None:
-                raise ValueError(
-                    "watchdog and draft= are not combinable yet: the "
-                    "speculative tick carries no per-slot health output")
             self._watchdog = defaults
         else:
             self._watchdog = None
@@ -531,6 +548,11 @@ class InferenceEngine:
         else:
             self._prefix = None
         self._init_draft(draft, spec_k)
+        # the draft always decodes against its own fixed-slot cache —
+        # k short steps over a small model don't need paging (built here
+        # AND by the watchdog restart's _reset_cache on its thread)
+        self.draft_cache = self._build_draft_cache() \
+            if self.draft is not None else None
         self.tokenizer = tokenizer
         # all-true token mask reused by every unconstrained tick: host
         # template for constrained batches, device-resident copy so the
@@ -547,6 +569,10 @@ class InferenceEngine:
         self._error: Optional[BaseException] = None  # scheduler crash cause
         self._base_key = jax.random.key(seed)
         self._rid = 0            # next request id (per-request RNG stream)
+        self._warm_seq = 0       # warm_prefix sequence (its own rid space)
+        self._evacuate = False   # lifecycle drain: scheduler raises
+        #                          ReplicaEvacuated at its next loop check
+        self._die_tick = None    # lifecycle chaos: fail_at_tick target
         self._ticks = 0          # scheduler loop iterations (span tagging)
         self._admit_seq = 0
         self._spec_prop = 0      # lifetime draft proposals / acceptances
@@ -646,13 +672,7 @@ class InferenceEngine:
         self._draft_params = self._put_params(draft_cfg, draft_params)
         self.draft = (draft_cfg, self._draft_params)
         self.spec_k = int(spec_k)
-        # the draft always decodes against its own fixed-slot cache —
-        # k short steps over a small model don't need paging
-        self.draft_cache = KVCache(draft_cfg, self.n_slots,
-                                   max_len=draft_len)
-        if self._mesh is not None:
-            self.draft_cache.k = self._put_cache(self.draft_cache.k)
-            self.draft_cache.v = self._put_cache(self.draft_cache.v)
+        self._draft_len = draft_len
         self._prefill_spec_jit = jax.jit(self._prefill_spec_fn,
                                          donate_argnums=(2, 3, 4, 5))
         if self.paged:
@@ -663,6 +683,16 @@ class InferenceEngine:
         else:
             self._spec_jit = jax.jit(self._spec_fn,
                                      donate_argnums=(2, 3, 4, 5))
+
+    def _build_draft_cache(self):
+        """Fresh zeroed draft KV cache (construction and the watchdog
+        restart both route here, so the rebuild matches the original)."""
+        cache = KVCache(self.draft_cfg, self.n_slots,
+                        max_len=self._draft_len)
+        if self._mesh is not None:
+            cache.k = self._put_cache(cache.k)
+            cache.v = self._put_cache(cache.v)
+        return cache
 
     # -- compiled programs ---------------------------------------------------
     def _sample_args(self, logits, base_key, rids, steps, temps, top_ks,
@@ -789,6 +819,13 @@ class InferenceEngine:
         keys = stream_keys(base_key, rids, steps)
         out, n_emit = spec_accept(t_logits, d_logits, d_toks, keys, temps,
                                   top_ks, top_ps)
+        if self._watchdog is not None:
+            # per-slot finite verdict over ALL k+1 verify positions —
+            # trace-time gated like the plain tick, so watchdog=off spec
+            # programs compile bit-identical to a watchdog-free build
+            health = logits_finite(
+                jnp.reshape(t_logits, (t_logits.shape[0], -1)))
+            return out, n_emit, health, k, v, dk, dv
         return out, n_emit, k, v, dk, dv
 
     def _spec_paged_fn(self, params, dparams, kb, vb, dk, dv, tables,
@@ -803,6 +840,10 @@ class InferenceEngine:
         keys = stream_keys(base_key, rids, steps)
         out, n_emit = spec_accept(t_logits, d_logits, d_toks, keys, temps,
                                   top_ks, top_ps)
+        if self._watchdog is not None:
+            health = logits_finite(
+                jnp.reshape(t_logits, (t_logits.shape[0], -1)))
+            return out, n_emit, health, kb, vb, dk, dv
         return out, n_emit, kb, vb, dk, dv
 
     # -- public API ----------------------------------------------------------
@@ -925,6 +966,51 @@ class InferenceEngine:
             SERVING_QUEUE_DEPTH.set(len(self._queue))
             self._cv.notify_all()
 
+    # -- replica lifecycle (serving/lifecycle.py, ISSUE 14) ------------------
+    def warm_prefix(self, prompt) -> GenerationRequest:
+        """Queue a prefill-only background request — the radix re-warm
+        primitive. The prompt is prefilled (and, in paged+prefix mode,
+        inserted into the radix tree) and exactly one token is generated
+        and discarded by the caller. The request id comes from a
+        DEDICATED space above ``2**30``, so warm replay neither collides
+        with nor shifts the numbering of live request ids — a rejoined
+        replica's sampled streams stay pure functions of (seed, rid)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1 or prompt.size >= self.max_len:
+            raise ValueError(f"warm prefix length {prompt.size} outside "
+                             f"(0, {self.max_len})")
+        req = GenerationRequest(prompt, 1, 0.0, 0, 1.0, None, None)
+        req._tokenizer = self.tokenizer
+        with self._cv:
+            self._check_open()
+            req.rid = _WARM_RID_BASE + self._warm_seq
+            self._warm_seq += 1
+            req._t_submit = time.monotonic()
+            self._queue.append(req)        # warm runs pre-traffic: the
+            SERVING_QUEUE_DEPTH.set(len(self._queue))  # bound is moot
+            self._cv.notify_all()
+        return req
+
+    def evacuate(self) -> None:
+        """Ask the scheduler to stop by FAILING every open stream with
+        :class:`ReplicaEvacuated` — through the router failover hook,
+        each one is adopted by a survivor and replayed token-identically
+        (the preemption-resume contract). The drain-shrink terminal
+        step: callers must have already stopped routing new work here."""
+        with self._cv:
+            self._evacuate = True
+            self._cv.notify_all()
+
+    def fail_at_tick(self, ticks_ahead: int = 1) -> None:
+        """Chaos/operator hook: make the scheduler raise InjectedCrash
+        ``ticks_ahead`` busy ticks from now — the replica_flap fault's
+        deterministic crash-after-rejoin, also usable as a manual
+        replica kill. A real crash in every observable way (failover,
+        supervisor respawn ladder, spans)."""
+        with self._cv:
+            self._die_tick = self._ticks + max(1, int(ticks_ahead))
+            self._cv.notify_all()
+
     # -- health surface (EngineRouter / frontend readyz) ---------------------
     @property
     def alive(self) -> bool:
@@ -979,6 +1065,14 @@ class InferenceEngine:
             while True:
                 with self._cv:
                     self._last_tick_t = time.monotonic()
+                    if self._evacuate:
+                        # lifecycle drain-shrink: fail every open stream
+                        # with the adoption-triggering cause (see
+                        # evacuate()) — raised here so it runs on the
+                        # scheduler thread, never racing a live tick
+                        raise ReplicaEvacuated(
+                            f"replica {self.replica_id} evacuated "
+                            "(drain-shrink)")
                     busy = bool(self._queue) or any(
                         s is not None for s in self._slots)
                     if self._stop and (not self._drain or not busy):
@@ -986,7 +1080,14 @@ class InferenceEngine:
                     if not busy:
                         self._cv.wait(0.05)
                         continue
+                    die = self._die_tick
                 self._ticks += 1
+                if die is not None and self._ticks >= die:
+                    # fail_at_tick (replica_flap chaos / operator kill):
+                    # indistinguishable from a real scheduler crash
+                    raise _faults.InjectedCrash(
+                        f"injected flap crash (replica {self.replica_id}, "
+                        f"tick {self._ticks})")
                 if _faults.ENABLED[0]:
                     # serving chaos hooks (tick-keyed, per replica):
                     # slow_tick stalls the scheduler (drives the brownout
@@ -1618,9 +1719,9 @@ class InferenceEngine:
         # proposed/accepted counts added below land in the trace event
         with span("serving.decode_step", cat="serving", args=span_args):
             if use_spec:
-                out, n_emit = self._spec_dispatch(active, positions, tokens,
-                                                  rids, steps, temps,
-                                                  top_ks, top_ps)
+                out, n_emit, health = self._spec_dispatch(
+                    active, positions, tokens, rids, steps, temps,
+                    top_ks, top_ps)
             elif native.serving_jit[0]:
                 if self.paged:
                     # table width bucketed to the live maximum (next pow2):
@@ -1719,25 +1820,39 @@ class InferenceEngine:
                        top_ks, top_ps):
         """Run the one-program speculative tick: draft proposes spec_k,
         target verifies k+1 positions, rejection sampling accepts.
-        Returns (out_tokens (B, k+1) np, n_emit (B,) np)."""
+        Returns (out_tokens (B, k+1) np, n_emit (B,) np, health (B,) np
+        or None) — health only when the watchdog is armed, computed over
+        every verify position inside the same compiled program."""
+        health = None
         if self.paged:
             tables = self.cache.tables_array(active)
             tables = tables[:, :self._width_bucket(
                 max(len(self.cache.block_tables[s]) for s in active))]
-            (out, n_emit, self.cache.kb, self.cache.vb, self.draft_cache.k,
-             self.draft_cache.v) = self._spec_paged_jit(
+            got = self._spec_paged_jit(
                 self._decode_params, self._draft_params, self.cache.kb,
                 self.cache.vb, self.draft_cache.k, self.draft_cache.v,
                 tables, positions, tokens, self._base_key, rids, steps,
                 temps, top_ks, top_ps)
+            if self._watchdog is not None:
+                (out, n_emit, health, self.cache.kb, self.cache.vb,
+                 self.draft_cache.k, self.draft_cache.v) = got
+            else:
+                (out, n_emit, self.cache.kb, self.cache.vb,
+                 self.draft_cache.k, self.draft_cache.v) = got
         else:
-            (out, n_emit, self.cache.k, self.cache.v, self.draft_cache.k,
-             self.draft_cache.v) = self._spec_jit(
+            got = self._spec_jit(
                 self._decode_params, self._draft_params, self.cache.k,
                 self.cache.v, self.draft_cache.k, self.draft_cache.v,
                 positions, tokens, self._base_key, rids, steps, temps,
                 top_ks, top_ps)
-        return np.asarray(out), np.asarray(n_emit)
+            if self._watchdog is not None:
+                (out, n_emit, health, self.cache.k, self.cache.v,
+                 self.draft_cache.k, self.draft_cache.v) = got
+            else:
+                (out, n_emit, self.cache.k, self.cache.v,
+                 self.draft_cache.k, self.draft_cache.v) = got
+        return (np.asarray(out), np.asarray(n_emit),
+                None if health is None else np.asarray(health))
 
     def _finish_reason(self, st: _Slot, tok: int) -> Optional[str]:
         """Why generation stops after emitting ``tok`` (None = keep
@@ -1868,6 +1983,11 @@ class InferenceEngine:
                 self.cache.v = self._put_cache(self.cache.v)
         if self._prefix is not None:
             self._prefix = RadixPrefixCache(self.cache)
+        if self.draft is not None:
+            # the draft's K/V were computed alongside the poisoned
+            # target rows — rebuild its fixed cache too, so the spec
+            # path resumes from the same clean slate (ISSUE 14)
+            self.draft_cache = self._build_draft_cache()
         if hasattr(self.cache, "update_gauges"):
             self.cache.update_gauges()
 

@@ -70,7 +70,11 @@ blocks and prefix-tree references.
 block-pool headroom > 0, brownout ladder below its shed rungs — with
 the failing checks in the 503 body. Mounting an
 :class:`~paddle_tpu.serving.router.EngineRouter` instead of an engine
-makes every route replica-aware.
+makes every route replica-aware; a lifecycle replacement that is still
+RE-WARMING its prefix tree shows up in the replica checks (``warming``)
+but is not counted ready, and an attached
+:class:`~paddle_tpu.serving.lifecycle.ReplicaSupervisor`'s state
+(target replica count, ladder positions) rides in ``checks.lifecycle``.
 """
 from __future__ import annotations
 
@@ -521,9 +525,19 @@ class ServingFrontend:
         if hasattr(e, "healthy_replicas"):          # EngineRouter
             healthy = e.healthy_replicas()
             checks["engine_alive"] = bool(healthy)
+            # health() carries per-replica warming/draining flags — a
+            # lifecycle replacement mid-re-warm is visible but NOT ready
             checks["replicas"] = {str(k): v for k, v in e.health().items()}
-            heads = [e.engines[i].pool_headroom() for i in healthy]
+            heads = []
+            for i in healthy:
+                try:
+                    heads.append(e.engine_for(i).pool_headroom())
+                except KeyError:
+                    continue        # removed between snapshot and read
             checks["pool_headroom"] = round(max(heads), 4) if heads else 0.0
+            sup = getattr(e, "supervisor", None)
+            if sup is not None:
+                checks["lifecycle"] = sup.snapshot()
         else:
             checks["engine_alive"] = bool(e.alive)
             checks["pool_headroom"] = round(e.pool_headroom(), 4)

@@ -38,7 +38,13 @@ Consumers:
   ``cap_max_tokens(lane, n)`` at admission;
 - :class:`~paddle_tpu.serving.router.EngineRouter` shares ONE controller
   across every replica, so pressure anywhere brownouts everywhere
-  (a half-browned-out pod serves inconsistent latency).
+  (a half-browned-out pod serves inconsistent latency);
+- :class:`~paddle_tpu.serving.lifecycle.ReplicaSupervisor` (ISSUE 14)
+  polls ``rung`` as its autoscaling signal: sustained rung >=
+  ``scale_up_rung`` grows the replica set, sustained rung 0 with low
+  occupancy drains-and-shrinks it — ``rung_held_s()`` (how long the
+  ladder has sat at the current rung) rides in ``snapshot()`` so the
+  operator view shows whether pressure is a blip or a trend.
 
 With no controller attached (the default everywhere) every compiled
 program, schedule decision and sampled token is bit-identical to a build
@@ -116,6 +122,7 @@ class OverloadController:
         self.token_cap = int(token_cap)
         self._lock = threading.Lock()
         self._rung = RUNG_HEALTHY
+        self._rung_since = time.monotonic()   # last transition (dwell time)
         self._q_ewma = 0.0
         self._t_ewma = 0.0
         self._hot = 0           # consecutive observations above high_water
@@ -150,10 +157,18 @@ class OverloadController:
         with self._lock:
             return self._pressure()
 
+    def rung_held_s(self) -> float:
+        """Seconds the ladder has sat at the CURRENT rung — the
+        blip-vs-trend signal behind lifecycle autoscaling decisions."""
+        with self._lock:
+            return time.monotonic() - self._rung_since
+
     def snapshot(self) -> dict:
         """Readyz/operator view of the controller."""
         with self._lock:
             return {"rung": self._rung, "rung_name": RUNG_NAMES[self._rung],
+                    "rung_held_s": round(
+                        time.monotonic() - self._rung_since, 3),
                     "pressure": round(self._pressure(), 4),
                     "queue_wait_ewma_ms": round(self._q_ewma, 3),
                     "tick_ewma_ms": round(self._t_ewma, 3)}
@@ -187,6 +202,7 @@ class OverloadController:
         # lock held by caller
         prev = self._rung
         self._rung = int(rung)
+        self._rung_since = time.monotonic()
         BROWNOUT_RUNG.set(self._rung)
         BROWNOUT_STEPS.add(1)
         if TRACING[0]:

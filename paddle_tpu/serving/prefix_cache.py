@@ -137,6 +137,33 @@ class RadixPrefixCache:
             level = best.children
         return used, blocks
 
+    def peek(self, shard: int, tokens) -> int:
+        """Read-only twin of :meth:`match`: how many leading tokens of
+        ``tokens`` the tree could serve right now, WITHOUT touching the
+        LRU clock or the hit-rate gauges. The lifecycle re-warm
+        verification (and tests) use it to ask "is this prefix warm?"
+        without perturbing eviction order. Uncapped — a fully-cached
+        prompt peeks at its full length even though ``match`` would
+        stop one token short."""
+        toks = [int(t) for t in tokens]
+        limit = len(toks)
+        level = self._roots[shard]
+        used = 0
+        while used < limit:
+            best_lcp = 0
+            best = None
+            for chunk, node in level.items():
+                lcp = _lcp(chunk, toks, used, limit)
+                if lcp > best_lcp:
+                    best, best_lcp = node, lcp
+            if best is None:
+                break
+            used += best_lcp
+            if best_lcp < len(best.chunk) or len(best.chunk) < self.block_size:
+                break
+            level = best.children
+        return used
+
     def note_lookup(self, matched: int, total: int) -> None:
         """Feed the hit-rate gauge (the engine calls this once per
         admission, with the prompt length it looked up)."""

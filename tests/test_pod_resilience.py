@@ -737,13 +737,19 @@ class TestServingWatchdog:
         assert monitor.stat_get("serving_watchdog_trips") == trips0
         assert monitor.stat_get("serving_watchdog_restarts") == rest0
 
-    def test_watchdog_rejects_draft(self):
+    def test_watchdog_composes_with_draft(self):
+        # PR 12 rejected this combination; ISSUE 14 made the verify
+        # program carry the per-slot health verdict, so it now builds
+        # (full compose coverage lives in test_serving_lifecycle.py)
         from paddle_tpu.serving.engine import InferenceEngine
 
         cfg, params = self._cfg_params()
-        with pytest.raises(ValueError, match="draft"):
-            InferenceEngine(cfg, params, watchdog=True,
-                            draft=(cfg, params))
+        eng = InferenceEngine(cfg, params, watchdog=True,
+                              draft=(cfg, params))
+        try:
+            assert eng._watchdog is not None and eng.draft is not None
+        finally:
+            eng.shutdown(drain=False, timeout=30)
 
     def test_unknown_watchdog_option_rejected(self):
         from paddle_tpu.serving.engine import InferenceEngine

@@ -554,6 +554,86 @@ def overload_report(events: list, file=None) -> dict:
     return out
 
 
+def lifecycle_report(events: list, file=None) -> dict:
+    """Replica-lifecycle verdict (ISSUE 14).
+
+    Reads the ReplicaSupervisor's spans: ``lifecycle.restart`` (one per
+    spawn attempt, with the death cause), ``lifecycle.rejoin`` (warm
+    stats + orphan adoptions), ``lifecycle.quarantine`` /
+    ``lifecycle.give_up`` (the ladder's upper rungs), and
+    ``lifecycle.scale_up`` / ``lifecycle.scale_down`` (the autoscale
+    timeline). Prints the restart-cause table, the scale-event
+    timeline, and a warm verdict: did rejoined replicas come back with
+    their prefix trees re-warmed, or cold?"""
+    restarts = [e for e in events if e.get("name") == "lifecycle.restart"]
+    rejoins = [e for e in events if e.get("name") == "lifecycle.rejoin"]
+    quarantines = [e for e in events
+                   if e.get("name") == "lifecycle.quarantine"]
+    give_ups = [e for e in events if e.get("name") == "lifecycle.give_up"]
+    scales = [e for e in events
+              if e.get("name") in ("lifecycle.scale_up",
+                                   "lifecycle.scale_down")]
+    if not restarts and not rejoins and not scales and not give_ups:
+        return {}
+    causes: dict = {}
+    for e in restarts:
+        c = (e.get("args") or {}).get("cause", "?")
+        causes[c] = causes.get(c, 0) + 1
+    timeline = []
+    for e in sorted(scales, key=lambda e: float(e.get("ts", 0))):
+        a = e.get("args") or {}
+        row = {"t_ms": float(e.get("ts", 0)) / 1e3,
+               "event": e["name"].split(".", 1)[1]}
+        row.update(a)
+        timeline.append(row)
+    warm_tokens = sum(int((e.get("args") or {}).get("warm_tokens", 0))
+                      for e in rejoins)
+    warm_rejoins = sum(1 for e in rejoins
+                       if int((e.get("args") or {}).get("warm_tokens", 0)))
+    adopted = sum(int((e.get("args") or {}).get("adopted", 0))
+                  for e in rejoins)
+    out = {"restarts": len(restarts), "rejoins": len(rejoins),
+           "restart_causes": causes, "quarantines": len(quarantines),
+           "give_ups": len(give_ups), "scale_timeline": timeline,
+           "warm_tokens": warm_tokens, "adopted_streams": adopted}
+    bits = []
+    if restarts:
+        top = max(causes.items(), key=lambda kv: kv[1])
+        bits.append(f"{len(rejoins)}/{len(restarts)} restart(s) rejoined "
+                    f"(top cause: {top[0]} x{top[1]})")
+    if give_ups:
+        bits.append(f"{len(give_ups)} replica(s) GAVE UP after exhausting "
+                    "the ladder — capacity is down, page someone")
+    elif quarantines:
+        bits.append(f"{len(quarantines)} quarantine hold(s): a replica "
+                    "is flapping")
+    if timeline:
+        ups = sum(1 for r in timeline if r["event"] == "scale_up")
+        downs = sum(1 for r in timeline
+                    if r["event"] == "scale_down"
+                    and r.get("phase") == "done")
+        bits.append(f"autoscale: {ups} up / {downs} down")
+    if rejoins:
+        bits.append(f"rejoins warm: {warm_rejoins}/{len(rejoins)} replayed "
+                    f"{warm_tokens} prefix token(s)"
+                    if warm_rejoins else
+                    "rejoins came back COLD (no routed prefixes to replay"
+                    " — expect a first-token latency dip)")
+    out["verdict"] = "; ".join(bits) if bits else "no lifecycle events"
+    print("\nReplica lifecycle:", file=file)
+    for c, n in sorted(causes.items(), key=lambda kv: -kv[1]):
+        print(f"  restart cause {c:<24}{n:>6}", file=file)
+    for row in timeline:
+        extra = {k: v for k, v in row.items() if k not in ("t_ms", "event")}
+        print(f"  t={row['t_ms']:>12.3f}ms  {row['event']}"
+              + (f"  {extra}" if extra else ""), file=file)
+    if give_ups:
+        for e in give_ups:
+            print(f"  GAVE UP: {e.get('args')}", file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
 def resilience_report(events: list, rows: list, file=None,
                       gauges: dict | None = None) -> dict:
     """Self-healing verdict from the resilience spans (ISSUE 5).
@@ -694,6 +774,7 @@ def main(argv=None):
     shard_balance_report(events)
     frontend_report(events)
     overload_report(events)
+    lifecycle_report(events)
     resilience_report(events, rows)
     recompile_report(events)
     pipeline_report(events)
