@@ -541,6 +541,48 @@ def bench_resilience(on_accel):
     }
 
 
+def _serving_hist_snap():
+    """Snapshot the source-recorded serving latency histograms
+    (ISSUE 15) so a bench leg can be scoped by delta."""
+    from paddle_tpu.monitor import get_histogram
+
+    return {name: get_histogram(name).snapshot()
+            for name in ("serving_first_token_ms", "serving_per_token_ms")}
+
+
+def _serving_hist_pcts(before, after, hand_p50_ms, what):
+    """p50/p99 from the histogram delta, cross-checked against the
+    hand-collected p50: the two measurement paths (client-side
+    perf_counter lists vs source-recorded log2-bucket histograms) must
+    land within ONE bucket of each other — the agreement gate that
+    guards the histogram math (bucketing, cumulative counts, quantile
+    interpolation) with real traffic."""
+    import math
+
+    from paddle_tpu.monitor import hist_delta, hist_quantile
+
+    out = {}
+    for name, key in (("serving_first_token_ms", "first_token_ms"),
+                      ("serving_per_token_ms", "per_token_ms")):
+        d = hist_delta(before[name], after[name])
+        out[f"{key}_p50"] = round(hist_quantile(d, 0.50), 3)
+        out[f"{key}_p99"] = round(hist_quantile(d, 0.99), 3)
+        out[f"{key}_samples"] = d["count"]
+    hist_p50 = out["first_token_ms_p50"]
+    if hand_p50_ms > 0 and hist_p50 > 0 \
+            and out["first_token_ms_samples"] >= 8:
+        drift = abs(math.log2(hist_p50 / hand_p50_ms))
+        out["first_token_p50_hand_ms"] = round(hand_p50_ms, 3)
+        out["p50_bucket_drift"] = round(drift, 3)
+        # one log2 bucket of resolution + boundary slack
+        assert drift <= 1.1, (
+            f"{what}: histogram first-token p50 {hist_p50:.2f}ms "
+            f"disagrees with the hand-collected {hand_p50_ms:.2f}ms by "
+            f"{drift:.2f} buckets (> 1 bucket) — histogram math or "
+            "source recording is wrong")
+    return out
+
+
 def bench_serving_load(on_accel):
     """ISSUE 7: serving load generator — Poisson arrivals at several
     offered-load levels against (a) the fixed-slot engine and (b) the
@@ -594,6 +636,7 @@ def bench_serving_load(on_accel):
         first_t = [None] * n_req
         done_t = [None] * n_req
         sub_t = [None] * n_req
+        h0 = _serving_hist_snap()
 
         def consume(i, req):
             it = req.stream(timeout=600)
@@ -619,13 +662,20 @@ def bench_serving_load(on_accel):
         ftl = np.asarray([f - s for f, s in zip(first_t, sub_t)]) * 1e3
         ptl = np.asarray([(d - f) / (max_new - 1)
                           for d, f in zip(done_t, first_t)]) * 1e3
-        return {
-            "first_token_ms_p50": round(float(np.percentile(ftl, 50)), 2),
-            "first_token_ms_p99": round(float(np.percentile(ftl, 99)), 2),
-            "per_token_ms_p50": round(float(np.percentile(ptl, 50)), 3),
-            "per_token_ms_p99": round(float(np.percentile(ptl, 99)), 3),
+        # headline percentiles come from the SOURCE-recorded histograms
+        # (ISSUE 15) — the same series GET /metrics scrapes — with the
+        # hand-collected client-side list as the agreement cross-check
+        out = _serving_hist_pcts(h0, _serving_hist_snap(),
+                                 float(np.percentile(ftl, 50)),
+                                 "serving_load")
+        out.update({
+            "first_token_ms_p99_hand":
+                round(float(np.percentile(ftl, 99)), 2),
+            "per_token_ms_p50_hand":
+                round(float(np.percentile(ptl, 50)), 3),
             "tokens_per_s": round(n_req * max_new / wall, 2),
-        }
+        })
+        return out
 
     out = {}
     for paged in (False, True):
@@ -986,6 +1036,7 @@ def bench_serving_chaos(on_accel):
                              step_down_after=6)
     shed0 = monitor.stat_get("serving_deadline_sheds")
     fo0 = monitor.stat_get("router_failovers")
+    h0 = _serving_hist_snap()      # after the oracle run: chaos-leg only
     configure_faults("replica_crash@step=20:replica=0,"
                      "slow_tick@step=8:secs=0.15:repeat=3:replica=1,"
                      "conn_drop@step=3")
@@ -1048,6 +1099,14 @@ def bench_serving_chaos(on_accel):
     silent = [i for i in range(n_req) if finishes[i] is None]
     ftl = np.asarray([(first_t[i] - sub_t[i]) * 1e3 for i in range(n_req)
                       if first_t[i] is not None])
+    # source-recorded histogram percentiles (ISSUE 15) + agreement gate
+    # vs the hand-collected list — under chaos, p50 only (failover
+    # adoption restamps a not-yet-started request's submit clock, so the
+    # tail definitions legitimately diverge)
+    hist = _serving_hist_pcts(
+        h0, _serving_hist_snap(),
+        float(np.percentile(ftl, 50)) if ftl.size else 0.0,
+        "serving_chaos")
     identity = 1.0 if completed and not corrupt else 0.0
     lifecycle = _serving_chaos_lifecycle_leg(cfg, params, rng)
     return {
@@ -1062,10 +1121,11 @@ def bench_serving_chaos(on_accel):
             monitor.stat_get("serving_deadline_sheds") - shed0,
         "brownout_rung_final": monitor.stat_get("brownout_rung"),
         "brownout_steps": monitor.stat_get("brownout_steps"),
-        "first_token_ms_p50": round(float(np.percentile(ftl, 50)), 2)
+        "first_token_ms_p50": hist["first_token_ms_p50"] or None,
+        "first_token_ms_p99": hist["first_token_ms_p99"] or None,
+        "first_token_ms_p50_hand": round(float(np.percentile(ftl, 50)), 2)
         if ftl.size else None,
-        "first_token_ms_p99": round(float(np.percentile(ftl, 99)), 2)
-        if ftl.size else None,
+        "histograms": hist,
         "wall_s": round(wall, 2),
         "note": f"{n_req} req x {max_new} tokens at ~24rps Poisson over "
                 "2 paged replicas (shared 64-block pools), faults: "

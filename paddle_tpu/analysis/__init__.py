@@ -56,6 +56,14 @@ entries carrying a fingerprint AND a reason):
 - **GL010 bare-except** — bare ``except:`` (swallows
   KeyboardInterrupt/SystemExit) anywhere, scheduler/guardian loops
   especially.
+- **GL011 span-hygiene** (ISSUE 15) — a trace span opened imperatively
+  (``add_begin``/``begin()``) whose closer is missing from the function
+  or sits only in straight-line code (no ``finally``). Rationale: an
+  exception between open and close leaks the span, mis-nesting every
+  later B/E pair on that thread — corrupting exactly the post-mortem
+  (flight-recorder) traces that are read when something already went
+  wrong. Use the ``monitor.trace.span()``/``RecordEvent`` context
+  managers, or close in a ``finally:``.
 
 Runtime sanitizers (``FLAGS_sanitize=1``; default 0 is pinned
 bit-for-bit on the fast-step trajectory — the flag-off cost is one list

@@ -6,7 +6,8 @@ builds the shared indexes the rule modules consume — a qualified-name
 function table, per-module import maps (so ``from ..models.gpt import
 gpt_decode_step`` resolves to the defining file), and a best-effort
 call-target resolver. Rules live in :mod:`hotpath` (GL001/GL002),
-:mod:`races` (GL003/GL004) and :mod:`invariants` (GL005–GL010); each
+:mod:`races` (GL003/GL004), :mod:`invariants` (GL005–GL010) and
+:mod:`spans` (GL011 span hygiene); each
 yields :class:`Finding` rows with a STABLE fingerprint (rule + path +
 symbol + detail, no line numbers) so the checked-in baseline survives
 unrelated edits.
@@ -49,6 +50,9 @@ RULE_DOCS = {
     "GL009": "mutable default argument (shared across calls)",
     "GL010": "bare except: swallows KeyboardInterrupt/SystemExit in a "
              "scheduler/guardian loop",
+    "GL011": "span opened imperatively (add_begin/begin) without a "
+             "guaranteed exit on exception paths — close in a finally: "
+             "or use the span()/RecordEvent context manager",
 }
 
 
@@ -377,9 +381,9 @@ def build_project(paths: Iterable[str], root: Optional[str] = None
 
 
 def _default_rules():
-    from . import hotpath, invariants, races
+    from . import hotpath, invariants, races, spans
 
-    return [hotpath.check, races.check, invariants.check]
+    return [hotpath.check, races.check, invariants.check, spans.check]
 
 
 ALL_RULES = tuple(RULE_DOCS)

@@ -1,0 +1,105 @@
+"""GL011 — span hygiene (ISSUE 15).
+
+The observability layer's exactness depends on every span CLOSING: an
+``add_begin``/``begin()`` whose matching ``add_end``/``end()`` sits in
+straight-line code leaks the span the first time an exception unwinds
+between the two — chrome-trace B/E matching then mis-nests every later
+span on that thread, and the flight recorder's last-seconds ring reads
+wrong exactly when it matters (mid-crash). The codebase convention is
+the ``monitor.trace.span(...)``/``RecordEvent`` context managers, whose
+``finally`` guarantees the exit; this rule flags the imperative pairs
+that don't:
+
+- an opener call (``*.add_begin(...)`` / ``*.begin()``) with NO closer
+  (``*.add_end(...)`` / ``*.end()``) anywhere in the same function — the
+  span's lifetime silently crosses function boundaries;
+- an opener whose closers all sit OUTSIDE any ``try/finally`` — an
+  exception between open and close leaks the span.
+
+A closer inside the ``finally`` of a ``try`` at-or-after the opener
+(the ``open(); try: ... finally: close()`` idiom) or enclosing it is
+accepted. Openers/closers naming their span with a string literal are
+matched by name; dynamic names match any closer.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .lint import Finding, Project
+
+__all__ = ["check"]
+
+_OPENERS = {"add_begin", "begin"}
+_CLOSERS = {"add_end", "end"}
+
+
+def _span_name(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _method_calls(node, names) -> List[ast.Call]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in names:
+            out.append(n)
+    return out
+
+
+def check(proj: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for (relpath, qual), fi in sorted(proj.functions.items()):
+        node = fi.node
+        openers = _method_calls(node, _OPENERS)
+        if not openers:
+            continue
+        closers = _method_calls(node, _CLOSERS)
+        # closers guarded by a finally: (closer, try-node) pairs
+        guarded = []
+        for t in ast.walk(node):
+            if isinstance(t, ast.Try) and t.finalbody:
+                for fb in t.finalbody:
+                    for c in _method_calls(fb, _CLOSERS):
+                        guarded.append((c, t))
+        for op in openers:
+            # skip the context-manager protocol's own plumbing (a class
+            # defining begin()/end() as __enter__/__exit__ sugar calls
+            # one from the other)
+            name = _span_name(op)
+            matching = [c for c in closers
+                        if name is None or _span_name(c) is None
+                        or _span_name(c) == name]
+            detail = f"span:{name or '<dynamic>'}"
+            if not matching:
+                findings.append(Finding(
+                    "GL011", relpath, op.lineno, qual, detail,
+                    f"span opened via .{op.func.attr}() with no matching "
+                    "closer in this function — the span leaks when the "
+                    "caller forgets (or an exception unwinds); use the "
+                    "monitor.trace.span()/RecordEvent context manager"))
+                continue
+            safe = False
+            for c, t in guarded:
+                if c not in matching:
+                    continue
+                # accepted shapes: opener before the try whose finally
+                # closes (open(); try: ... finally: close()), or opener
+                # inside that try's body
+                if t.lineno >= op.lineno \
+                        or (t.lineno <= op.lineno
+                            <= max(getattr(t, "end_lineno", t.lineno),
+                                   t.lineno)):
+                    safe = True
+                    break
+            if not safe:
+                findings.append(Finding(
+                    "GL011", relpath, op.lineno, qual, detail,
+                    f"span opened via .{op.func.attr}() is closed only in "
+                    "straight-line code — an exception between open and "
+                    "close leaks it; close in a finally: or use the "
+                    "monitor.trace.span()/RecordEvent context manager"))
+    return findings

@@ -9,16 +9,36 @@ Reference mapping:
 - TrainerMonitor        → per-step telemetry feeding hapi callbacks
                           (callbacks.py Monitor) and tools/scaling_report
 
+Observability v2 (ISSUE 15):
+- histogram metrics    → stats.Histogram (log2 buckets, +count/+sum) +
+                         stats.prometheus_text() — the GET /metrics body
+- causal tracing       → tracectx.TraceContext / mint_trace + the
+                         trace.py flow events ("s"/"t"/"f") that chain a
+                         request's spans into one chrome timeline
+- crash flight recorder→ flight.FlightRecorder: a bounded ring of recent
+                         spans/gauge deltas dumped (pod-aware naming) at
+                         the moment of failure, merged across hosts by
+                         tools/trace_report.py
+
 Layering: this package depends only on the stdlib and core.native (the
 flag cells), so the hot paths (framework.core, distributed.collective,
 parallel.train_step) can import it without cycles. Everything is
-opt-out-by-default: with tracing off and FLAGS_benchmark=0 the only cost
-in the dispatch path is counter increments.
+opt-out-by-default: with tracing off, no flight recorder armed and
+FLAGS_benchmark=0 the only cost in the dispatch path is counter
+increments.
 """
 from .stats import (
+    DEFAULT_HISTOGRAMS,
     DEFAULT_STATS,
+    Histogram,
     Stat,
     StatRegistry,
+    get_histogram,
+    hist_delta,
+    hist_observe,
+    hist_quantile,
+    histogram_snapshot,
+    prometheus_text,
     reset_all_stats,
     stat_add,
     stat_get,
@@ -31,9 +51,20 @@ from .trace import (
     TraceWriter,
     get_writer,
     is_tracing,
+    recording,
     span,
     start_tracing,
     stop_tracing,
+)
+from .tracectx import TraceContext, mint_trace
+from .flight import (
+    FlightRecorder,
+    arm_flight_recorder,
+    disarm_flight_recorder,
+    dump_flight,
+    get_flight_recorder,
+    host_id,
+    set_host_id,
 )
 from .benchmark import (
     benchmark_reset,
@@ -46,8 +77,13 @@ __all__ = [
     "Stat", "StatRegistry", "DEFAULT_STATS",
     "stat_add", "stat_get", "stat_reset", "stat_names", "stat_snapshot",
     "reset_all_stats", "update_memory_stats",
-    "TraceWriter", "get_writer", "is_tracing", "span",
+    "Histogram", "DEFAULT_HISTOGRAMS", "hist_observe", "get_histogram",
+    "histogram_snapshot", "hist_delta", "hist_quantile", "prometheus_text",
+    "TraceWriter", "get_writer", "is_tracing", "recording", "span",
     "start_tracing", "stop_tracing",
+    "TraceContext", "mint_trace",
+    "FlightRecorder", "arm_flight_recorder", "disarm_flight_recorder",
+    "dump_flight", "get_flight_recorder", "set_host_id", "host_id",
     "benchmark_reset", "benchmark_rows", "benchmark_summary",
     "TrainerMonitor",
 ]

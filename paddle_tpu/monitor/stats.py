@@ -14,12 +14,15 @@ gauges are filled on demand by :func:`update_memory_stats`.
 """
 from __future__ import annotations
 
+import re
 import threading
 
 __all__ = [
     "Stat", "StatRegistry", "stat_add", "stat_get", "stat_reset",
     "stat_names", "stat_snapshot", "reset_all_stats", "update_memory_stats",
     "DEFAULT_STATS",
+    "Histogram", "DEFAULT_HISTOGRAMS", "hist_observe", "get_histogram",
+    "histogram_snapshot", "hist_delta", "hist_quantile", "prometheus_text",
 ]
 
 
@@ -58,6 +61,106 @@ class Stat:
         return f"Stat({self.name}={self._value})"
 
 
+# log2-spaced default bucket bounds (milliseconds): 0.125ms .. 8.192s.
+# Fixed and shared by every default histogram so cross-metric quantile
+# comparisons and the bench agreement gate read off one resolution —
+# "within bucket resolution" means within one factor-of-2 bucket.
+DEFAULT_BUCKETS_MS = tuple(2.0 ** k for k in range(-3, 14))
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (Prometheus histogram semantics:
+    cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``).
+
+    Buckets are log-spaced and FIXED at construction — observation is
+    one lock + one bisect-free linear scan over ~17 bounds (cheap next
+    to the time.monotonic() call that produced the sample), and two
+    snapshots diff cleanly because the bounds never move.
+    """
+
+    __slots__ = ("name", "bounds", "_counts", "_count", "_sum", "_lock")
+
+    def __init__(self, name: str, bounds=DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(f"histogram bounds must be strictly "
+                             f"increasing, got {bounds}")
+        self._counts = [0] * (len(self.bounds) + 1)   # +1 = +Inf overflow
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for b in self.bounds:
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+
+    def snapshot(self) -> dict:
+        """{"bounds", "counts" (per-bucket, NON-cumulative, +Inf last),
+        "count", "sum"} — a value object two of which diff cleanly."""
+        with self._lock:
+            return {"bounds": list(self.bounds),
+                    "counts": list(self._counts),
+                    "count": self._count, "sum": self._sum}
+
+    def quantile(self, q: float) -> float:
+        return hist_quantile(self.snapshot(), q)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+
+    def __repr__(self):
+        return f"Histogram({self.name}, count={self._count})"
+
+
+def hist_delta(before: dict, after: dict) -> dict:
+    """Snapshot difference (same bounds): the observations made between
+    the two snapshots — how bench scopes a histogram to one run leg."""
+    if before["bounds"] != after["bounds"]:
+        raise ValueError("histogram snapshots have different bounds")
+    return {"bounds": list(after["bounds"]),
+            "counts": [a - b for a, b in zip(after["counts"],
+                                             before["counts"])],
+            "count": after["count"] - before["count"],
+            "sum": after["sum"] - before["sum"]}
+
+
+def hist_quantile(snap: dict, q: float) -> float:
+    """Quantile estimate from a snapshot: linear interpolation inside
+    the bucket where the cumulative count crosses ``q`` (Prometheus
+    ``histogram_quantile`` semantics; the +Inf bucket clamps to the last
+    finite bound). NaN-free: an empty snapshot returns 0.0."""
+    count = snap["count"]
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    cum = 0
+    lo = 0.0
+    for i, c in enumerate(snap["counts"]):
+        nxt = cum + c
+        if nxt >= rank and c > 0:
+            if i >= len(snap["bounds"]):
+                return float(snap["bounds"][-1])    # +Inf bucket: clamp
+            hi = snap["bounds"][i]
+            frac = (rank - cum) / c
+            return float(lo + (hi - lo) * frac)
+        cum = nxt
+        if i < len(snap["bounds"]):
+            lo = snap["bounds"][i]
+    return float(snap["bounds"][-1])
+
+
 class StatRegistry:
     """Thread-safe singleton registry of Stats (monitor.h StatRegistry)."""
 
@@ -74,6 +177,7 @@ class StatRegistry:
 
     def __init__(self):
         self._stats: dict[str, Stat] = {}
+        self._hists: dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     def get_stat(self, name: str) -> Stat:
@@ -82,6 +186,19 @@ class StatRegistry:
             with self._lock:
                 s = self._stats.setdefault(name, Stat(name))
         return s
+
+    def get_histogram(self, name: str,
+                      bounds=DEFAULT_BUCKETS_MS) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, Histogram(name, bounds))
+        return h
+
+    def histogram_snapshot(self) -> dict:
+        with self._lock:
+            hists = sorted(self._hists.items())
+        return {n: h.snapshot() for n, h in hists}
 
     def add(self, name: str, delta: int = 1) -> None:
         self.get_stat(name).add(delta)
@@ -96,6 +213,8 @@ class StatRegistry:
         with self._lock:
             for s in self._stats.values():
                 s.reset()
+            for h in self._hists.values():
+                h.reset()
 
     def names(self):
         with self._lock:
@@ -131,6 +250,18 @@ def stat_snapshot() -> dict:
 
 def reset_all_stats() -> None:
     _registry.reset_all()
+
+
+def hist_observe(name: str, value: float) -> None:
+    _registry.get_histogram(name).observe(value)
+
+
+def get_histogram(name: str) -> Histogram:
+    return _registry.get_histogram(name)
+
+
+def histogram_snapshot() -> dict:
+    return _registry.histogram_snapshot()
 
 
 # -- pre-registered stats (the subsystem's standing dashboard) --------------
@@ -297,6 +428,88 @@ SERVING_REPLICAS_TARGET = _registry.get_stat("serving_replicas_target")
 SERVING_REPLICA_RESTARTS = _registry.get_stat("serving_replica_restarts")
 SERVING_SCALE_EVENTS = _registry.get_stat("serving_scale_events")
 PREFIX_WARM_TOKENS = _registry.get_stat("prefix_warm_tokens")
+
+
+# -- pre-registered latency histograms (ISSUE 15) ---------------------------
+#
+# Recorded AT THE SOURCE (engine scheduler / frontend dispatcher), so the
+# p50/p99 numbers bench.py used to hand-collect are live, scrapeable
+# series under GET /metrics. All share DEFAULT_BUCKETS_MS.
+
+DEFAULT_HISTOGRAMS = (
+    ("serving_first_token_ms",
+     "submit-to-first-token latency per request (ms)"),
+    ("serving_per_token_ms",
+     "steady-state inter-token latency per request, "
+     "(t_last - t_first)/(n-1) (ms)"),
+    ("serving_queue_wait_ms",
+     "queue wait before work starts: WFQ lane wait and engine "
+     "admission wait (ms)"),
+    ("serving_decode_tick_ms",
+     "batched decode tick wall latency (ms)"),
+    ("serving_prefill_chunk_ms",
+     "prefill work quantum wall latency: one chunk (paged) or one "
+     "whole-prompt prefill (fixed) (ms)"),
+)
+
+HISTOGRAM_HELP = dict(DEFAULT_HISTOGRAMS)
+
+for _n, _ in DEFAULT_HISTOGRAMS:
+    _registry.get_histogram(_n)
+
+SERVING_FIRST_TOKEN_MS = _registry.get_histogram("serving_first_token_ms")
+SERVING_PER_TOKEN_MS = _registry.get_histogram("serving_per_token_ms")
+SERVING_QUEUE_WAIT_MS = _registry.get_histogram("serving_queue_wait_ms")
+SERVING_DECODE_TICK_MS = _registry.get_histogram("serving_decode_tick_ms")
+SERVING_PREFILL_CHUNK_MS = _registry.get_histogram(
+    "serving_prefill_chunk_ms")
+
+
+# -- Prometheus text exposition (ISSUE 15 satellite) ------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str = "paddle_tpu_") -> str:
+    """Sanitize to a legal Prometheus metric name: invalid characters
+    (the per-axis gauges' ``.``, benchmark rows' ``@``) become ``_``,
+    and a leading digit is prefixed."""
+    n = _PROM_BAD.sub("_", str(name))
+    if n and n[0].isdigit():
+        n = "_" + n
+    return prefix + n
+
+
+def _prom_num(v: float) -> str:
+    """Format a float the Prometheus text format accepts (no trailing
+    noise: 0.125 -> '0.125', 8192.0 -> '8192')."""
+    return format(float(v), "g")
+
+
+def prometheus_text(prefix: str = "paddle_tpu_") -> str:
+    """The full registry in Prometheus text exposition format 0.0.4:
+    every gauge with ``# HELP``/``# TYPE``, every histogram as
+    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count`` —
+    what GET /metrics serves."""
+    lines = []
+    for name, value in stat_snapshot().items():
+        m = _prom_name(name, prefix)
+        lines.append(f"# HELP {m} int64 gauge {name}")
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {int(value)}")
+    for name, snap in histogram_snapshot().items():
+        m = _prom_name(name, prefix)
+        help_txt = HISTOGRAM_HELP.get(name, f"latency histogram {name}")
+        lines.append(f"# HELP {m} {help_txt}")
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for bound, c in zip(snap["bounds"], snap["counts"]):
+            cum += c
+            lines.append(f'{m}_bucket{{le="{_prom_num(bound)}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {snap["count"]}')
+        lines.append(f"{m}_sum {_prom_num(snap['sum'])}")
+        lines.append(f"{m}_count {snap['count']}")
+    return "\n".join(lines) + "\n"
 
 
 # per-mesh-axis device-memory gauges published by the last

@@ -24,11 +24,19 @@ import os
 import threading
 import time
 
-__all__ = ["TraceWriter", "TRACING", "is_tracing", "start_tracing",
-           "stop_tracing", "get_writer", "span"]
+__all__ = ["TraceWriter", "TRACING", "FLIGHT", "is_tracing",
+           "start_tracing", "stop_tracing", "get_writer", "span",
+           "recording", "emit_complete", "emit_instant", "emit_flow"]
 
 # shared mutable gate — hot paths read TRACING[0] directly
 TRACING = [False]
+
+# the armed flight recorder (monitor/flight.py) or None — a second
+# consumer of span/instant events that stays on across a failure so the
+# last seconds before a crash are dumpable even when full tracing is off.
+# Kept here (not in flight.py) so span() pays ONE extra list index when
+# nothing is armed and flight.py can import without a cycle.
+FLIGHT = [None]
 
 
 class TraceWriter:
@@ -83,6 +91,23 @@ class TraceWriter:
                 "ts": int(ts * 1e6), "args": dict(values),
             })
 
+    def add_flow(self, ph: str, flow_id: int, ts: float,
+                 name: str = "request", cat: str = "trace") -> None:
+        """One flow event ("s" start / "t" step / "f" finish) with
+        ``id=flow_id``. Chrome/Perfetto draw an arrow chain through every
+        flow event sharing an id, binding each to the enclosing slice on
+        its thread — that chain is what turns per-layer spans into ONE
+        connected per-request timeline (ISSUE 15 causal tracing)."""
+        ev = {
+            "name": name, "ph": ph, "cat": cat, "pid": self.pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "ts": int(ts * 1e6), "id": int(flow_id),
+        }
+        if ph == "f":
+            ev["bp"] = "e"      # bind the finish to the enclosing slice
+        with self._lock:
+            self._events.append(ev)
+
     def extend(self, events) -> None:
         with self._lock:
             self._events.extend(events)
@@ -136,15 +161,60 @@ def stop_tracing() -> TraceWriter:
     return _writer
 
 
+def recording() -> bool:
+    """True when anything consumes events: full tracing OR an armed
+    flight recorder. Hot paths that pre-compute span args should gate on
+    this rather than ``TRACING[0]`` alone."""
+    return TRACING[0] or FLIGHT[0] is not None
+
+
+def emit_complete(name: str, ts: float, dur: float, cat: str = "op",
+                  args: dict | None = None) -> None:
+    """One complete event to every live consumer (trace writer when
+    tracing, flight-recorder ring when armed)."""
+    if TRACING[0]:
+        _writer.add_complete(name, ts, dur, cat=cat, args=args)
+    rec = FLIGHT[0]
+    if rec is not None:
+        rec.add_complete(name, ts, dur, cat=cat, args=args)
+
+
+def emit_instant(name: str, ts: float, cat: str = "instant") -> None:
+    if TRACING[0]:
+        _writer.add_instant(name, ts, cat=cat)
+    rec = FLIGHT[0]
+    if rec is not None:
+        rec.add_instant(name, ts, cat=cat)
+
+
+def emit_flow(ph: str, flow_id: int, ts: float,
+              name: str = "request") -> None:
+    if TRACING[0]:
+        _writer.add_flow(ph, flow_id, ts, name=name)
+    rec = FLIGHT[0]
+    if rec is not None:
+        rec.add_flow(ph, flow_id, ts, name=name)
+
+
 @contextlib.contextmanager
-def span(name: str, cat: str = "op", args: dict | None = None):
-    """Record a span around a block — free when tracing is off."""
-    if not TRACING[0]:
+def span(name: str, cat: str = "op", args: dict | None = None,
+         flow: int | None = None):
+    """Record a span around a block — free when tracing is off (one list
+    index) and the flight recorder is unarmed (a second list index).
+
+    ``flow``: a trace/flow id to stamp a flow STEP event at span start,
+    chaining this span into its request's causal timeline."""
+    if not TRACING[0] and FLIGHT[0] is None:
         yield
         return
     t0 = time.perf_counter()
+    if flow is not None:
+        # flow events keep the constant "request" name: name-based event
+        # filters (reports, tests) must see only the real span under the
+        # span's name
+        emit_flow("t", flow, t0)
     try:
         yield
     finally:
-        _writer.add_complete(name, t0, time.perf_counter() - t0,
-                             cat=cat, args=args)
+        emit_complete(name, t0, time.perf_counter() - t0,
+                      cat=cat, args=args)

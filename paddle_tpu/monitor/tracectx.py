@@ -1,0 +1,92 @@
+"""Causal trace context for the serving fleet (ISSUE 15).
+
+A :class:`TraceContext` is the Dapper-style identity one request carries
+from HTTP admission to its last decoded token: a process-unique
+``trace_id`` (the chrome-trace FLOW id — every event stamped with it is
+drawn on one connected arrow chain), a parent/child span-id pair so
+events nest causally rather than just temporally, and the list of
+replica HOPS the request survived (failover adoption, supervisor
+restart/rejoin) so a cross-replica timeline still reads as ONE request.
+
+Who does what:
+
+- the front end MINTS a context per generation request
+  (:func:`mint_trace`) and emits the flow-START event at admission;
+- every layer a request passes through (WFQ lane wait, engine
+  admission/prefill, each prefill chunk, each decode tick it
+  participates in, the failover hop) stamps its span with
+  :meth:`TraceContext.args` and a flow STEP, becoming a child of the
+  previous span;
+- request completion emits the flow FINISH.
+
+``tools/trace_report.py request_report`` groups events by ``trace`` and
+prints the per-request critical path (lane wait vs prefill vs decode vs
+stalls) plus the slowest-N breakdown; chrome://tracing renders the same
+events as one connected per-request timeline across threads, replicas
+and (merged flight dumps) hosts.
+
+Context minting and propagation never touches sampling state — with
+tracing off and no flight recorder armed the token stream is pinned
+bit-identical (the context rides along but nothing reads it).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import List, Optional, Tuple
+
+__all__ = ["TraceContext", "mint_trace"]
+
+# trace ids carry the pid in their high bits so flow chains from
+# different processes stay distinct when flight dumps are merged
+_seq = itertools.count(1)
+_seq_lock = threading.Lock()
+
+
+class TraceContext:
+    """One request's causal identity: flow id + span lineage + hops."""
+
+    __slots__ = ("trace_id", "parent_id", "span_id", "hops", "_n")
+
+    def __init__(self, trace_id: int):
+        self.trace_id = int(trace_id)
+        self.parent_id = 0          # span id of the latest emitted span
+        self.span_id = 0
+        self.hops: List[Tuple[Optional[int], Optional[int]]] = []
+        self._n = 0
+
+    def child(self) -> Tuple[int, int]:
+        """Allocate the next span id; returns (parent_id, span_id) and
+        advances the lineage so the NEXT span parents off this one."""
+        self._n += 1
+        parent = self.span_id
+        self.parent_id = parent
+        self.span_id = self._n
+        return parent, self._n
+
+    def args(self, **extra) -> dict:
+        """Span-args payload for the next event on this trace: allocates
+        a child span id and merges any per-span extras."""
+        parent, sid = self.child()
+        out = {"trace": self.trace_id, "span": sid, "parent": parent}
+        if self.hops:
+            out["hop"] = len(self.hops)
+        out.update(extra)
+        return out
+
+    def hop(self, from_replica: Optional[int],
+            to_replica: Optional[int]) -> None:
+        """Record a replica hop (failover adoption / rejoin replay)."""
+        self.hops.append((from_replica, to_replica))
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id:#x}, spans={self._n}, "
+                f"hops={self.hops})")
+
+
+def mint_trace() -> TraceContext:
+    """New process-unique trace context (pid-salted flow id)."""
+    with _seq_lock:
+        n = next(_seq)
+    return TraceContext(((os.getpid() & 0xFFFF) << 40) | n)

@@ -126,6 +126,20 @@ class TrainGuardian:
         self.elastic = elastic
         self.pod = pod              # PodCoordinator: rollback agreement,
         #                             host-loss detection, resize devices
+        if pod is not None:
+            # pod-aware flight/trace dump naming: this process's dumps
+            # carry the elastic layer's host id, so multi-host dumps
+            # dropped into one directory merge into one timeline
+            from ..monitor.flight import set_host_id
+
+            set_host_id(pod.host)
+        if watchdog_timeout and ckpt_dir is not None:
+            # a watchdog-armed guardian is exactly a process whose last
+            # seconds matter: arm the crash flight recorder so the stall
+            # path can dump them (idempotent and process-shared)
+            from ..monitor.flight import arm_flight_recorder
+
+            arm_flight_recorder(ckpt_dir)
         self.rebuild = rebuild      # callable(devices) -> new step object
         self.keep_snapshots = max(1, int(keep_snapshots))
         self.async_snapshot = bool(async_snapshot)
@@ -660,6 +674,14 @@ class TrainGuardian:
                     os.path.join(base, "watchdog_trace.json"))
         except Exception:  # noqa: BLE001
             pass
+        # flight-recorder dump (ISSUE 15): the bounded ring of recent
+        # spans/gauge deltas — works even when full tracing is off, and
+        # never raises (the stall is the story, not the dump)
+        from ..monitor.flight import dump_flight
+
+        dump_flight("guardian_watchdog_stall",
+                    trace_dir=self.ckpt_dir,
+                    extra={"watchdog_timeout": self.watchdog_timeout})
         warnings.warn(
             f"watchdog: training step stalled for >{self.watchdog_timeout}s"
             + (f"; stacks dumped to {target}" if target else ""))

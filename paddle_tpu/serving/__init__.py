@@ -85,7 +85,9 @@ Layers, bottom up:
 - :mod:`frontend` — the network surface (``python -m
   paddle_tpu.serving.frontend``): a stdlib-asyncio HTTP server with
   OpenAI-style ``/v1/completions`` and ``/v1/chat/completions`` (SSE
-  streaming), ``/v1/models``, ``/metrics`` (StatRegistry dump), and
+  streaming), ``/v1/models``, ``/metrics`` (Prometheus text exposition:
+  HELP/TYPE for every gauge + the source-recorded latency histograms as
+  ``_bucket``/``_sum``/``_count`` series, ISSUE 15), and
   ``/healthz`` / ``/readyz`` probes; per-tenant API-key auth with
   token-bucket admission and SLO lanes drained by weighted fair
   queuing over prefill chunks. The status contract: **429** = the

@@ -139,6 +139,21 @@ the mesh, plus ``serving.prefill`` / ``serving.prefill_chunk`` /
 proposed/accepted and per-shard load args) that ``tools/trace_report.py``
 turns into prefill-vs-decode, prefill-starvation, speculation and
 shard-balance verdicts.
+
+Observability v2 (ISSUE 15): latency HISTOGRAMS recorded at the source
+(serving_first_token_ms / serving_per_token_ms / serving_queue_wait_ms
+/ serving_decode_tick_ms / serving_prefill_chunk_ms — live under the
+front end's Prometheus ``GET /metrics``); CAUSAL TRACING — a request
+submitted with ``trace=TraceContext`` stamps every span it touches
+(prefill, each chunk, each decode tick via per-request
+``serving.decode_tick`` events, the ``serving.failover_hop`` of an
+adoption, ``serving.request_done``) with its trace id + flow events,
+so one request renders as one connected chrome-trace timeline across
+replicas (``tools/trace_report.py --section request``); and the CRASH
+FLIGHT RECORDER — ``flight_dir=`` arms a process-wide bounded ring of
+recent spans/gauge deltas that ``_abort`` and the watchdog-restart
+path dump as self-contained chrome-trace files at the moment of
+failure (pod-aware naming, multi-host merge in trace_report).
 """
 from __future__ import annotations
 
@@ -160,17 +175,22 @@ from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
 from ..monitor.stats import (CONSTRAINED_FALLBACK_TICKS,
                              CONSTRAINED_REQUESTS, FAULTS_INJECTED,
                              PREFIX_COW_COPIES, SERVING_DEADLINE_SHEDS,
-                             SERVING_DECODE_MS, SERVING_EVICTIONS,
-                             SERVING_PREEMPTIONS, SERVING_PREFILL_MS,
-                             SERVING_QUEUE_DEPTH, SERVING_SHARDS,
-                             SERVING_SLOT_OCCUPANCY, SERVING_TOKENS_PER_S,
+                             SERVING_DECODE_MS, SERVING_DECODE_TICK_MS,
+                             SERVING_EVICTIONS, SERVING_FIRST_TOKEN_MS,
+                             SERVING_PER_TOKEN_MS, SERVING_PREEMPTIONS,
+                             SERVING_PREFILL_CHUNK_MS, SERVING_PREFILL_MS,
+                             SERVING_QUEUE_DEPTH, SERVING_QUEUE_WAIT_MS,
+                             SERVING_SHARDS, SERVING_SLOT_OCCUPANCY,
+                             SERVING_TOKENS_PER_S,
                              SERVING_WATCHDOG_RESTARTS,
                              SERVING_WATCHDOG_TRIPS,
                              SPEC_ACCEPTANCE_RATE, SPEC_ACCEPTED,
                              SPEC_PROPOSED)
 from ..resilience import faults as _faults
 from ..resilience.sentinel import logits_finite
-from ..monitor.trace import TRACING, get_writer, span
+from ..monitor.flight import arm_flight_recorder, dump_flight
+from ..monitor.trace import (emit_complete, emit_flow, emit_instant,
+                             recording, span)
 from .kv_cache import KVCache, PagedKVCache, cache_insert
 from .prefix_cache import RadixPrefixCache
 from .sampling import (DRAFT_SALT, sample_tokens, sample_tokens_streams,
@@ -241,10 +261,14 @@ class GenerationRequest:
         self.constraint = constraint      # ConstraintCursor (scheduler-owned)
         self.rid = 0                      # engine-assigned request id: the
         #                                   RNG stream identity (sampling.py)
+        self.trace = None                 # TraceContext (ISSUE 15) or None:
+        #                                   the request's causal identity,
+        #                                   surviving failover/rejoin hops
         self.tokens: List[int] = []       # generated ids (includes eos)
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self._cancelled = False
+        self._t_first = None              # monotonic time of the first token
         self._tokenizer = None            # set by engines with a text front end
         # paged-mode preemption: (cached-prefix tokens, last token) to
         # re-prefill from when the request is re-admitted
@@ -260,6 +284,11 @@ class GenerationRequest:
     def _push(self, tok: int) -> None:
         with self._cv:
             self.tokens.append(tok)
+            if self._t_first is None:
+                self._t_first = time.monotonic()
+                if self._t_submit:
+                    SERVING_FIRST_TOKEN_MS.observe(
+                        (self._t_first - self._t_submit) * 1e3)
             self._cv.notify_all()
 
     def _finish(self, reason: str, error: Optional[BaseException] = None):
@@ -273,11 +302,27 @@ class GenerationRequest:
                     return          # adopted: a survivor owns this now
             except BaseException:  # noqa: BLE001 — failover must never mask
                 pass               # the original error; fall through to it
+        finished = False
         with self._cv:
             if self.finish_reason is None:
                 self.finish_reason = reason
                 self.error = error
+                finished = True
             self._cv.notify_all()
+        if not finished:
+            return
+        if self._t_first is not None and len(self.tokens) >= 2:
+            # the steady-state inter-token rate the client saw, stalls
+            # and failover hops included (bench's hand-collected twin)
+            SERVING_PER_TOKEN_MS.observe(
+                (time.monotonic() - self._t_first) * 1e3
+                / (len(self.tokens) - 1))
+        if self.trace is not None and recording():
+            t = time.perf_counter()
+            emit_complete("serving.request_done", t, 0.0, cat="serving",
+                          args=self.trace.args(rid=self.rid, reason=reason,
+                                               tokens=len(self.tokens)))
+            emit_flow("f", self.trace.trace_id, t)
 
     # -- user side -----------------------------------------------------------
     @property
@@ -437,6 +482,12 @@ class InferenceEngine:
     Options: ``latency_budget_ms`` (None disables the latency rung)
     with ``latency_trips`` consecutive slow ticks per stall verdict,
     and ``max_restarts`` before the engine fails open requests loudly.
+
+    ``flight_dir`` (ISSUE 15) arms the process-wide crash flight
+    recorder (``monitor.arm_flight_recorder`` — idempotent, shared by
+    every engine in the process) and makes the scheduler-abort and
+    watchdog-restart paths dump the ring of recent spans/gauge deltas
+    there as a self-contained chrome-trace at the moment of failure.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
@@ -447,7 +498,8 @@ class InferenceEngine:
                  prefill_chunk: int = 64, tps_window_ticks: int = 64,
                  draft=None, spec_k: int = 4, mesh=None, tokenizer=None,
                  prefix_cache: Optional[bool] = None, watchdog=None,
-                 overload=None, replica_id: Optional[int] = None):
+                 overload=None, replica_id: Optional[int] = None,
+                 flight_dir: Optional[str] = None):
         # per-tick NaN/latency sentinel + auto-restart (off by default;
         # when off the engine's compiled programs are bit-identical to a
         # build without it — the health output is gated at trace time)
@@ -597,6 +649,12 @@ class InferenceEngine:
         self.overload = overload
         self.replica_id = replica_id
         self.failover = None
+        # crash flight recorder (ISSUE 15): arming is process-global and
+        # idempotent — every engine in the process shares one ring, and
+        # the abort/watchdog paths dump it the moment they fire
+        self.flight_dir = flight_dir
+        if flight_dir:
+            arm_flight_recorder(flight_dir)
         self._last_tick_t = time.monotonic()
         self._thread = threading.Thread(target=self._run,
                                         name="serving-scheduler", daemon=True)
@@ -853,7 +911,7 @@ class InferenceEngine:
                eos_id: Optional[int] = None, deadline_s: Optional[float] = None,
                block: bool = True, timeout: Optional[float] = None,
                text: Optional[str] = None,
-               constraint=None) -> GenerationRequest:
+               constraint=None, trace=None) -> GenerationRequest:
         """Queue a generation request; returns its streaming handle.
 
         Exactly one of ``prompt`` (token ids) and ``text`` must be given;
@@ -870,6 +928,12 @@ class InferenceEngine:
         sampled token through the compiled automaton — structured
         decoding; the stream finishes with ``finish_reason="stop"`` when
         the match completes.
+
+        ``trace`` (monitor.TraceContext, ISSUE 15) is the request's
+        causal tracing identity — minted at HTTP admission by the front
+        end and stamped onto every span/flow event the request touches,
+        across failover hops. It never influences sampling: with tracing
+        off the token stream is pinned bit-identical.
         """
         if text is not None:
             if prompt is not None:
@@ -914,6 +978,7 @@ class InferenceEngine:
             self.eos_id if eos_id is None else eos_id,
             None if deadline_s is None else time.monotonic() + deadline_s,
             constraint=cursor)
+        req.trace = trace
         req._tokenizer = self.tokenizer
         with self._cv:
             self._check_open()
@@ -948,6 +1013,20 @@ class InferenceEngine:
         sharing a seed, the continuation is token-identical to the run
         the dead replica would have produced. Bypasses the queue bound
         (failover must not drop work a user already holds a handle to)."""
+        if req.trace is not None:
+            # the causal timeline continues on THIS replica: record the
+            # hop so chrome-trace/request_report show one connected
+            # request across the failover instead of two half-streams
+            prev = getattr(req, "_replica", None)
+            req.trace.hop(prev, self.replica_id)
+            if recording():
+                t = time.perf_counter()
+                emit_complete(
+                    "serving.failover_hop", t, 0.0, cat="serving",
+                    args=req.trace.args(
+                        rid=req.rid, hop_from=prev,
+                        hop_to=self.replica_id))
+                emit_flow("t", req.trace.trace_id, t)
         with self._cv:
             self._check_open()
             if req.tokens:
@@ -1140,6 +1219,12 @@ class InferenceEngine:
         raise RuntimeError("InferenceEngine is shut down")
 
     def _abort(self, err: BaseException) -> None:
+        # black-box dump at the moment of death: the last ring of spans/
+        # gauge deltas, named per host so multi-host dumps merge (no-op
+        # when no flight recorder is armed; never raises)
+        dump_flight(f"engine_abort_{type(err).__name__}",
+                    extra={"replica": self.replica_id,
+                           "error": f"{type(err).__name__}: {err}"})
         with self._cv:
             # close the engine BEFORE failing requests so a racing
             # submit() cannot slip into the dead queue
@@ -1224,9 +1309,11 @@ class InferenceEngine:
                 SERVING_DEADLINE_SHEDS.add(1)
                 req._finish(DEADLINE)
                 continue
-            if self.overload is not None:
-                self.overload.observe_queue_wait(
-                    (time.monotonic() - req._t_submit) * 1e3)
+            if req._t_submit:
+                wait_ms = (time.monotonic() - req._t_submit) * 1e3
+                SERVING_QUEUE_WAIT_MS.observe(wait_ms)
+                if self.overload is not None:
+                    self.overload.observe_queue_wait(wait_ms)
             slot = self.cache.alloc(prefer_shard=shard) if paged \
                 else self.cache.alloc()
             if paged:
@@ -1390,8 +1477,13 @@ class InferenceEngine:
             req._finish(LENGTH)
             return
         t0 = time.perf_counter()
-        with span("serving.prefill", cat="serving",
-                  args={"slot": slot, "prompt_len": S}):
+        pf_args = {"slot": slot, "prompt_len": S}
+        flow = None
+        if req.trace is not None and recording():
+            pf_args.update(req.trace.args(rid=req.rid))
+            flow = req.trace.trace_id
+        with span("serving.prefill", cat="serving", args=pf_args,
+                  flow=flow):
             if native.serving_jit[0]:
                 s_pad = self._bucket(S)
                 toks = np.zeros((1, s_pad), np.int32)
@@ -1424,8 +1516,9 @@ class InferenceEngine:
                     jnp.float32(req.top_p)[None],
                     mask=jnp.asarray(self._mask_row(req)))[0]
             tok = int(tok)
-        self._note_ms(SERVING_PREFILL_MS, "_prefill_ms",
-                      (time.perf_counter() - t0) * 1e3)
+        pf_ms = (time.perf_counter() - t0) * 1e3
+        self._note_ms(SERVING_PREFILL_MS, "_prefill_ms", pf_ms)
+        SERVING_PREFILL_CHUNK_MS.observe(pf_ms)
         st = _Slot(req, length=S, last_token=tok)
         self._slots[slot] = st
         self.cache.lengths[slot] = S
@@ -1495,10 +1588,15 @@ class InferenceEngine:
             self._preempt(victim)
         last = c_true == pending.size
         t0 = time.perf_counter()
-        with span("serving.prefill_chunk", cat="serving",
-                  args={"slot": slot, "start": st.length, "chunk": c_true,
-                        "tick": self._ticks,
-                        "open_streams": self._open_decode_streams()}):
+        ck_args = {"slot": slot, "start": st.length, "chunk": c_true,
+                   "tick": self._ticks,
+                   "open_streams": self._open_decode_streams()}
+        flow = None
+        if st.req.trace is not None and recording():
+            ck_args.update(st.req.trace.args(rid=st.req.rid))
+            flow = st.req.trace.trace_id
+        with span("serving.prefill_chunk", cat="serving", args=ck_args,
+                  flow=flow):
             toks = np.zeros((1, c_pad), np.int32)
             toks[0, :c_true] = pending[:c_true]
             row = self.cache.table_row(slot)[:self._width_bucket(
@@ -1520,8 +1618,9 @@ class InferenceEngine:
                     self._params, self.cache.kb, self.cache.vb,
                     jnp.asarray(row), jnp.asarray(toks),
                     np.int32(st.length))
-        self._note_ms(SERVING_PREFILL_MS, "_prefill_ms",
-                      (time.perf_counter() - t0) * 1e3)
+        ck_ms = (time.perf_counter() - t0) * 1e3
+        self._note_ms(SERVING_PREFILL_MS, "_prefill_ms", ck_ms)
+        SERVING_PREFILL_CHUNK_MS.observe(ck_ms)
         st.length += c_true
         self.cache.lengths[slot] = st.length
         st.pending = None if last else pending[c_true:]
@@ -1778,6 +1877,7 @@ class InferenceEngine:
                                                for s in active))
         tick_ms = (time.perf_counter() - t0) * 1e3
         self._note_ms(SERVING_DECODE_MS, "_decode_ms", tick_ms)
+        SERVING_DECODE_TICK_MS.observe(tick_ms)
         if self.overload is not None:
             self.overload.observe_tick(tick_ms)
         if self._watchdog is not None:
@@ -1793,10 +1893,12 @@ class InferenceEngine:
             self._watchdog_latency(tick_ms)
 
         emitted = 0
+        traced = []       # (req, tokens pushed) for per-request tick events
         for s in active:
             st = self._slots[s]
             burst = [int(out[s])] if n_emit is None \
                 else [int(t) for t in out[s, :int(n_emit[s])]]
+            pushed = 0
             for tok in burst:
                 st.length += 1
                 st.generated += 1
@@ -1804,10 +1906,27 @@ class InferenceEngine:
                 self.cache.lengths[s] = st.length
                 st.req._push(tok)
                 emitted += 1
+                pushed += 1
                 reason = self._finish_reason(st, tok)
                 if reason is not None:
                     self._evict(s, reason)
                     break
+            if st.req.trace is not None:
+                traced.append((st.req, pushed))
+        if traced and recording():
+            # one per-request decode-tick event per traced participant:
+            # the causal twin of the BATCHED serving.decode_step span,
+            # letting request_report/chrome attribute this tick's time
+            # to each request riding it (gated — no cost untraced)
+            dur = tick_ms / 1e3
+            for req, n_toks in traced:
+                rq_args = req.trace.args(rid=req.rid, tokens=n_toks,
+                                         tick=self._ticks)
+                if self.replica_id is not None:
+                    rq_args["replica"] = self.replica_id
+                emit_complete("serving.decode_tick", t0, dur,
+                              cat="serving", args=rq_args)
+                emit_flow("t", req.trace.trace_id, t0)
         if use_spec:
             self._note_spec(self.spec_k * len(active),
                             int(sum(int(n_emit[s]) - 1 for s in active)))
@@ -1910,9 +2029,9 @@ class InferenceEngine:
         if self._slow_ticks >= int(self._watchdog["latency_trips"]):
             self._slow_ticks = 0
             SERVING_WATCHDOG_TRIPS.add()
-            if TRACING[0]:
-                get_writer().add_instant("serving.watchdog_stall",
-                                         time.perf_counter(), cat="serving")
+            if recording():
+                emit_instant("serving.watchdog_stall", time.perf_counter(),
+                             cat="serving")
 
     def _watchdog_restart(self, poisoned: List[int]) -> None:
         """Engine auto-restart from the last healthy state: fail ONLY the
@@ -1925,11 +2044,16 @@ class InferenceEngine:
         self._restarts += 1
         if self._restarts > int(self._watchdog["max_restarts"]):
             # the last rung: a persistently-poisoned engine fails loudly
-            # (scheduler _abort fails every open request with this cause)
+            # (scheduler _abort fails every open request with this cause;
+            # _abort also writes the flight dump)
             raise WatchdogTripped(
                 f"watchdog restart budget exhausted "
                 f"(max_restarts={self._watchdog['max_restarts']})")
         SERVING_WATCHDOG_RESTARTS.add()
+        dump_flight("serving_watchdog_restart",
+                    extra={"replica": self.replica_id,
+                           "poisoned": sorted(poisoned),
+                           "restart": self._restarts})
         bad = set(poisoned)
         healthy = sorted(
             ((st.admit_order, s) for s, st in enumerate(self._slots)

@@ -25,8 +25,20 @@ Routes:
   shared system prompt is a shared PREFIX the radix cache serves from
   blocks; SSE deltas when streaming;
 - ``GET /v1/models`` — the single served model;
-- ``GET /metrics`` — the StatRegistry dump, one
-  ``paddle_tpu_<gauge> <value>`` line each (Prometheus text format).
+- ``GET /metrics`` — real Prometheus text exposition (ISSUE 15): every
+  StatRegistry gauge with ``# HELP``/``# TYPE`` and sanitized names,
+  every latency histogram (first-token, per-token, queue wait, decode
+  tick, prefill chunk — recorded at the source) as cumulative
+  ``_bucket{le=...}``/``_sum``/``_count`` series; rendered from
+  registry snapshots so a scrape never blocks a scheduler tick.
+
+Causal tracing (ISSUE 15): every generation request gets a
+``monitor.TraceContext`` minted at admission; the flow-START event,
+the WFQ ``frontend.queue_wait`` span and every downstream engine span
+(prefill chunks, decode ticks, failover hops, completion) carry its
+trace id, so chrome-trace renders one connected timeline per request
+and ``tools/trace_report.py --section request`` prints the critical
+path. Tracing off = token streams pinned bit-identical.
 
 Tenancy & SLO scheduling: every request authenticates with
 ``Authorization: Bearer <api-key>`` against a :class:`Tenant` table.
@@ -93,8 +105,10 @@ import numpy as np
 from ..monitor.stats import (FAULTS_INJECTED, FRONTEND_429S,
                              FRONTEND_ACTIVE_STREAMS, FRONTEND_LOAD_SHEDS,
                              FRONTEND_QUEUE_WAIT_MS, FRONTEND_REQUESTS,
-                             stat_get, stat_snapshot)
-from ..monitor.trace import span
+                             SERVING_QUEUE_WAIT_MS, prometheus_text,
+                             stat_get)
+from ..monitor.trace import emit_complete, emit_flow, recording, span
+from ..monitor.tracectx import mint_trace
 from ..resilience import faults as _faults
 from .constrained import compile_constraint
 from .engine import QueueFull
@@ -380,6 +394,7 @@ class ServingFrontend:
                     job.future.set_exception(e)
                 continue
             FRONTEND_QUEUE_WAIT_MS.add(int(wait_ms))
+            SERVING_QUEUE_WAIT_MS.observe(wait_ms)
             if not job.future.done():
                 job.future.set_result((req, wait_ms))
 
@@ -502,10 +517,13 @@ class ServingFrontend:
         return 200
 
     async def _metrics(self, writer) -> int:
-        lines = [f"paddle_tpu_{name} {value}"
-                 for name, value in stat_snapshot().items()
-                 if "." not in name]      # per-axis gauges need escaping
-        payload = ("\n".join(lines) + "\n").encode("utf-8")
+        """Prometheus text exposition 0.0.4 (ISSUE 15): every gauge with
+        ``# HELP``/``# TYPE`` and sanitized names (the per-axis ``.``
+        gauges become ``_``), every latency histogram as cumulative
+        ``_bucket{le=...}``/``_sum``/``_count`` series. Renders from
+        registry snapshots on the loop thread — the scrape never touches
+        engine state, so it cannot block a scheduler tick."""
+        payload = prometheus_text().encode("utf-8")
         writer.write(self._head(200, {
             "Content-Type": "text/plain; version=0.0.4",
             "Content-Length": str(len(payload)),
@@ -671,6 +689,20 @@ class ServingFrontend:
             deadline_t = time.monotonic() + float(body["deadline_s"])
         if kwargs["constraint"] is None:
             kwargs["eos_id"] = self.engine.tokenizer.eos_id
+        # causal tracing (ISSUE 15): mint the request's trace context at
+        # HTTP admission — the flow-START anchor every downstream span
+        # (lane wait, prefill chunks, decode ticks, failover hops) chains
+        # from. Minting never touches sampling: tracing-off token
+        # streams are pinned bit-identical.
+        ctx = mint_trace()
+        kwargs["trace"] = ctx
+        if recording():
+            t = time.perf_counter()
+            emit_flow("s", ctx.trace_id, t)
+            emit_complete("frontend.admission", t, 0.0, cat="frontend",
+                          args=ctx.args(tenant=tenant.name,
+                                        lane=tenant.lane,
+                                        prompt_tokens=int(prompt_ids.size)))
         cost = max(1.0, -(-int(prompt_ids.size) // self._chunk))
         fut = asyncio.get_running_loop().create_future()
         self._wfq.put(tenant.lane, cost,
@@ -684,10 +716,13 @@ class ServingFrontend:
         except _Shed as e:
             FRONTEND_LOAD_SHEDS.add(1)
             raise _HttpError(503, str(e), headers={"Retry-After": "1"})
-        with span("frontend.queue_wait", cat="frontend",
-                  args={"tenant": tenant.name, "lane": tenant.lane,
-                        "wait_ms": wait_ms,
-                        "prompt_tokens": int(prompt_ids.size)}):
+        qw_args = {"tenant": tenant.name, "lane": tenant.lane,
+                   "wait_ms": wait_ms,
+                   "prompt_tokens": int(prompt_ids.size)}
+        if recording():
+            qw_args.update(ctx.args())
+        with span("frontend.queue_wait", cat="frontend", args=qw_args,
+                  flow=ctx.trace_id):
             pass
         rid = f"cmpl-{uuid.uuid4().hex[:20]}"
         created = int(datetime.now(timezone.utc).timestamp())

@@ -82,6 +82,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..monitor.flight import dump_flight
 from ..monitor.stats import (FAULTS_INJECTED, PREFIX_WARM_TOKENS,
                              SERVING_REPLICA_RESTARTS, SERVING_REPLICAS_TARGET,
                              SERVING_SCALE_EVENTS)
@@ -323,6 +324,12 @@ class ReplicaSupervisor:
                   args={"replica": rid, "attempts": st.attempts,
                         "cause": st.cause}):
             pass
+        # give-up is a capacity-down page: dump the flight ring so the
+        # on-call human gets the last seconds of fleet history with the
+        # alert (no-op when no recorder is armed)
+        dump_flight(f"lifecycle_give_up_r{rid}",
+                    extra={"replica": rid, "attempts": st.attempts,
+                           "cause": str(st.cause)})
         self.router.fail_orphans(ReplicaFailed(
             f"replica {rid} gave up after {st.attempts} restart(s) "
             f"(max_restarts={self.max_restarts}; last cause: {st.cause})"))

@@ -62,7 +62,7 @@ import time
 from typing import Optional
 
 from ..monitor.stats import BROWNOUT_RUNG, BROWNOUT_STEPS
-from ..monitor.trace import TRACING, get_writer
+from ..monitor.trace import emit_complete, emit_instant, recording
 
 __all__ = ["OverloadController", "RUNG_NAMES", "RUNG_HEALTHY",
            "RUNG_NO_SPEC", "RUNG_SMALL_CHUNKS", "RUNG_CAPPED_TOKENS",
@@ -205,18 +205,17 @@ class OverloadController:
         self._rung_since = time.monotonic()
         BROWNOUT_RUNG.set(self._rung)
         BROWNOUT_STEPS.add(1)
-        if TRACING[0]:
-            w = get_writer()
-            w.add_instant("serving.brownout", time.perf_counter(),
-                          cat="serving")
+        if recording():
+            emit_instant("serving.brownout", time.perf_counter(),
+                         cat="serving")
             # instants carry no args in the writer API — follow with a
             # zero-duration span so the report gets the rung/pressure
             t = time.perf_counter()
-            w.add_complete("serving.brownout_step", t, 0.0, cat="serving",
-                           args={"rung": self._rung,
-                                 "rung_name": RUNG_NAMES[self._rung],
-                                 "from": prev,
-                                 "pressure": round(pressure, 4)})
+            emit_complete("serving.brownout_step", t, 0.0, cat="serving",
+                          args={"rung": self._rung,
+                                "rung_name": RUNG_NAMES[self._rung],
+                                "from": prev,
+                                "pressure": round(pressure, 4)})
 
     def force_rung(self, rung: int) -> None:
         """Operator/test hook: pin the ladder to a rung (the controller

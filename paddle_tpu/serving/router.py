@@ -75,7 +75,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..monitor.stats import ROUTER_FAILOVERS, SERVING_REPLICAS_HEALTHY
-from ..monitor.trace import TRACING, get_writer
+from ..monitor.trace import emit_complete, recording
 
 __all__ = ["EngineRouter"]
 
@@ -445,8 +445,8 @@ class EngineRouter:
                 self._purge_affinity(replica)
         if first:
             SERVING_REPLICAS_HEALTHY.set(len(self.healthy_replicas()))
-            if TRACING[0]:
-                get_writer().add_complete(
+            if recording():
+                emit_complete(
                     "router.replica_down", time.perf_counter(), 0.0,
                     cat="serving",
                     args={"replica": replica,

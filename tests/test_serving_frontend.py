@@ -516,15 +516,23 @@ class TestFrontendHttp:
         status, headers, data = _call(frontend, "GET", "/metrics")
         assert status == 200
         assert headers["Content-Type"].startswith("text/plain")
-        lines = data.decode().splitlines()
-        names = {line.split()[0] for line in lines}
+        # Prometheus text exposition (ISSUE 15): samples carry HELP/TYPE
+        # metadata lines and histograms ride along — parse accordingly
+        samples = [line for line in data.decode().splitlines()
+                   if line and not line.startswith("#")]
+        names = {line.split()[0] for line in samples}
         for gauge in ("paddle_tpu_frontend_requests",
                       "paddle_tpu_prefix_hit_rate",
                       "paddle_tpu_serving_tokens_per_s",
                       "paddle_tpu_frontend_429s"):
             assert gauge in names
-        got = {line.split()[0]: int(line.split()[1]) for line in lines}
+            assert f"# TYPE {gauge} gauge" in data.decode()
+        got = {line.split()[0]: float(line.split()[1]) for line in samples}
         assert got["paddle_tpu_frontend_requests"] >= 1
+        # the source-recorded histograms are scrapeable series now
+        assert got["paddle_tpu_serving_first_token_ms_count"] >= 1
+        assert any(n.startswith(
+            "paddle_tpu_serving_first_token_ms_bucket") for n in names)
 
     def test_wfq_prefers_gold_under_contention(self, frontend):
         """Weighted fair queuing: with both lanes loaded, gold's higher

@@ -1,28 +1,77 @@
-"""Chrome-trace hot-span report: load a trace-event JSON (as written by
-paddle_tpu.profiler / monitor.trace.TraceWriter, or any chrome://tracing
-export) and print the top-N spans by total time — so CI and bench rounds
-can diff hot paths without TensorBoard.
+"""Chrome-trace analysis reports: load one or more trace-event JSON
+files (as written by paddle_tpu.profiler / monitor.trace.TraceWriter /
+the crash flight recorder, or any chrome://tracing export) and print the
+hot-span table plus every section report the events support — so CI and
+bench rounds can diff hot paths without TensorBoard.
 
-    python tools/trace_report.py /path/to/paddle_tpu_trace.json [--top 20]
+    python -m tools.trace_report trace.json [more.json ...]
+        [--top 20] [--json] [--section NAME]
+
+One CLI fronts every report (ISSUE 15 satellite — previously ~10
+per-subsystem entry points): ``--section NAME`` prints just that
+section (``--list-sections`` enumerates them), ``--json`` emits one
+machine-readable object ``{section: result, ...}`` for CI consumption,
+and MULTIPLE trace files merge into one timeline — flight-recorder
+dumps from different hosts get distinct synthetic pids (named per host)
+so a pod-wide failure reads as one chrome-loadable merged trace.
 
 Handles both "X" (complete) events and matched "B"/"E" pairs; events come
-either as a bare list or under the {"traceEvents": [...]} envelope.
+either as a bare list or under the {"traceEvents": [...]} envelope
+(flight dumps additionally carry their summary under a "flight" key).
 """
 from __future__ import annotations
 
 import argparse
+import io
 import json
 import sys
 
 
-def load_events(path: str) -> list:
+def load_trace(path: str) -> dict:
+    """One file -> {"path", "events", "flight" (summary dict or None)}."""
     with open(path) as f:
         data = json.load(f)
     events = data.get("traceEvents", []) if isinstance(data, dict) else data
     if not isinstance(events, list):
         raise ValueError(f"{path}: not a chrome-trace file "
                          "(expected a list or a traceEvents envelope)")
-    return events
+    flight = data.get("flight") if isinstance(data, dict) else None
+    return {"path": path, "events": events, "flight": flight}
+
+
+def load_events(path: str) -> list:
+    return load_trace(path)["events"]
+
+
+def merge_traces(traces: list) -> list:
+    """Merge several loaded traces into one event list. Every (file,
+    pid) pair gets a DISTINCT synthetic pid — two hosts' flight dumps
+    (or two simulated hosts in one process, sharing a real pid) land in
+    separate process lanes — and a process_name metadata row names each
+    lane after the dump's host id. Timestamps share the perf_counter
+    timeline per host and are left untouched."""
+    if len(traces) == 1 and traces[0]["flight"] is None:
+        return list(traces[0]["events"])
+    out = []
+    next_pid = 1
+    for tr in traces:
+        host = (tr["flight"] or {}).get("host")
+        pid_map: dict = {}
+        for ev in tr["events"]:
+            if ev.get("ph") == "M":
+                continue        # re-emitted below with the merged pid
+            pid = ev.get("pid", 0)
+            if pid not in pid_map:
+                pid_map[pid] = next_pid
+                next_pid += 1
+            ev = dict(ev)
+            ev["pid"] = pid_map[pid]
+            out.append(ev)
+        for pid, mapped in pid_map.items():
+            label = f"{host} pid={pid}" if host else f"pid={pid}"
+            out.append({"name": "process_name", "ph": "M", "pid": mapped,
+                        "args": {"name": label}})
+    return out
 
 
 def aggregate(events: list) -> list:
@@ -744,6 +793,134 @@ def resilience_report(events: list, rows: list, file=None,
     return out
 
 
+def request_report(events: list, file=None, top: int = 5) -> dict:
+    """Per-request critical path from the causal trace context
+    (ISSUE 15).
+
+    Every span a request touches is stamped with its ``trace`` id:
+    ``frontend.admission`` (the clock start), ``frontend.queue_wait``
+    (WFQ lane wait in ``wait_ms``), ``serving.prefill`` /
+    ``serving.prefill_chunk`` (prompt work), ``serving.decode_tick``
+    (this request's share of each batched decode tick),
+    ``serving.failover_hop`` (replica hops survived) and
+    ``serving.request_done`` (the clock stop + finish reason). Grouped
+    by trace id they answer THE latency question — where did this
+    request's time go: lane wait, prefill, decode, or unattributed
+    STALL (scheduler queueing between ticks, failover gaps) — and the
+    slowest-N breakdown says whether the tail is an admission problem
+    or a decode problem."""
+    traces: dict = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace")
+        if tid is not None:
+            traces.setdefault(tid, []).append(e)
+    if not traces:
+        return {}
+    rows = []
+    for tid, evs in traces.items():
+        evs.sort(key=lambda e: float(e.get("ts", 0)))
+        a_of = lambda e: e.get("args") or {}      # noqa: E731
+        done = [e for e in evs if e["name"] == "serving.request_done"]
+        t0 = float(evs[0]["ts"])
+        t1 = float(done[-1]["ts"]) if done else max(
+            float(e.get("ts", 0)) + float(e.get("dur", 0)) for e in evs)
+        lane_ms = sum(float(a_of(e).get("wait_ms", 0.0)) for e in evs
+                      if e["name"] == "frontend.queue_wait")
+        prefill_ms = sum(float(e.get("dur", 0)) for e in evs
+                         if e["name"] in ("serving.prefill",
+                                          "serving.prefill_chunk")) / 1e3
+        decode_ms = sum(float(e.get("dur", 0)) for e in evs
+                        if e["name"] == "serving.decode_tick") / 1e3
+        hops = [e for e in evs if e["name"] == "serving.failover_hop"]
+        total_ms = (t1 - t0) / 1e3
+        stall_ms = max(0.0, total_ms - lane_ms - prefill_ms - decode_ms)
+        phases = {"lane_wait": lane_ms, "prefill": prefill_ms,
+                  "decode": decode_ms, "stall": stall_ms}
+        replicas = sorted({a_of(e)["replica"] for e in evs
+                           if a_of(e).get("replica") is not None})
+        rows.append({
+            "trace": tid, "total_ms": round(total_ms, 3),
+            "lane_wait_ms": round(lane_ms, 3),
+            "prefill_ms": round(prefill_ms, 3),
+            "decode_ms": round(decode_ms, 3),
+            "stall_ms": round(stall_ms, 3),
+            "decode_ticks": sum(1 for e in evs
+                                if e["name"] == "serving.decode_tick"),
+            "prefill_chunks": sum(1 for e in evs
+                                  if e["name"] == "serving.prefill_chunk"),
+            "hops": len(hops),
+            "hop_path": [(a_of(e).get("hop_from"), a_of(e).get("hop_to"))
+                         for e in hops],
+            "replicas": replicas,
+            "tokens": a_of(done[-1]).get("tokens") if done else None,
+            "finish": a_of(done[-1]).get("reason") if done else None,
+            "critical_phase": max(phases, key=phases.get),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    n = len(rows)
+    agg = {k: sum(r[k] for r in rows)
+           for k in ("lane_wait_ms", "prefill_ms", "decode_ms", "stall_ms")}
+    total = sum(agg.values()) or 1.0
+    worst = rows[0]
+    out = {"requests": n, "completed": sum(1 for r in rows if r["finish"]),
+           "failovers_survived": sum(r["hops"] for r in rows),
+           "phase_fractions": {k: round(v / total, 4)
+                               for k, v in agg.items()},
+           "slowest": rows[:top]}
+    out["verdict"] = (
+        f"{n} traced request(s); slowest spent "
+        f"{worst['total_ms']:.1f}ms, dominated by {worst['critical_phase']}"
+        + (f", surviving {worst['hops']} failover hop(s) across replicas "
+           f"{worst['replicas']}" if worst["hops"] else "")
+        + "; fleet-wide split "
+        + ", ".join(f"{k} {v:.0%}"
+                    for k, v in out["phase_fractions"].items()))
+    print("\nRequest critical paths (slowest first):", file=file)
+    print(f"  {'trace':<16}{'total':>9}{'lane':>8}{'prefill':>9}"
+          f"{'decode':>8}{'stall':>8}{'hops':>6}  finish", file=file)
+    for r in rows[:top]:
+        print(f"  {r['trace']:<16x}{r['total_ms']:>9.1f}"
+              f"{r['lane_wait_ms']:>8.1f}{r['prefill_ms']:>9.1f}"
+              f"{r['decode_ms']:>8.1f}{r['stall_ms']:>8.1f}"
+              f"{r['hops']:>6}  {r['finish']}", file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
+def flight_report(flights: list, file=None) -> dict:
+    """Flight-recorder dump summaries (ISSUE 15): one row per dump —
+    host, reason, event count, the gauge highlights an on-call human
+    triages by — plus a merged verdict when dumps from several hosts
+    were loaded together."""
+    flights = [f for f in flights if f]
+    if not flights:
+        return {}
+    rows = []
+    for fl in flights:
+        g = fl.get("gauges", {})
+        rows.append({
+            "host": fl.get("host", "?"), "pid": fl.get("pid"),
+            "reason": fl.get("reason", "?"), "events": fl.get("events", 0),
+            "watchdog_trips": g.get("serving_watchdog_trips", 0),
+            "restarts": g.get("serving_replica_restarts", 0),
+            "failovers": g.get("router_failovers", 0),
+            "rollbacks": g.get("rollbacks", 0),
+        })
+    hosts = sorted({r["host"] for r in rows})
+    out = {"dumps": rows, "hosts": hosts}
+    out["verdict"] = (
+        f"{len(rows)} flight dump(s) from host(s) {hosts}: "
+        + "; ".join(f"{r['host']} dumped on '{r['reason']}' with "
+                    f"{r['events']} ring event(s)" for r in rows))
+    print("\nFlight recorder:", file=file)
+    for r in rows:
+        print(f"  {r['host']:<8}pid={r['pid']:<8}{r['reason']:<36}"
+              f"events={r['events']:<6}failovers={r['failovers']} "
+              f"restarts={r['restarts']}", file=file)
+    print(f"  verdict: {out['verdict']}", file=file)
+    return out
+
+
 def report(rows: list, top: int = 20, file=None) -> list:
     rows = rows[:top]
     if not rows:
@@ -758,27 +935,80 @@ def report(rows: list, top: int = 20, file=None) -> list:
     return rows
 
 
+# the one CLI's section registry (ISSUE 15 satellite): name ->
+# callable(ctx, file) -> result. ``ctx`` carries events/rows/top/flights
+# so each section keeps its historical function signature for direct
+# callers (tests, bench) while the CLI drives them uniformly.
+SECTIONS = {
+    "spans": lambda c, f: report(c["rows"], c["top"], file=f),
+    "input_pipeline": lambda c, f: input_pipeline_report(c["rows"], file=f),
+    "overlap": lambda c, f: overlap_report(c["rows"], file=f),
+    "serving": lambda c, f: serving_report(c["rows"], file=f,
+                                           events=c["events"]),
+    "spec": lambda c, f: spec_report(c["events"], file=f),
+    "shard_balance": lambda c, f: shard_balance_report(c["events"], file=f),
+    "frontend": lambda c, f: frontend_report(c["events"], file=f),
+    "overload": lambda c, f: overload_report(c["events"], file=f),
+    "lifecycle": lambda c, f: lifecycle_report(c["events"], file=f),
+    "resilience": lambda c, f: resilience_report(c["events"], c["rows"],
+                                                 file=f),
+    "recompile": lambda c, f: recompile_report(c["events"], file=f),
+    "pipeline": lambda c, f: pipeline_report(c["events"], file=f),
+    "request": lambda c, f: request_report(c["events"], file=f,
+                                           top=c["top"]),
+    "flight": lambda c, f: flight_report(c["flights"], file=f),
+}
+
+
+def run_sections(events: list, top: int = 20, flights: list | None = None,
+                 sections=None, file=None) -> dict:
+    """Run the requested (default: all) sections over one merged event
+    list; returns {section: result} with empty sections dropped."""
+    ctx = {"events": events, "rows": aggregate(events), "top": top,
+           "flights": flights or []}
+    out = {}
+    for name in (sections or SECTIONS):
+        if name not in SECTIONS:
+            raise KeyError(f"unknown section {name!r} "
+                           f"(choose from {sorted(SECTIONS)})")
+        result = SECTIONS[name](ctx, file)
+        if result:
+            out[name] = result
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="chrome-trace JSON file")
+    ap.add_argument("trace", nargs="*",
+                    help="chrome-trace JSON file(s); several (e.g. "
+                         "per-host flight dumps) merge into one timeline")
     ap.add_argument("--top", type=int, default=20,
-                    help="number of spans to print (by total time)")
+                    help="number of spans/requests to print (by total "
+                         "time)")
+    ap.add_argument("--section", action="append", default=None,
+                    metavar="NAME",
+                    help="print only this section (repeatable; default "
+                         "all) — see --list-sections")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable {section: result} on stdout "
+                         "(for CI consumption)")
+    ap.add_argument("--list-sections", action="store_true")
     args = ap.parse_args(argv)
-    events = load_events(args.trace)
-    rows = aggregate(events)
-    report(rows, args.top)
-    input_pipeline_report(rows)
-    overlap_report(rows)
-    serving_report(rows, events=events)
-    spec_report(events)
-    shard_balance_report(events)
-    frontend_report(events)
-    overload_report(events)
-    lifecycle_report(events)
-    resilience_report(events, rows)
-    recompile_report(events)
-    pipeline_report(events)
-    return rows
+    if args.list_sections:
+        for name in SECTIONS:
+            print(name)
+        return {}
+    if not args.trace:
+        ap.error("at least one trace file is required")
+    traces = [load_trace(p) for p in args.trace]
+    events = merge_traces(traces)
+    flights = [t["flight"] for t in traces if t["flight"]]
+    sink = io.StringIO() if args.as_json else None
+    out = run_sections(events, top=args.top, flights=flights,
+                       sections=args.section, file=sink)
+    if args.as_json:
+        print(json.dumps(out, indent=2, default=str))
+    return out
 
 
 if __name__ == "__main__":
