@@ -65,6 +65,14 @@ class ModelStats:
     act_dtype_bytes: int = 4
     opt_state_bytes_per_param: int = 8   # AdamW fp32 m+v
     grad_dtype_bytes: int = 4
+    # embedding-table placement term (paddle_tpu.sparse): the table is
+    # NOT part of param_bytes — it follows its own rules (replicates, or
+    # row-shards over "model"; sparse grads are SelectedRows-bounded, so
+    # no dense grad or full-row optimizer traffic). Zero rows = no table.
+    table_rows: int = 0             # logical rows of the sharded table(s)
+    table_dim: int = 0              # embedding width
+    table_dtype_bytes: int = 4
+    table_lookups_per_sample: int = 0   # ids resolved per sample per step
 
     @classmethod
     def from_params(cls, params, specs=None, layers: Optional[int] = None,
@@ -176,8 +184,13 @@ def enumerate_plans(n_devices: int, global_batch: int,
                 continue
             if mp > 1 and not allow_mp:
                 continue
-            if mp > 1 and stats.hidden and stats.hidden % mp != 0:
+            # hidden divisibility binds only the TP-annotated matmuls;
+            # a row-sharded embedding table has no such constraint
+            if mp > 1 and stats.tp_bytes and stats.hidden \
+                    and stats.hidden % mp != 0:
                 continue
+            if mp > 1 and stats.table_rows and stats.table_rows < mp:
+                continue  # fewer rows than shards: empty shards
             rest = n_devices // (pp * mp)
             for sh in _divisors(rest):
                 if cons.get("sharding", sh) != sh:
@@ -242,9 +255,26 @@ def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
     act += micro_bs * stats.seq_len * act_token_bytes * \
         (2 if c.remat else max(stats.layers // c.pp, 1))
 
-    hbm = int(params + grads + opt + act)
+    # embedding-table placement (paddle_tpu.sparse): storage + moments
+    # row-shard over "model" (mod-sharding — mp=1 means fully
+    # replicated); the gradient never densifies, it is bounded by the
+    # batch's touched rows (SelectedRows semantics)
+    table = 0.0
+    batch_ids = stats.table_lookups_per_sample * \
+        max(global_batch // (c.dp * c.sharding), 1)
+    if stats.table_rows and stats.table_dim:
+        table_bytes = stats.table_rows * stats.table_dim * \
+            stats.table_dtype_bytes
+        table = table_bytes / c.mp
+        table += (stats.table_rows * stats.table_dim *
+                  stats.opt_state_bytes_per_param) / c.mp
+        touched = min(batch_ids, stats.table_rows)
+        table += touched * stats.table_dim * stats.grad_dtype_bytes
+
+    hbm = int(params + grads + opt + act + table)
     c.hbm_detail = {"params": int(params), "grads": int(grads),
-                    "opt_state": int(opt), "activations": int(act)}
+                    "opt_state": int(opt), "activations": int(act),
+                    "table": int(table)}
     c.hbm_bytes = hbm
     budget = int(hw.hbm_bytes * hw.hbm_fudge)
     c.fits = hbm <= budget
@@ -279,10 +309,22 @@ def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
     if c.pp > 1:
         # stage-boundary activation rotate, fwd + bwd, per microbatch tick
         coll += 2.0 * c.n_micro * micro_bs * stats.seq_len * act_token_bytes
+    if c.mp > 1 and stats.table_rows and stats.table_dim:
+        # sharded-lookup all-to-all: each off-shard id ships 4 bytes of
+        # id out and dim * dtype bytes of vector back (sparse/embedding.
+        # exchange_bytes), twice per step (forward lookup + the grad
+        # rows routed home)
+        coll += 2.0 * batch_ids * \
+            (4 + stats.table_dim * stats.table_dtype_bytes) * \
+            (c.mp - 1) / c.mp
     c.coll_bytes = int(coll)
 
+    # mp splits dense compute only when matmuls are TP-annotated; a
+    # table-only "model" axis (row-sharded embeddings) leaves the dense
+    # math replicated
+    mp_compute = c.mp if stats.tp_bytes else 1
     flops = 6.0 * stats.n_params * (global_batch * stats.seq_len) \
-        / (c.dp * c.sharding * c.mp * c.pp)
+        / (c.dp * c.sharding * mp_compute * c.pp)
     t_compute = flops / hw.peak_flops
     t = t_compute / max(1e-9, 1.0 - c.bubble_frac) + coll / hw.ici_bandwidth
     c.score = t

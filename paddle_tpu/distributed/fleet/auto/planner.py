@@ -124,6 +124,8 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
          hardware: Optional[HardwareSpec] = None,
          param_specs=None, layers: Optional[int] = None,
          seq_len: int = 1, hidden: int = 0,
+         table_rows: int = 0, table_dim: int = 0,
+         table_lookups_per_sample: int = 0,
          allow_mp: Optional[bool] = None,
          zero_levels=(0, 1, 2, 3), max_micro: int = 64,
          constraints: Optional[Dict[str, int]] = None,
@@ -142,11 +144,18 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
         stats = ModelStats.from_params(params, specs=param_specs,
                                        layers=layers, hidden=hidden,
                                        seq_len=seq_len)
+    if table_rows:
+        # embedding-table placement term (paddle_tpu.sparse): the table
+        # rides its own ModelStats fields, never param_bytes
+        stats = dataclasses.replace(
+            stats, table_rows=int(table_rows), table_dim=int(table_dim),
+            table_lookups_per_sample=int(table_lookups_per_sample))
     if n_devices is None:
         n_devices = len(jax.devices())
     hw = hardware or HardwareSpec()
     if allow_mp is None:
-        allow_mp = stats.tp_bytes > 0
+        # TP-annotated matmuls or a row-shardable table both legalise mp
+        allow_mp = stats.tp_bytes > 0 or stats.table_rows > 0
 
     cands = enumerate_plans(n_devices, global_batch, stats,
                             zero_levels=zero_levels, allow_mp=allow_mp,

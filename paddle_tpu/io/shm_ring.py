@@ -114,11 +114,22 @@ class ShmRing:
 # -- batch <-> slot encoding ------------------------------------------------
 #
 # The skeleton mirrors the batch pytree with every ndarray leaf replaced by
-# ("__shm__", offset, shape, dtype_str); scalars ride along inline. A
-# non-encodable leaf aborts the attempt (caller falls back to pickle).
+# ("__shm__", offset, shape, dtype_str); scalars ride along inline. A list
+# of 1-D integer arrays (a CTR batch's ragged per-slot id lists) flattens
+# to ONE offsets array + ONE values array — ("__shm_ragged__", kind,
+# off_offsets, n_arrays, off_values, total, dtype_str) — two aligned
+# copies instead of n tiny ones. A non-encodable leaf aborts the attempt
+# (caller falls back to pickle, byte-identical to the pipe transport).
 
 class _NotShmable(Exception):
     pass
+
+
+def _ragged_candidate(tree) -> bool:
+    return (len(tree) >= 2
+            and all(isinstance(v, np.ndarray) and v.ndim == 1
+                    and v.dtype.kind in "iu" for v in tree)
+            and len({v.dtype for v in tree}) == 1)
 
 
 def _plan(tree, offset: int) -> Tuple[Any, int, List[Tuple[int, np.ndarray]]]:
@@ -129,6 +140,18 @@ def _plan(tree, offset: int) -> Tuple[Any, int, List[Tuple[int, np.ndarray]]]:
         return (("__shm__", off, tree.shape, tree.dtype.str),
                 off + tree.nbytes, [(off, tree)])
     if isinstance(tree, (list, tuple)):
+        if _ragged_candidate(tree):
+            offsets = np.zeros(len(tree) + 1, np.int64)
+            np.cumsum([len(v) for v in tree], out=offsets[1:])
+            values = np.concatenate(tree)
+            off_o = (offset + _ALIGN - 1) // _ALIGN * _ALIGN
+            off_v = (off_o + offsets.nbytes + _ALIGN - 1) \
+                // _ALIGN * _ALIGN
+            kind = "tuple" if isinstance(tree, tuple) else "list"
+            return (("__shm_ragged__", kind, off_o, len(tree), off_v,
+                     int(offsets[-1]), values.dtype.str),
+                    off_v + values.nbytes,
+                    [(off_o, offsets), (off_v, values)])
         out, writes = [], []
         for v in tree:
             sk, offset, w = _plan(v, offset)
@@ -169,6 +192,14 @@ def _decode(skeleton, buf):
         _, off, shape, dtype = skeleton
         src = np.ndarray(shape, np.dtype(dtype), buffer=buf, offset=off)
         return src.copy()
+    if isinstance(skeleton, tuple) and len(skeleton) == 7 \
+            and skeleton[0] == "__shm_ragged__":
+        _, kind, off_o, n, off_v, total, dtype = skeleton
+        offs = np.ndarray((n + 1,), np.int64, buffer=buf, offset=off_o)
+        vals = np.ndarray((total,), np.dtype(dtype), buffer=buf,
+                          offset=off_v)
+        out = [vals[offs[i]:offs[i + 1]].copy() for i in range(n)]
+        return tuple(out) if kind == "tuple" else out
     if isinstance(skeleton, (list, tuple)):
         return type(skeleton)(_decode(v, buf) for v in skeleton)
     if isinstance(skeleton, dict):

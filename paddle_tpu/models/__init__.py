@@ -26,6 +26,18 @@ from .gpt import (
     gpt_nano,
     bert_base_config,
 )
+from .dlrm import (
+    DLRMConfig,
+    dlrm_init,
+    dlrm_forward,
+    dlrm_forward_from_emb,
+    dlrm_loss,
+    dlrm_loss_from_emb,
+    dlrm_param_specs,
+    dlrm_score_fn,
+    dlrm_tiny,
+    synthetic_ctr_batches,
+)
 
 __all__ = [
     "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
@@ -33,4 +45,7 @@ __all__ = [
     "gpt_decode_step", "gpt_decode_step_paged",
     "gpt_verify_step", "gpt_verify_step_paged", "gpt_truncate",
     "gpt_tiny", "gpt_small", "gpt_1p3b", "gpt_nano", "bert_base_config",
+    "DLRMConfig", "dlrm_init", "dlrm_forward", "dlrm_forward_from_emb",
+    "dlrm_loss", "dlrm_loss_from_emb", "dlrm_param_specs", "dlrm_score_fn",
+    "dlrm_tiny", "synthetic_ctr_batches",
 ]

@@ -1,0 +1,215 @@
+"""DLRM / DeepFM — the CTR ranking models of the recommender stack.
+
+Functional like models/gpt.py: a config dataclass, an init returning a
+param pytree split into ``{"dense": ..., "table": ...}`` (the split the
+sparse training path consumes — tables update via SelectedRows, dense
+via the pure optimizers), a PartitionSpec table, and a synthetic CTR
+stream with planted logistic structure so loss curves are meaningful.
+
+Architecture (Naumov et al. DLRM): dense features → bottom MLP → one
+vector; each categorical slot → embedding vector from ONE shared
+mod-sharded table (slot-hashed id space — the reference's
+``sparse_embedding`` is likewise one logical id space per PS table);
+pairwise-dot feature interaction over all vectors; concat with the
+bottom vector → top MLP → 1 logit. ``arch="deepfm"`` swaps the
+interaction for the FM second-order term + flattened embeddings. Both
+MLPs run through the fused LN+MLP kernel (ops/fused_kernels.py) —
+Pallas on TPU, identical composed jnp math on CPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.fused_kernels import fused_ln_mlp
+
+__all__ = ["DLRMConfig", "dlrm_tiny", "dlrm_init", "dlrm_param_specs",
+           "dlrm_forward_from_emb", "dlrm_forward", "dlrm_loss",
+           "dlrm_loss_from_emb", "dlrm_score_fn", "synthetic_ctr_batches"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    n_dense: int = 13          # continuous features (Criteo layout)
+    n_slots: int = 8           # categorical slots, one id each
+    table_rows: int = 100_000  # shared (slot-hashed) id space
+    table_dim: int = 16        # embedding width D == bottom MLP output
+    mlp_hidden: int = 64       # top MLP width
+    mlp_mult: int = 4          # fused-block expansion factor
+    arch: str = "dlrm"         # "dlrm" | "deepfm"
+    dtype: str = "float32"
+
+    @property
+    def interact_dim(self) -> int:
+        n = self.n_slots + 1   # slots + bottom vector
+        if self.arch == "deepfm":
+            return self.table_dim * n
+        return self.table_dim + n * (n - 1) // 2
+
+    @property
+    def table_bytes(self) -> int:
+        return self.table_rows * self.table_dim * \
+            jnp.dtype(self.dtype).itemsize
+
+
+def dlrm_tiny(**kw) -> DLRMConfig:
+    """Test-sized config (fits the 8-dev virtual CPU mesh)."""
+    base = dict(n_dense=4, n_slots=4, table_rows=1000, table_dim=8,
+                mlp_hidden=16)
+    base.update(kw)
+    return DLRMConfig(**base)
+
+
+def _linear(key, n_in, n_out, dtype):
+    scale = (2.0 / (n_in + n_out)) ** 0.5
+    return {"w": (scale * jax.random.normal(
+        key, (n_in, n_out))).astype(dtype),
+        "b": jnp.zeros((n_out,), dtype)}
+
+
+def _block(key, width, mult, dtype):
+    k1, k2 = jax.random.split(key)
+    m = width * mult
+    return {"w1": (0.02 * jax.random.normal(k1, (width, m))).astype(dtype),
+            "b1": jnp.zeros((m,), dtype),
+            "w2": (0.02 * jax.random.normal(k2, (m, width))).astype(dtype),
+            "b2": jnp.zeros((width,), dtype),
+            "ln_s": jnp.ones((width,), dtype),
+            "ln_b": jnp.zeros((width,), dtype)}
+
+
+def dlrm_init(cfg: DLRMConfig, seed: int = 0):
+    """``{"table": (rows, D) logical, "dense": {...}}`` — feed
+    ``tables={"table": p["table"]}`` and ``p["dense"]`` to
+    SparseTrainStep."""
+    dt = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(jax.random.key(seed), 6)
+    table = (0.01 * jax.random.normal(
+        keys[0], (cfg.table_rows, cfg.table_dim))).astype(dt)
+    dense = {
+        "bot_in": _linear(keys[1], cfg.n_dense, cfg.table_dim, dt),
+        "bot_blk": _block(keys[2], cfg.table_dim, cfg.mlp_mult, dt),
+        "top_in": _linear(keys[3], cfg.interact_dim, cfg.mlp_hidden, dt),
+        "top_blk": _block(keys[4], cfg.mlp_hidden, cfg.mlp_mult, dt),
+        "top_out": _linear(keys[5], cfg.mlp_hidden, 1, dt),
+    }
+    return {"table": table, "dense": dense}
+
+
+def dlrm_param_specs(cfg: DLRMConfig):
+    """Table rows shard over "model"; the MLPs replicate (they are tiny
+    next to the table — DLRM is embedding-bound by construction)."""
+    lin = {"w": P(), "b": P()}
+    blk = {k: P() for k in ("w1", "b1", "w2", "b2", "ln_s", "ln_b")}
+    return {"table": P("model", None),
+            "dense": {"bot_in": dict(lin), "bot_blk": dict(blk),
+                      "top_in": dict(lin), "top_blk": dict(blk),
+                      "top_out": dict(lin)}}
+
+
+def _apply_block(blk, x):
+    return fused_ln_mlp(x, blk["w1"], blk["b1"], blk["w2"], blk["b2"],
+                        ln_scale=blk["ln_s"], ln_bias=blk["ln_b"],
+                        residual=True, act="relu")
+
+
+def dlrm_forward_from_emb(cfg: DLRMConfig, dense_params, dense_x, emb):
+    """Logits from already-gathered slot vectors.
+
+    ``dense_x``: (B, n_dense); ``emb``: (B, n_slots, D) — the gathered
+    vectors (differentiable leaf in the sparse train step). Returns
+    (B,) logits.
+    """
+    d = dense_params
+    bot = jnp.tanh(dense_x @ d["bot_in"]["w"] + d["bot_in"]["b"])
+    bot = _apply_block(d["bot_blk"], bot)                   # (B, D)
+    vecs = jnp.concatenate([bot[:, None, :], emb], axis=1)  # (B, n+1, D)
+    if cfg.arch == "deepfm":
+        # FM second-order term + flattened embeddings through the MLP
+        s = vecs.sum(axis=1)
+        fm = 0.5 * (jnp.square(s) - jnp.square(vecs).sum(axis=1)).sum(-1)
+        feats = vecs.reshape(vecs.shape[0], -1)
+    else:
+        # pairwise dots, upper triangle (the DLRM dot interaction)
+        dots = jnp.einsum("bnd,bmd->bnm", vecs, vecs)
+        n = vecs.shape[1]
+        iu, ju = jnp.triu_indices(n, k=1)
+        feats = jnp.concatenate([bot, dots[:, iu, ju]], axis=1)
+        fm = 0.0
+    top = jnp.tanh(feats @ d["top_in"]["w"] + d["top_in"]["b"])
+    top = _apply_block(d["top_blk"], top)
+    logit = (top @ d["top_out"]["w"] + d["top_out"]["b"])[:, 0]
+    return logit + fm
+
+
+def dlrm_forward(cfg: DLRMConfig, params, batch):
+    """Convenience single-array path: plain dense gather (no sharding,
+    no sparse grads) — the reference the sparse trajectory pins against."""
+    emb = jnp.take(params["table"], batch["slots"], axis=0)
+    return dlrm_forward_from_emb(cfg, params["dense"], batch["dense"], emb)
+
+
+def _bce(logit, y):
+    # stable binary cross-entropy with logits
+    return jnp.mean(jnp.maximum(logit, 0) - logit * y +
+                    jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+
+def dlrm_loss(cfg: DLRMConfig, params, batch):
+    return _bce(dlrm_forward(cfg, params, batch), batch["y"])
+
+
+def dlrm_loss_from_emb(cfg: DLRMConfig, dense_params, emb, batch):
+    """``loss_fn`` shape for SparseTrainStep (emb dict keyed "table")."""
+    logit = dlrm_forward_from_emb(cfg, dense_params, batch["dense"],
+                                  emb["table"])
+    return _bce(logit, batch["y"])
+
+
+def dlrm_score_fn(cfg: DLRMConfig, dense_params):
+    """``score_fn`` for serving's EmbeddingRanker: emb dict in, (B,)
+    sigmoid CTR scores out."""
+    def score(emb, dense):
+        logit = dlrm_forward_from_emb(cfg, dense_params, dense,
+                                      emb["table"])
+        return jax.nn.sigmoid(logit)
+    return score
+
+
+def synthetic_ctr_batches(cfg: DLRMConfig, batch_size: int, n_batches: int,
+                          seed: int = 0, ragged: bool = False,
+                          max_multi_hot: int = 4):
+    """Synthetic CTR stream with planted logistic structure: labels are
+    Bernoulli in a fixed random linear model over the dense features
+    and per-slot id hashes, so a learner beats chance and loss curves
+    slope. ``ragged=True`` adds ``"multi_hot"`` — a list of n_slots
+    variable-length id arrays per batch (the shm-ring ragged payload).
+    Yields dict batches of numpy arrays (shm-ring shardable).
+    """
+    rng = np.random.default_rng(seed)
+    w_dense = rng.normal(size=cfg.n_dense).astype(np.float32)
+    w_slot = rng.normal(size=cfg.n_slots).astype(np.float32)
+    for _ in range(n_batches):
+        dense = rng.normal(size=(batch_size, cfg.n_dense)).astype(
+            np.float32)
+        # zipf-ish skew: hot ids dominate, like real CTR id traffic
+        slots = np.minimum(
+            rng.zipf(1.3, size=(batch_size, cfg.n_slots)) - 1,
+            cfg.table_rows - 1).astype(np.int32)
+        planted = dense @ w_dense + \
+            (np.sin(slots * 0.1) * w_slot).sum(axis=1)
+        y = (rng.uniform(size=batch_size) <
+             1 / (1 + np.exp(-planted))).astype(np.float32)
+        batch = {"dense": dense, "slots": slots, "y": y}
+        if ragged:
+            batch["multi_hot"] = [
+                rng.integers(0, cfg.table_rows,
+                             rng.integers(1, max_multi_hot + 1)
+                             ).astype(np.int64)
+                for _ in range(cfg.n_slots)]
+        yield batch

@@ -352,6 +352,11 @@ DEFAULT_STATS = (
     "serving_replica_restarts",  # replicas respawned after death/wedge/watchdog abort
     "serving_scale_events",      # autoscale transitions (grow or drain-shrink) completed
     "prefix_warm_tokens",        # prompt tokens replayed to re-warm a rejoined radix tree
+    # sparse embedding / recommender stack (ISSUE 16)
+    "embedding_lookup_ids",      # ids resolved through sparse lookup paths
+    "embedding_unique_ratio",    # gauge: unique/total ids in the last batch, ppm
+    "embedding_exchange_bytes",  # all-to-all bytes moved by sharded lookups
+    "sparse_rows_touched",       # table rows updated by sparse optimizer steps
 )
 
 for _n in DEFAULT_STATS:
@@ -428,6 +433,10 @@ SERVING_REPLICAS_TARGET = _registry.get_stat("serving_replicas_target")
 SERVING_REPLICA_RESTARTS = _registry.get_stat("serving_replica_restarts")
 SERVING_SCALE_EVENTS = _registry.get_stat("serving_scale_events")
 PREFIX_WARM_TOKENS = _registry.get_stat("prefix_warm_tokens")
+EMBEDDING_LOOKUP_IDS = _registry.get_stat("embedding_lookup_ids")
+EMBEDDING_UNIQUE_RATIO = _registry.get_stat("embedding_unique_ratio")
+EMBEDDING_EXCHANGE_BYTES = _registry.get_stat("embedding_exchange_bytes")
+SPARSE_ROWS_TOUCHED = _registry.get_stat("sparse_rows_touched")
 
 
 # -- pre-registered latency histograms (ISSUE 15) ---------------------------

@@ -98,7 +98,37 @@ def _embedding(weight, ids, padding_idx=None):
     return out
 
 
+def _embedding_sparse(weight, ids, padding_idx=None):
+    # SelectedRows-semantics backward (unique + segment_sum, one write
+    # per touched row) — forward values identical to _embedding
+    from ...sparse.embedding import sparse_lookup
+
+    return sparse_lookup(weight, ids, padding_idx=padding_idx)
+
+
+_sparse_warned = [False]
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    if sparse:
+        from ...parallel.mesh import get_mesh
+
+        if get_mesh() is not None:
+            # mesh active: the sparse-grad path (paddle_tpu.sparse) —
+            # duplicate-id cotangents merge per row instead of the
+            # dense scatter-add, matching the reference's sparse=True
+            # SelectedRows gradient
+            return apply_op(_embedding_sparse, weight, x,
+                            padding_idx=padding_idx)
+        if not _sparse_warned[0]:
+            _sparse_warned[0] = True
+            import warnings
+
+            warnings.warn(
+                "Embedding(sparse=True) without an active mesh falls "
+                "back to the dense backward (values and gradients are "
+                "identical); create_mesh()/set_mesh() enables the "
+                "sparse-grad path", stacklevel=2)
     return apply_op(_embedding, weight, x, padding_idx=padding_idx)
 
 

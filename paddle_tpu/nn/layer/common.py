@@ -53,17 +53,21 @@ class Linear(Layer):
 class Embedding(Layer):
     """Parity: reference python/paddle/nn/layer/common.py Embedding.
 
-    ``sparse=True`` is accepted and IGNORED by design: it selects a
-    SelectedRows gradient storage format in the reference; here the
-    backward is a dense scatter-add compiled into the step (see README
-    "LoDTensor / SelectedRows decision"). Values and gradients are
-    identical either way (tests/test_sequence_semantics.py)."""
+    ``sparse=True`` selects the SelectedRows-semantics backward (the
+    reference's sparse gradient format): with a mesh active the lookup
+    routes through paddle_tpu.sparse — duplicate-id cotangents are
+    merged per row via unique + segment_sum and the row-wise lazy
+    :class:`~paddle_tpu.sparse.SparseAdam` touches only live rows.
+    Without a mesh it warns once and falls back to the dense backward.
+    Values and gradients are identical on every path
+    (tests/test_sparse.py pins both, plus padding_idx zero-grad)."""
 
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        self._sparse = bool(sparse)
         self._padding_idx = (
             None if padding_idx is None
             else padding_idx if padding_idx >= 0
@@ -77,7 +81,8 @@ class Embedding(Layer):
             self.weight._data = self.weight._data.at[self._padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
 
 class Dropout(Layer):

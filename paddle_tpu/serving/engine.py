@@ -488,6 +488,17 @@ class InferenceEngine:
     every engine in the process) and makes the scheduler-abort and
     watchdog-restart paths dump the ring of recent spans/gauge deltas
     there as a self-contained chrome-trace at the moment of failure.
+
+    ``embedding_tables`` (ISSUE 16) arms the recommender ranking path:
+    a ``{name: (rows, dim) array}`` dict (optionally ``(tables,
+    score_fn)`` to score with a trained model, or a ready
+    :class:`~paddle_tpu.sparse.EmbeddingRanker`) is placed row-sharded
+    over the engine mesh's "model" axis and :meth:`rank` resolves a
+    request's sparse features against it inside one jitted lookup+score
+    step (the shard_map all-to-all exchange — no host hop between
+    lookup and MLP). The HTTP frontend exposes it as ``POST /v1/rank``.
+    Independent of the generation path: no compiled generation program
+    changes when it is armed.
     """
 
     def __init__(self, cfg, params, n_slots: int = 4,
@@ -499,7 +510,8 @@ class InferenceEngine:
                  draft=None, spec_k: int = 4, mesh=None, tokenizer=None,
                  prefix_cache: Optional[bool] = None, watchdog=None,
                  overload=None, replica_id: Optional[int] = None,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 embedding_tables=None):
         # per-tick NaN/latency sentinel + auto-restart (off by default;
         # when off the engine's compiled programs are bit-identical to a
         # build without it — the health output is gated at trace time)
@@ -655,6 +667,22 @@ class InferenceEngine:
         self.flight_dir = flight_dir
         if flight_dir:
             arm_flight_recorder(flight_dir)
+        # serving-side sparse lookup (ISSUE 16): tables placed over THIS
+        # engine's mesh; built before the scheduler thread starts so a
+        # rank() race with startup is impossible
+        self._ranker = None
+        if embedding_tables is not None:
+            from ..sparse.ranking import EmbeddingRanker
+
+            if isinstance(embedding_tables, EmbeddingRanker):
+                self._ranker = embedding_tables
+            elif isinstance(embedding_tables, tuple):
+                tables, score_fn = embedding_tables
+                self._ranker = EmbeddingRanker(tables, score_fn=score_fn,
+                                               mesh=self._mesh)
+            else:
+                self._ranker = EmbeddingRanker(dict(embedding_tables),
+                                               mesh=self._mesh)
         self._last_tick_t = time.monotonic()
         self._thread = threading.Thread(target=self._run,
                                         name="serving-scheduler", daemon=True)
@@ -1118,6 +1146,18 @@ class InferenceEngine:
     def generate(self, prompt: Sequence[int] = None, **kw) -> List[int]:
         """Blocking convenience wrapper: submit + result."""
         return self.submit(prompt, **kw).result()
+
+    def rank(self, slots, dense=None):
+        """Score a batch of sparse-feature requests against the armed
+        embedding tables (``embedding_tables=``): ``slots`` = {name:
+        (B, L) int ids}, optional ``dense`` = (B, n_dense) floats.
+        Returns (B,) numpy scores. Thread-safe (the lookup runs on the
+        caller's thread — it shares no state with the scheduler)."""
+        if self._ranker is None:
+            raise RuntimeError(
+                "ranking not enabled: construct the engine with "
+                "embedding_tables= to arm the sparse lookup path")
+        return self._ranker.rank(slots, dense=dense)
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:

@@ -428,6 +428,12 @@ class ServingFrontend:
                 status = await self._generate(
                     tenant, body, writer, reader,
                     chat=path == "/v1/chat/completions")
+            elif path == "/v1/rank":
+                if method != "POST":
+                    raise _HttpError(405, "POST required")
+                tenant = self._authenticate(headers)
+                tenant_name, lane = tenant.name, tenant.lane
+                status = await self._rank(body, writer)
             else:
                 raise _HttpError(404, f"no route {path}")
         except _HttpError as e:
@@ -535,6 +541,43 @@ class ServingFrontend:
     async def _healthz(self, writer) -> int:
         """Liveness: the loop answered, the process serves."""
         await self._send_json(writer, 200, {"status": "ok"})
+        return 200
+
+    async def _rank(self, body: bytes, writer) -> int:
+        """POST /v1/rank (ISSUE 16): sparse features -> scores through
+        the engine's sharded embedding tables. Body: ``{"slots":
+        {name: [[ids...], ...]} | [[ids...], ...], "dense":
+        [[floats...], ...]?}`` (a bare list binds to the single armed
+        table). The jitted lookup+score runs in the executor — it holds
+        no loop state and shares nothing with the scheduler thread."""
+        if getattr(self.engine, "_ranker", None) is None and \
+                not hasattr(self.engine, "rank"):
+            raise _HttpError(404, "ranking not enabled on this server")
+        try:
+            req = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise _HttpError(400, f"bad JSON: {e}") from None
+        slots = req.get("slots")
+        if not slots:
+            raise _HttpError(400, "missing 'slots'")
+        ranker = getattr(self.engine, "_ranker", None)
+        if isinstance(slots, list):
+            if ranker is None or len(ranker.tables) != 1:
+                raise _HttpError(400, "bare 'slots' list needs exactly "
+                                      "one armed table; use {name: ids}")
+            slots = {next(iter(ranker.tables)): slots}
+        dense = req.get("dense")
+        loop = asyncio.get_running_loop()
+        try:
+            scores = await loop.run_in_executor(
+                None, lambda: self.engine.rank(slots, dense))
+        except RuntimeError as e:
+            raise _HttpError(404, str(e)) from None
+        except (ValueError, TypeError, KeyError) as e:
+            raise _HttpError(400, f"bad rank request: {e}") from None
+        await self._send_json(writer, 200,
+                              {"object": "rank",
+                               "scores": [float(s) for s in scores]})
         return 200
 
     def _engine_checks(self) -> dict:

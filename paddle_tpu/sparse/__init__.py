@@ -1,0 +1,40 @@
+"""paddle_tpu.sparse — the TPU-native recommender stack.
+
+The reference's CTR/recsys half (fleet parameter-server mode +
+``sparse_embedding`` distributed lookup tables) rebuilt without the
+parameter server: tables are mod-sharded JAX arrays on the mesh's
+"model" axis, the PS's RPC id routing becomes an in-program all-to-all,
+and SelectedRows gradients become unique+segment_sum pairs feeding a
+row-wise lazy Adam.
+
+Layer map::
+
+    embedding.py    storage layout (mod-sharded rows, to_stored/
+                    to_logical), sparse_lookup (custom-VJP gather,
+                    unique+segment_sum backward), sharded_lookup
+                    (shard_map all-to-all exchange), ShardedEmbedding
+    optimizer.py    sparse_adam_init/sparse_adam_rows (pure, compiled
+                    path) + eager SparseAdam (lazy_mode Adam subclass)
+    train_step.py   SparseTrainStep — jitted dense+sparse step; the
+                    dense (rows, dim) table grad never materializes;
+                    topology-independent state_dict
+    ranking.py      EmbeddingRanker — serving-side jitted lookup+score
+                    (InferenceEngine embedding_tables= / POST /v1/rank)
+
+Composes with: models/dlrm.py (DLRM/DeepFM on the fused-MLP kernels),
+io/shm_ring.py (ragged CTR id lists over shared memory),
+distributed/fleet/auto (table HBM + exchange-bytes placement term),
+tools/trace_report.py (``embedding_report`` section over the
+``sparse.step`` / ``sparse.lookup`` spans).
+"""
+from .embedding import (ShardedEmbedding, sharded_lookup, sparse_lookup,
+                        stored_rows, to_logical, to_stored)
+from .optimizer import SparseAdam, sparse_adam_init, sparse_adam_rows
+from .ranking import EmbeddingRanker, fm_score
+from .train_step import SparseTrainStep
+
+__all__ = [
+    "ShardedEmbedding", "sharded_lookup", "sparse_lookup", "stored_rows",
+    "to_logical", "to_stored", "SparseAdam", "sparse_adam_init",
+    "sparse_adam_rows", "EmbeddingRanker", "fm_score", "SparseTrainStep",
+]

@@ -1,0 +1,120 @@
+"""Row-wise lazy Adam for sparse embedding gradients.
+
+Two faces of the same math:
+
+* **Pure functions** (:func:`sparse_adam_init` / :func:`sparse_adam_rows`)
+  — consumed inside the compiled sparse training path
+  (sparse/train_step.py). The update takes the SelectedRows pair
+  ``(rows, row_grads)`` produced by unique+segment_sum and touches ONLY
+  those rows of the table and its m/v moments; the dense (rows, dim)
+  gradient never exists.
+
+* **Eager** :class:`SparseAdam` — an ``optimizer.Adam`` subclass with
+  the reference's ``lazy_mode=True`` semantics (operators/optimizers/
+  adam_op lazy path): rows whose gradient is exactly zero are skipped
+  entirely — parameter, moment1 and moment2 stay untouched, so rare ids
+  don't decay toward the bias-corrected zero-gradient fixed point. The
+  implementation computes the dense update and ``where``-selects per
+  row, which keeps one compiled program for every sparsity pattern
+  while matching lazy semantics bit-for-bit for zero rows. Slot/
+  checkpoint plumbing (state_dict keys ``{param}.moment1`` etc.) is
+  inherited unchanged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..monitor import stats as _mstats
+from ..optimizer.optimizer import Adam
+
+__all__ = ["SparseAdam", "sparse_adam_init", "sparse_adam_rows"]
+
+
+def sparse_adam_init(table, mv_dtype=jnp.float32):
+    """Moment state for one table: {"m", "v", "count"} (count is the
+    global step for bias correction, shared by every row — the
+    reference's lazy adam also advances beta_pow globally)."""
+    return {"m": jnp.zeros(table.shape, mv_dtype),
+            "v": jnp.zeros(table.shape, mv_dtype),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def sparse_adam_rows(table, state, rows, row_g, lr, *, beta1=0.9,
+                     beta2=0.999, eps=1e-8):
+    """Apply Adam to ``table[rows]`` only, from the SelectedRows pair.
+
+    ``rows``: (k,) int — unique touched rows; out-of-range entries
+    (the unique-padding sentinel) drop via ``mode="drop"`` scatters.
+    ``row_g``: (k, dim) summed gradients for those rows. Returns
+    ``(new_table, new_state)``; untouched rows — values AND moments —
+    are byte-identical to before (lazy_mode).
+    """
+    count = state["count"] + 1
+    b1p = beta1 ** count.astype(jnp.float32)
+    b2p = beta2 ** count.astype(jnp.float32)
+    g = row_g.astype(state["m"].dtype)
+    # gather clips OOB reads; the matching scatters drop them
+    m_rows = jnp.take(state["m"], rows, axis=0, mode="clip")
+    v_rows = jnp.take(state["v"], rows, axis=0, mode="clip")
+    nm = beta1 * m_rows + (1 - beta1) * g
+    nv = beta2 * v_rows + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    upd = (lr_t * nm / (jnp.sqrt(nv) + eps)).astype(table.dtype)
+    new_table = table.at[rows].add(-upd, mode="drop")
+    new_state = {"m": state["m"].at[rows].set(nm, mode="drop"),
+                 "v": state["v"].at[rows].set(nv, mode="drop"),
+                 "count": count}
+    return new_table, new_state
+
+
+class SparseAdam(Adam):
+    """Adam with per-row lazy updates for embedding tables (eager API).
+
+    ::
+
+        opt = SparseAdam(learning_rate=1e-3,
+                         parameters=model.parameters())
+        loss.backward(); opt.step()
+
+    Rows whose gradient is identically zero (ids absent from the batch
+    — exactly what the sparse backward produces) are left untouched:
+    no moment decay, no parameter drift. 1-D parameters (biases) fall
+    back to plain dense Adam.
+    """
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode=True, name=name)
+
+    def _fused_supported(self):
+        return False  # fused flat-buffer path is dense-only
+
+    def step(self):
+        # host-side observability: rows with any nonzero grad this step
+        touched = 0
+        for p in (self._parameter_list or []):
+            g = getattr(p, "grad", None)
+            if g is not None and getattr(g, "ndim", 0) >= 2:
+                import numpy as np
+                ga = np.asarray(g._data if hasattr(g, "_data") else g)
+                touched += int((np.abs(ga).reshape(ga.shape[0], -1)
+                                .max(axis=1) > 0).sum())
+        if touched:
+            _mstats.SPARSE_ROWS_TOUCHED.add(touched)
+        return super().step()
+
+    @staticmethod
+    def _pure_update(p, g, lr, m1, m2, b1p, b2p, b1, b2, eps):
+        np_, nm1, nm2, nb1p, nb2p = Adam._pure_update(
+            p, g, lr, m1, m2, b1p, b2p, b1, b2, eps)
+        if p.ndim < 2:
+            return np_, nm1, nm2, nb1p, nb2p
+        # lazy rows: zero-gradient rows keep param AND moments verbatim
+        live = (jnp.max(jnp.abs(g.reshape(g.shape[0], -1)), axis=1)
+                > 0)[(...,) + (None,) * (p.ndim - 1)]
+        return (jnp.where(live, np_, p),
+                jnp.where(live, nm1, m1),
+                jnp.where(live, nm2, m2),
+                nb1p, nb2p)

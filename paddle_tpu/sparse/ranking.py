@@ -1,0 +1,127 @@
+"""Serving-side sparse lookup: real-time ranking over sharded tables.
+
+The reference serves CTR models by pointing the inference runtime at
+the fleet's distributed lookup table (a remote PS hop per request).
+Here the table is already resident — row-sharded over the serving
+mesh's "model" axis — so a ranking request resolves its sparse
+features with the SAME shard_map all-to-all exchange the training path
+uses, inside one jitted score step: ids in, scores out, no host
+round-trip between lookup and MLP.
+
+:class:`EmbeddingRanker` owns the placed tables and the per-shape jit
+cache; ``InferenceEngine(embedding_tables=...)`` wires one up and the
+HTTP frontend exposes it as ``POST /v1/rank``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..monitor import stats as _mstats
+from ..monitor.trace import span as _trace_span
+from ..parallel.mesh import get_mesh, mesh_shape
+from .embedding import exchange_bytes, sharded_lookup, to_stored
+
+__all__ = ["EmbeddingRanker", "fm_score"]
+
+
+def fm_score(emb: Dict[str, jnp.ndarray], dense=None):
+    """Parameter-free factorization-machine score: the second-order FM
+    term ``0.5 * ((Σv)² − Σv²)`` with every looked-up id vector as one
+    FM feature (the default when no trained scorer is supplied — real
+    deployments pass ``score_fn`` closing over model params, e.g.
+    models.dlrm.dlrm_score). ``emb[name]``: (B, L, D) per-slot vectors;
+    slots concatenate along the feature axis, so a single multi-id
+    table still produces a non-degenerate pairwise-interaction score.
+    """
+    vecs = [v if v.ndim == 3 else v[:, None, :] for v in emb.values()]
+    stack = jnp.concatenate(vecs, axis=1)                # (B, ΣL, D)
+    if dense is not None:
+        stack = jnp.concatenate(
+            [stack, jnp.asarray(dense)[:, None, :stack.shape[-1]]], axis=1)
+    s = stack.sum(axis=1)
+    return 0.5 * (jnp.square(s) - jnp.square(stack).sum(axis=1)).sum(-1)
+
+
+class EmbeddingRanker:
+    """Sharded-table lookup + score, jitted per padded batch shape.
+
+    ``tables``: {name: logical (rows, dim) array}. ``score_fn(emb,
+    dense) -> (B,) scores`` with ``emb`` = {name: (B, L, dim)} gathered
+    vectors; defaults to :func:`fm_score`. Requests are padded to
+    power-of-two batch buckets so the jit cache stays bounded.
+    """
+
+    def __init__(self, tables: Dict, score_fn: Optional[Callable] = None,
+                 mesh=None, axis: str = "model"):
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.axis = axis
+        self.n_shards = (mesh_shape(self.mesh).get(axis, 1)
+                         if self.mesh is not None else 1)
+        self._score = score_fn or fm_score
+        self.rows = {k: int(np.asarray(t).shape[0])
+                     for k, t in tables.items()}
+        self.dims = {k: int(np.asarray(t).shape[1])
+                     for k, t in tables.items()}
+        self.tables = {}
+        for k, t in tables.items():
+            stored = to_stored(np.asarray(t), self.n_shards)
+            if self.mesh is not None and self.n_shards > 1:
+                self.tables[k] = jax.device_put(
+                    stored, NamedSharding(self.mesh, P(axis, None)))
+            else:
+                self.tables[k] = jnp.asarray(stored)
+        self._jit = jax.jit(self._step, static_argnums=(2,))
+
+    def _step(self, tables, slots, has_dense, dense):
+        emb = {}
+        for k, ids in slots.items():
+            if self.n_shards > 1:
+                emb[k] = sharded_lookup(tables[k], ids, mesh=self.mesh,
+                                        axis=self.axis, rows=self.rows[k])
+            else:
+                emb[k] = jnp.take(tables[k], ids.reshape(-1),
+                                  axis=0).reshape(
+                    ids.shape + (tables[k].shape[-1],))
+        return self._score(emb, dense if has_dense else None)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def rank(self, slots: Dict, dense=None) -> np.ndarray:
+        """``slots``: {name: (B, L) int ids} (lists accepted). Returns
+        (B,) float scores. Batch padded to a pow-2 bucket; pad rows
+        reuse row 0 and are sliced off before return."""
+        slots = {k: np.asarray(v, np.int32) for k, v in slots.items()}
+        b = next(iter(slots.values())).shape[0]
+        bb = self._bucket(max(b, 1))
+        padded = {k: np.concatenate(
+            [v, np.zeros((bb - b,) + v.shape[1:], v.dtype)]) if bb > b
+            else v for k, v in slots.items()}
+        dense_a = None
+        if dense is not None:
+            dense_a = np.asarray(dense, np.float32)
+            if bb > b:
+                dense_a = np.concatenate(
+                    [dense_a, np.zeros((bb - b,) + dense_a.shape[1:],
+                                       dense_a.dtype)])
+        n_ids = sum(int(v.size) for v in padded.values())
+        xbytes = sum(exchange_bytes(int(v.size), self.dims[k],
+                                    self.n_shards)
+                     for k, v in padded.items())
+        _mstats.EMBEDDING_LOOKUP_IDS.add(n_ids)
+        _mstats.EMBEDDING_EXCHANGE_BYTES.add(xbytes)
+        with _trace_span("sparse.lookup", cat="sparse",
+                         args={"ids": n_ids, "exchange_bytes": xbytes,
+                               "shards": self.n_shards, "batch": bb}):
+            scores = self._jit(self.tables, padded, dense_a is not None,
+                               dense_a)
+        return np.asarray(scores)[:b]

@@ -1,0 +1,267 @@
+"""Compiled training step for models with row-sharded embedding tables.
+
+The dense-grad contract of ``DistributedTrainStep`` (grads tree ==
+params treedef) cannot carry SelectedRows, so the sparse workload gets
+its own step with the same surface (callable → loss, ``state_dict`` /
+``set_state_dict``, gauges, trace spans):
+
+1. **Lookup** — each table's batch ids go through the shard_map
+   all-to-all exchange (:func:`~paddle_tpu.sparse.embedding.
+   sharded_lookup`) *outside* the autodiff region: the gathered
+   vectors ``emb`` enter the loss as a differentiable leaf, so
+   ``value_and_grad`` runs over ``(dense_params, emb)`` and the dense
+   (rows, dim) table gradient never exists anywhere in the program.
+2. **Sparse update** — per table, the per-id cotangents collapse to a
+   SelectedRows pair via ``jnp.unique`` + ``segment_sum``
+   (duplicate ids summed once) and :func:`~paddle_tpu.sparse.optimizer.
+   sparse_adam_rows` writes only those rows of the table + moments.
+3. **Dense update** — the MLP side reuses the pure optimizers from
+   parallel/train_step.py (``_OPTS``: adamw/sgd/...).
+
+Checkpoints are topology-independent: ``state_dict`` de-permutes the
+mod-sharded storage back to logical row order on the host, so a run
+sharded 8 ways resumes bit-for-bit on 1 shard and vice versa (the ZeRO
+sharded↔unsharded property, pinned in tests/test_sparse.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..monitor import stats as _mstats
+from ..monitor.trace import span as _trace_span
+from ..parallel.mesh import get_mesh, mesh_shape
+from ..parallel.train_step import _OPTS, global_norm_clip
+from .embedding import (exchange_bytes, sharded_lookup, stored_rows,
+                        to_logical, to_stored)
+from .optimizer import sparse_adam_init, sparse_adam_rows
+
+__all__ = ["SparseTrainStep"]
+
+
+class SparseTrainStep:
+    """One jitted optimizer step over dense params + sparse tables.
+
+    ::
+
+        step = SparseTrainStep(loss_fn, dense_params,
+                               tables={"ids": table},      # logical (R, D)
+                               ids_fn=lambda b: b["slots"], # -> {"ids": ...}
+                               mesh=mesh, lr=1e-3)
+        loss = step(batch)
+
+    ``loss_fn(dense_params, emb, batch)`` receives ``emb`` =
+    ``{name: (ids.shape, dim)}`` gathered vectors; ``ids_fn(batch)``
+    maps a batch to ``{name: int ids}`` (traceable — it runs inside
+    jit and once per step on the host for the gauges).
+
+    Tables are stored mod-permuted and row-sharded ``P(table_axis,
+    None)`` when the mesh has that axis > 1; Adam moments shard with
+    them. ``clip_norm`` applies global-norm clipping jointly over the
+    dense grads and the per-id embedding cotangents.
+    """
+
+    def __init__(self, loss_fn: Callable, dense_params, tables: Dict,
+                 *, ids_fn: Callable, dense_specs=None,
+                 optimizer: str = "adamw", lr: float = 1e-3,
+                 sparse_lr: Optional[float] = None,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8, clip_norm: Optional[float] = None,
+                 table_axis: str = "model", mesh=None,
+                 opt_kwargs: Optional[dict] = None):
+        self._loss_fn = loss_fn
+        self._ids_fn = ids_fn
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.axis = table_axis
+        self.n_shards = (mesh_shape(self.mesh).get(table_axis, 1)
+                         if self.mesh is not None else 1)
+        self._lr = float(lr)
+        self._sparse_lr = float(sparse_lr if sparse_lr is not None else lr)
+        self._betas = (float(beta1), float(beta2), float(eps))
+        self._clip = clip_norm
+        if isinstance(optimizer, str):
+            init_fn, update_fn = _OPTS[optimizer]
+        else:
+            init_fn, update_fn = optimizer
+        self._dense_update = update_fn
+        self._opt_kwargs = dict(opt_kwargs or {})
+
+        def _rep(x):
+            if self.mesh is None:
+                return jnp.asarray(x)
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+        def _tab(x):
+            if self.mesh is None or self.n_shards <= 1:
+                return _rep(x)
+            return jax.device_put(
+                x, NamedSharding(self.mesh, P(self.axis, None)))
+
+        self.rows = {k: int(np.asarray(t).shape[0])
+                     for k, t in tables.items()}
+        self.dims = {k: int(np.asarray(t).shape[1])
+                     for k, t in tables.items()}
+        self.tables = {k: _tab(to_stored(np.asarray(t), self.n_shards))
+                       for k, t in tables.items()}
+        self.sparse_state = {
+            k: {"m": _tab(np.zeros(self.tables[k].shape, np.float32)),
+                "v": _tab(np.zeros(self.tables[k].shape, np.float32)),
+                "count": _rep(np.zeros((), np.int32))}
+            for k in tables}
+        if dense_specs is not None and self.mesh is not None:
+            self.params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, s)),
+                dense_params, dense_specs)
+        else:
+            self.params = jax.tree_util.tree_map(_rep, dense_params)
+        self.opt_state = jax.tree_util.tree_map(
+            _rep, jax.tree_util.tree_map(np.asarray,
+                                         init_fn(dense_params)))
+        self._step_fn = jax.jit(self._step, donate_argnums=(0, 1, 2, 3))
+
+    # -- the compiled step --------------------------------------------------
+
+    def _lookup(self, table, ids, name):
+        if self.n_shards > 1:
+            return sharded_lookup(table, ids, mesh=self.mesh,
+                                  axis=self.axis, rows=self.rows[name])
+        flat = jnp.asarray(ids).reshape(-1)
+        return jnp.take(table, flat, axis=0).reshape(
+            jnp.shape(ids) + (table.shape[-1],))
+
+    def _constrain_tab(self, x):
+        if self.mesh is None or self.n_shards <= 1:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(self.axis, None)))
+
+    def _step(self, params, tables, sparse_state, opt_state, batch, lr,
+              sparse_lr):
+        ids = {k: jnp.asarray(v) for k, v in self._ids_fn(batch).items()}
+        emb = {k: self._lookup(tables[k], ids[k], k) for k in tables}
+
+        def run(dense, embs):
+            return self._loss_fn(dense, embs, batch)
+
+        loss, (dg, eg) = jax.value_and_grad(run, argnums=(0, 1))(
+            params, emb)
+        if self._clip:
+            both = {"d": dg, "e": eg}
+            both = global_norm_clip(both, self._clip)
+            dg, eg = both["d"], both["e"]
+
+        new_params, new_opt = self._dense_update(
+            params, dg, opt_state, lr, **self._opt_kwargs)
+
+        b1, b2, eps = self._betas
+        new_tables, new_sparse = {}, {}
+        for k in tables:
+            rows_pad = tables[k].shape[0]  # padded stored row count
+            flat = stored_rows(ids[k].reshape(-1), self.rows[k],
+                               self.n_shards)
+            g2 = eg[k].reshape(flat.shape[0], -1)
+            # SelectedRows merge: duplicates summed ONCE, then one
+            # lazy-Adam write per touched row (sentinel rows_pad drops)
+            uids, inv = jnp.unique(flat, size=flat.shape[0],
+                                   fill_value=rows_pad,
+                                   return_inverse=True)
+            seg = jax.ops.segment_sum(g2, inv.reshape(-1),
+                                      num_segments=flat.shape[0])
+            nt, ns = sparse_adam_rows(
+                tables[k], sparse_state[k], uids, seg, sparse_lr,
+                beta1=b1, beta2=b2, eps=eps)
+            new_tables[k] = self._constrain_tab(nt)
+            new_sparse[k] = {"m": self._constrain_tab(ns["m"]),
+                             "v": self._constrain_tab(ns["v"]),
+                             "count": ns["count"]}
+        return loss, new_params, new_tables, new_sparse, new_opt
+
+    # -- host-side call -----------------------------------------------------
+
+    def __call__(self, batch, lr: Optional[float] = None):
+        lr = self._lr if lr is None else float(lr)
+        host_ids = {k: np.asarray(v)
+                    for k, v in self._ids_fn(batch).items()}
+        n_ids = sum(int(v.size) for v in host_ids.values())
+        n_unique = sum(int(np.unique(v).size) for v in host_ids.values())
+        xbytes = sum(exchange_bytes(int(v.size), self.dims[k],
+                                    self.n_shards)
+                     for k, v in host_ids.items())
+        _mstats.EMBEDDING_LOOKUP_IDS.add(n_ids)
+        if n_ids:
+            _mstats.EMBEDDING_UNIQUE_RATIO.set(
+                int(n_unique * 1_000_000 / n_ids))
+        _mstats.EMBEDDING_EXCHANGE_BYTES.add(xbytes)
+        _mstats.SPARSE_ROWS_TOUCHED.add(n_unique)
+        if self.mesh is not None:
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    np.asarray(x), NamedSharding(self.mesh, P())), batch)
+        n = int(np.asarray(self.opt_state["count"]))
+        with _trace_span("sparse.step", cat="step",
+                         args={"step": n, "lookup_ids": n_ids,
+                               "unique_ids": n_unique,
+                               "exchange_bytes": xbytes,
+                               "shards": self.n_shards}):
+            (loss, self.params, self.tables, self.sparse_state,
+             self.opt_state) = self._step_fn(
+                self.params, self.tables, self.sparse_state,
+                self.opt_state, batch, lr, self._sparse_lr)
+        return loss
+
+    # -- topology-independent checkpoint format -----------------------------
+
+    @property
+    def step_count(self) -> int:
+        return int(np.asarray(self.opt_state["count"]))
+
+    def state_dict(self):
+        """Host tree in LOGICAL row order — shard-count independent."""
+        host = lambda t: jax.tree_util.tree_map(np.asarray, t)
+        tabs = {k: to_logical(np.asarray(self.tables[k]), self.rows[k],
+                              self.n_shards)
+                for k in self.tables}
+        sp = {k: {"m": to_logical(np.asarray(s["m"]), self.rows[k],
+                                  self.n_shards),
+                  "v": to_logical(np.asarray(s["v"]), self.rows[k],
+                                  self.n_shards),
+                  "count": np.asarray(s["count"])}
+              for k, s in self.sparse_state.items()}
+        return {"params": {"dense": host(self.params), "tables": tabs},
+                "opt_state": {"dense": host(self.opt_state), "sparse": sp},
+                "step": self.step_count}
+
+    def set_state_dict(self, state):
+        """Sharding is placement, not content: the logical-order host
+        tree is re-permuted and re-placed for THIS mesh's shard count."""
+        def _rep(x):
+            if self.mesh is None:
+                return jnp.asarray(x)
+            return jax.device_put(np.asarray(x),
+                                  NamedSharding(self.mesh, P()))
+
+        def _tab(x):
+            x = to_stored(np.asarray(x), self.n_shards)
+            if self.mesh is None or self.n_shards <= 1:
+                return jnp.asarray(x)
+            return jax.device_put(
+                x, NamedSharding(self.mesh, P(self.axis, None)))
+
+        self.params = jax.tree_util.tree_map(
+            lambda old, new: (jax.device_put(np.asarray(new), old.sharding)
+                              if hasattr(old, "sharding") else
+                              jnp.asarray(np.asarray(new))),
+            self.params, state["params"]["dense"])
+        self.tables = {k: _tab(v)
+                       for k, v in state["params"]["tables"].items()}
+        self.opt_state = jax.tree_util.tree_map(
+            _rep, state["opt_state"]["dense"])
+        self.sparse_state = {
+            k: {"m": _tab(s["m"]), "v": _tab(s["v"]),
+                "count": _rep(s["count"])}
+            for k, s in state["opt_state"]["sparse"].items()}

@@ -41,6 +41,11 @@ def pytest_configure(config):
         "brownout runs; select with `pytest -m chaos` after touching "
         "serving overload paths — tier-1 keeps the fast deterministic "
         "ones)")
+    config.addinivalue_line(
+        "markers",
+        "recsys: recommender-stack tests (paddle_tpu.sparse sharded "
+        "embeddings, DLRM, serving rank path) — select with `pytest -m "
+        "recsys` after touching sparse/ or models/dlrm.py")
 
 
 @pytest.fixture(autouse=True)
