@@ -1421,6 +1421,301 @@ def bench_gpt_tiny_fused(on_accel):
                 "fwd/bwd), best-of-3 timing"}
 
 
+def bench_flash_s2048(on_accel):
+    """ISSUE 17: the real seq-2048 flash A/B — autotuned block config
+    (FLAGS_autotune, shape-keyed trial cache) vs the hand-picked
+    defaults, at BERT-base attention shapes, causal, fwd+bwd.
+
+    vs_baseline here is autotuned-over-hand-picked: >1.0 means the
+    measured trials beat the static block table for this shape. The
+    first autotuned compile runs the 3-5 candidate trials and persists
+    the winner (tools/autotune_cache.json or PADDLE_TPU_AUTOTUNE_CACHE);
+    the timed window then re-jits and HITS the cache — autotune_hits
+    moving is asserted alongside the timing."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.monitor import stats as _st
+    from paddle_tpu.ops.flash_attention import flash_attention_arrays
+
+    B, H, S, D = (4, 12, 2048, 64) if on_accel else (1, 2, 2048, 64)
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)) * 0.05, dtype)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)) * 0.05, dtype)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)) * 0.05, dtype)
+
+    if not on_accel:
+        # CPU: Pallas only runs under interpret (minutes at S=2048), so
+        # the recorded number is the composed-jnp fallback — the row
+        # exists with provenance; the A/B itself needs an accelerator.
+        fn = jax.jit(lambda a, b, c: flash_attention_arrays(
+            a, b, c, causal=True))
+        jax.block_until_ready(fn(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / 3
+        return {"value": round(B * S / dt, 1), "unit": "tokens/sec",
+                "vs_baseline": None, "mfu": None,
+                "note": "cpu smoke: composed-jnp fallback, fwd only; "
+                        "the autotuned-vs-hand-picked A/B runs the "
+                        "Pallas kernel and needs an accelerator"}
+
+    iters = 20
+
+    def fwd_bwd(a, b, c):
+        def f(aa, bb, cc):
+            return jnp.sum(flash_attention_arrays(
+                aa, bb, cc, causal=True).astype(jnp.float32))
+        return jax.grad(f, argnums=(0, 1, 2))(a, b, c)
+
+    def one_leg(auto):
+        paddle.set_flags({"FLAGS_autotune": int(auto)})
+        try:
+            fn = jax.jit(fwd_bwd)          # fresh wrapper => retrace
+            jax.block_until_ready(fn(q, k, v))   # compile (+trials)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn(q, k, v)
+                jax.block_until_ready(out)
+                best = min(best, (time.perf_counter() - t0) / iters)
+        finally:
+            paddle.set_flags({"FLAGS_autotune": 0})
+        return best
+
+    hand_s = one_leg(False)
+    h0, m0 = _st.AUTOTUNE_HITS.get(), _st.AUTOTUNE_MISSES.get()
+    auto_s = one_leg(True)
+    hits, misses = _st.AUTOTUNE_HITS.get() - h0, _st.AUTOTUNE_MISSES.get() - m0
+    # causal attention FLOPs: fwd = 0.5 * 4*B*H*S^2*D; bwd ~= 2.5x fwd
+    # (the flash-attention repo's counting convention)
+    flops = 3.5 * 0.5 * 4.0 * B * H * S * S * D
+    best_s = min(hand_s, auto_s)
+    return {"value": round(B * S / best_s, 1), "unit": "tokens/sec",
+            "mfu": round(flops / best_s / 197e12, 4),
+            "vs_baseline": round(hand_s / auto_s, 4),
+            "hand_picked_ms": round(hand_s * 1e3, 3),
+            "autotuned_ms": round(auto_s * 1e3, 3),
+            "autotune_hits": hits, "autotune_misses": misses,
+            "baseline": "the hand-picked block table (_auto_block) this "
+                        "repo shipped before ISSUE 17 — vs_baseline is "
+                        "hand_picked_ms/autotuned_ms at this shape",
+            "note": "causal flash fwd+bwd at (%d,%d,%d,%d) bf16, "
+                    "best-of-3x%d; mfu uses the 3.5x-causal-fwd FLOP "
+                    "convention over the v5e 197e12 peak"
+                    % (B, H, S, D, iters)}
+
+
+def bench_gpt_tiny_fp8(on_accel):
+    """ISSUE 17: fp8 (e4m3) MLP A/B on gpt_tiny — GPTConfig(fp8=True)
+    routes both MLP matmuls through the fused-dequant fp8 kernel with
+    just-in-time per-tensor scaling and STE gradients. Runs on any
+    backend (off-TPU the kernel falls back to the identical-op-sequence
+    reference, so CPU measures the quantize+bf16-dot math, not the MXU
+    fp8 rate — the note says which one the row is)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import gpt_init, gpt_loss, gpt_tiny
+    from paddle_tpu.monitor import stats as _st
+
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    batch, seq, n_layers = 8, 128, 8
+    iters = 20 if on_accel else 8
+    rng = np.random.default_rng(0)
+    tokens = None
+
+    def one_leg(fp8):
+        nonlocal tokens
+        cfg = gpt_tiny(seq_len=seq, n_layers=n_layers, dtype=dtype,
+                       fp8=fp8)
+        tree = jax.device_put(gpt_init(cfg, seed=0))
+        if tokens is None:
+            tokens = (jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                               (batch, seq)), jnp.int32),
+                      jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                               (batch, seq)), jnp.int32))
+        grad_fn = jax.jit(jax.value_and_grad(
+            lambda pt, b: gpt_loss(cfg, pt, b)))
+        loss, g = grad_fn(tree, tokens)
+        jax.block_until_ready(g)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, g = grad_fn(tree, tokens)
+            jax.block_until_ready(g)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(tree))
+        return batch / best, float(loss), n_params
+
+    c0 = _st.FP8_MATMUL_CALLS.get()
+    base_sps, base_loss, n_params = one_leg(False)
+    fp8_sps, fp8_loss, _ = one_leg(True)
+    return {"value": round(fp8_sps, 2), "unit": "samples/sec",
+            "mfu": round(_mfu(n_params, seq, fp8_sps), 4),
+            "vs_baseline": round(fp8_sps / base_sps, 4),
+            "baseline_sps": round(base_sps, 2),
+            "loss_drift": round(abs(fp8_loss - base_loss), 4),
+            "fp8_matmul_calls": _st.FP8_MATMUL_CALLS.get() - c0,
+            "baseline": "the same model/seed/data with the default "
+                        "(unfused jnp) MLP — vs_baseline is "
+                        "fp8_sps/default_sps",
+            "note": ("fp8 Pallas kernel (fused dequant epilogue), "
+                     "jit per-tensor scaling, grad fwd+bwd timed"
+                     if on_accel else
+                     "cpu: fp8 reference path (quantize + bf16 dots — "
+                     "numerics identical to the kernel, no MXU fp8 "
+                     "rate); loss_drift is the expected e4m3 "
+                     "quantization error, NOT a bug"),
+            }
+
+
+def bench_ragged_decode(on_accel):
+    """ISSUE 17: ragged paged-attention decode A/B — live-length-clamped
+    K/V index map (FLAGS_ragged_decode) vs the dense map that DMAs every
+    table slot. Batch of decode queries whose live lengths are ragged
+    (1..max); the win is DMA elision, so only an accelerator shows it —
+    the CPU row is the interpret-mode parity smoke at a tiny pool."""
+    import math as _math
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.paged_attention import paged_attention_arrays
+
+    rng = np.random.default_rng(0)
+    if on_accel:
+        B, nh, hd, bs, W = 32, 8, 128, 16, 64
+        dtype = jnp.bfloat16
+        iters = 50
+    else:
+        B, nh, hd, bs, W = 4, 8, 128, 8, 4
+        dtype = jnp.float32
+        iters = 5
+    n_blocks = B * W + 1
+    q = jnp.asarray(rng.standard_normal((B, nh, hd)), dtype)
+    kb = jnp.asarray(rng.standard_normal((n_blocks, nh, bs, hd)), dtype)
+    vb = jnp.asarray(rng.standard_normal((n_blocks, nh, bs, hd)), dtype)
+    tables = jnp.asarray(1 + np.arange(B * W, dtype=np.int32).reshape(B, W))
+    # ragged live lengths: 1..W*bs, mean ~half the pool
+    lengths = jnp.asarray(rng.integers(1, W * bs + 1, (B,)), jnp.int32)
+    scale = 1.0 / _math.sqrt(hd)
+    interp = not on_accel
+
+    def one_leg(ragged):
+        fn = jax.jit(lambda qq: paged_attention_arrays(
+            qq, kb, vb, tables, lengths, scale=scale,
+            interpret=interp, ragged=ragged))
+        jax.block_until_ready(fn(q))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best, fn(q)
+
+    dense_s, out_d = one_leg(False)
+    ragged_s, out_r = one_leg(True)
+    identical = bool(jnp.array_equal(out_d, out_r))
+    live = int(jnp.sum(lengths))
+    return {"value": round(B / ragged_s, 1), "unit": "decode_tokens/sec",
+            "mfu": None,
+            "vs_baseline": round(dense_s / ragged_s, 4),
+            "dense_ms": round(dense_s * 1e3, 3),
+            "ragged_ms": round(ragged_s * 1e3, 3),
+            "bit_identical": identical,
+            "live_frac": round(live / (B * W * bs), 3),
+            "baseline": "the dense K/V index map (every pool slot "
+                        "DMA'd) — vs_baseline is dense_ms/ragged_ms; "
+                        "expected ~1/live_frac on TPU, ~1.0 under "
+                        "interpret (no DMA cost model)",
+            "note": ("Pallas decode kernel, ragged lengths 1..%d, "
+                     "batch %d" % (W * bs, B) if on_accel else
+                     "cpu: interpret-mode smoke — pins bit-identical "
+                     "outputs; interpret has no DMA cost so the A/B "
+                     "delta only shows on TPU")}
+
+
+def bench_overlap_zero2(on_accel):
+    """ISSUE 17: MEASURED grad-collective overlap under ZeRO-2
+    (FLAGS_overlap_zero2: the in-backward collective is a
+    reduce-scatter, not a pmean) on the dp=2 x sharding=4 mesh, and the
+    measured hidden_comm_frac fed back into the fleet.auto cost model —
+    the row records both the measurement and how it moves the planner
+    score vs the assumed-0.5 default."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.auto.cost_model import ModelStats
+    from paddle_tpu.distributed.fleet.auto.planner import plan
+    from paddle_tpu.models import gpt_init, gpt_loss, gpt_tiny
+    from paddle_tpu.parallel.mesh import create_mesh, set_mesh
+    from paddle_tpu.parallel.train_step import DistributedTrainStep, P
+
+    if len(jax.devices()) < 8:
+        return {"value": None, "unit": "hidden_comm_frac",
+                "note": "skipped: needs 8 devices (dp=2 x sharding=4)"}
+    rng = np.random.default_rng(0)
+    paddle.set_flags({"FLAGS_overlap_grads": 1, "FLAGS_overlap_zero2": 1})
+    try:
+        create_mesh(dp=2, sharding=4, pp=1, mp=1)
+        cfg = gpt_tiny(seq_len=64, n_layers=2, dtype=jnp.float32)
+        params = gpt_init(cfg, seed=0)
+        specs = jax.tree_util.tree_map(lambda _: P(), params)
+        st = DistributedTrainStep(
+            lambda p, b: gpt_loss(cfg, p, b), params, specs,
+            optimizer="adamw", lr=1e-4, zero=2)
+        batch = (jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 64)),
+                             jnp.int32),
+                 jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 64)),
+                             jnp.int32))
+        m = st.measure_overlap(batch, reps=3)
+        hf = m.get("hidden_frac")
+        rs2_active = bool(getattr(st, "_overlap_zero2", False))
+    finally:
+        set_mesh(None)
+        paddle.set_flags({"FLAGS_overlap_grads": 0,
+                          "FLAGS_overlap_zero2": 0})
+
+    # feed the measurement into the planner: same model/topology scored
+    # with the assumed 0.5 overlap vs the measured fraction
+    stats = ModelStats.from_params(params, layers=cfg.n_layers,
+                                   hidden=cfg.hidden, seq_len=64)
+    p_assumed = plan(stats=stats, global_batch=64, n_devices=8,
+                     constraints={"pp": 1, "mp": 1})
+    p_meas = plan(stats=stats, global_batch=64, n_devices=8,
+                  constraints={"pp": 1, "mp": 1},
+                  hidden_comm_frac=hf)
+    return {"value": None if hf is None else round(hf, 4),
+            "unit": "hidden_comm_frac", "mfu": None,
+            "vs_baseline": None,
+            "step_ms": round(m["step_ms"], 3),
+            "compute_ms": round(m["compute_ms"], 3),
+            "comm_ms": round(m["comm_ms"], 3),
+            "zero2_reduce_scatter": rs2_active,
+            "plan_assumed": p_assumed.chosen.describe(),
+            "plan_measured": p_meas.chosen.describe(),
+            "plan_score_ratio": round(
+                p_meas.chosen.score / max(p_assumed.chosen.score, 1e-12),
+                4),
+            "note": ("measured on the real ICI mesh" if on_accel else
+                     "8-device CPU host mesh: collectives are memcpys, "
+                     "so hidden_frac trends ~1.0 — the MEASUREMENT "
+                     "machinery is what this row exercises; plan_* show "
+                     "the measured fraction changing the cost-model "
+                     "score vs the assumed 0.5")}
+
+
 def bench_ring_attention(on_accel):
     """Long-context flagship: ring+flash attention (context parallelism
     whose per-hop block compute is the Pallas flash kernel,
@@ -1818,6 +2113,10 @@ def main():
                      ("gpt_1p3b_auto", bench_gpt_1p3b_auto),
                      ("ring_attention", bench_ring_attention),
                      ("gpt_tiny_fused", bench_gpt_tiny_fused),
+                     ("flash_s2048", bench_flash_s2048),
+                     ("gpt_tiny_fp8", bench_gpt_tiny_fp8),
+                     ("ragged_decode", bench_ragged_decode),
+                     ("overlap_zero2", bench_overlap_zero2),
                      ("gpt_tiny_serving", bench_gpt_tiny_serving),
                      ("serving_spec", bench_serving_spec),
                      ("serving_load", bench_serving_load),

@@ -9,6 +9,12 @@ Mechanics: auto_cast flips a thread-local AMP state consulted by the layer
 forward paths (Linear/Conv/Matmul cast inputs to the amp dtype; denylist ops
 like softmax/log stay fp32) — same allow/deny structure as the reference's
 AmpOperators lists (imperative/amp_auto_cast.cc:55).
+
+Below bf16 there is an fp8 (e4m3) matmul path: ``amp.fp8`` carries the
+per-tensor scaling state (just-in-time and delayed amax-history modes,
+checkpointable like GradScaler) over the fused-dequant Pallas kernel in
+``ops/fp8_matmul.py``; gate with ``FLAGS_fp8_matmul`` or
+``GPTConfig(fp8=True)``.
 """
 from __future__ import annotations
 

@@ -1,0 +1,168 @@
+"""fp8 (e4m3) training support: scaling state + differentiable matmul.
+
+The scale-management half of the fp8 path (ISSUE 17); the Pallas kernel
+lives in ``ops/fp8_matmul.py``. Two scaling modes, both per-tensor:
+
+- **just-in-time** (:func:`fp8_linear`): scale = amax(tensor)/448
+  computed on the spot. Stateless, so it drops into any forward (the
+  GPT MLP wiring, ``GPTConfig(fp8=True)`` / ``FLAGS_fp8_matmul``) with
+  no state threading; costs one extra reduction per operand.
+- **delayed** (:func:`init_delayed_state` / :func:`delayed_scale` /
+  :func:`update_delayed_state`): the standard fp8 recipe — quantize with
+  a scale derived from a rolling amax HISTORY (max over the last
+  ``window`` steps), then record the current step's amax. The state is a
+  plain pytree ``{"amax_history": (window,) f32, "scale": () f32}``, so
+  it rides inside jit like optimizer state; :class:`DelayedScaling`
+  wraps a dict of named states with the same ``state_dict`` /
+  ``load_state_dict`` surface as :class:`~paddle_tpu.amp.GradScaler`
+  for checkpointing.
+
+Gradients: :func:`fp8_linear` is a ``custom_vjp`` — the forward runs the
+real fp8 kernel on the quantized operands; the backward differentiates
+through the quantize-dequantize as a straight-through estimator (grads
+computed against the DEQUANTIZED operands in bf16, zero cotangent into
+the scales). That is the same STE contract as ``quantization.fake_quant``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.fp8_matmul import E4M3_MAX, fp8_matmul_arrays
+
+__all__ = ["E4M3_MAX", "quantize_fp8", "fp8_linear", "init_delayed_state",
+           "delayed_scale", "update_delayed_state", "fp8_linear_delayed",
+           "DelayedScaling"]
+
+
+def quantize_fp8(x, scale):
+    """x / scale, saturated to the e4m3 range, cast to float8_e4m3fn."""
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-12)
+    q = jnp.clip(x.astype(jnp.float32) / s, -E4M3_MAX, E4M3_MAX)
+    return q.astype(jnp.float8_e4m3fn)
+
+
+def _jit_scale(t):
+    """Just-in-time per-tensor scale: amax/448 (non-differentiable)."""
+    amax = jax.lax.stop_gradient(jnp.max(jnp.abs(t.astype(jnp.float32))))
+    return jnp.maximum(amax, 1e-12) / E4M3_MAX
+
+
+@jax.custom_vjp
+def _fp8_mm(x, w, sx, sw):
+    xq = quantize_fp8(x, sx)
+    wq = quantize_fp8(w, sw)
+    return fp8_matmul_arrays(xq, wq, sx, sw, out_dtype=x.dtype)
+
+
+def _fp8_mm_fwd(x, w, sx, sw):
+    xq = quantize_fp8(x, sx)
+    wq = quantize_fp8(w, sw)
+    y = fp8_matmul_arrays(xq, wq, sx, sw, out_dtype=x.dtype)
+    # zero-size sentinels carry the primal dtypes through the residuals
+    # (raw dtypes are not valid pytree leaves)
+    return y, (xq, wq, sx, sw, jnp.zeros((0,), x.dtype),
+               jnp.zeros((0,), w.dtype))
+
+
+def _fp8_mm_bwd(res, g):
+    # STE: grads against the dequantized operands, bf16 dots, f32 accum —
+    # what the compiled bwd of a bf16 matmul would run.
+    xq, wq, sx, sw, xs, ws = res
+    xdt, wdt = xs.dtype, ws.dtype
+    xd = xq.astype(jnp.bfloat16)
+    wd = wq.astype(jnp.bfloat16)
+    g16 = g.astype(jnp.bfloat16)
+    dx = jax.lax.dot_general(
+        g16, wd, (((g.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sw
+    lead = tuple(range(g.ndim - 1))
+    dw = jax.lax.dot_general(
+        xd, g16, ((lead, lead), ((), ())),
+        preferred_element_type=jnp.float32) * sx
+    return (dx.astype(xdt), dw.astype(wdt),
+            jnp.zeros_like(sx), jnp.zeros_like(sw))
+
+
+_fp8_mm.defvjp(_fp8_mm_fwd, _fp8_mm_bwd)
+
+
+def fp8_linear(x, w, bias=None):
+    """``x @ w (+ bias)`` through the fp8 kernel, just-in-time per-tensor
+    scaling, STE gradients. x [..., K] fp; w [K, N] fp."""
+    y = _fp8_mm(x, w, _jit_scale(x), _jit_scale(w))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+# -- delayed scaling ---------------------------------------------------------
+
+def init_delayed_state(window: int = 16):
+    """Fresh per-tensor delayed-scaling state pytree."""
+    return {"amax_history": jnp.zeros((int(window),), jnp.float32),
+            "scale": jnp.asarray(1.0, jnp.float32)}
+
+
+def delayed_scale(state):
+    """The scale the CURRENT step should quantize with (history max)."""
+    return state["scale"]
+
+
+def update_delayed_state(state, t):
+    """Record ``amax(t)`` and refresh the scale from the history max.
+    Returns the new state; pure, jit-friendly."""
+    amax = jax.lax.stop_gradient(jnp.max(jnp.abs(t.astype(jnp.float32))))
+    hist = jnp.roll(state["amax_history"], 1).at[0].set(amax)
+    scale = jnp.maximum(jnp.max(hist), 1e-12) / E4M3_MAX
+    return {"amax_history": hist, "scale": scale}
+
+
+def fp8_linear_delayed(x, w, x_state, w_state, bias=None):
+    """Delayed-scaling fp8 linear: quantize with the HISTORY scales, then
+    record this step's amaxes. Returns (y, new_x_state, new_w_state)."""
+    y = _fp8_mm(x, w, delayed_scale(x_state), delayed_scale(w_state))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y, update_delayed_state(x_state, x), update_delayed_state(w_state, w)
+
+
+class DelayedScaling:
+    """Host-side registry of named delayed-scaling states with the
+    GradScaler checkpoint surface.
+
+        fp8 = DelayedScaling(window=16)
+        y, fp8["fc_x"], fp8["fc_w"] = fp8_linear_delayed(
+            x, w, fp8["fc_x"], fp8["fc_w"])
+        ckpt["fp8"] = fp8.state_dict()     # plain nested dict of arrays
+        fp8.load_state_dict(ckpt["fp8"])   # exact round-trip
+    """
+
+    def __init__(self, window: int = 16):
+        self._window = int(window)
+        self._states: dict = {}
+
+    def __getitem__(self, name):
+        if name not in self._states:
+            self._states[name] = init_delayed_state(self._window)
+        return self._states[name]
+
+    def __setitem__(self, name, state):
+        self._states[name] = state
+
+    def names(self):
+        return sorted(self._states)
+
+    def state_dict(self):
+        import numpy as np
+
+        return {name: {"amax_history": np.asarray(st["amax_history"]),
+                       "scale": np.asarray(st["scale"])}
+                for name, st in self._states.items()}
+
+    def load_state_dict(self, d):
+        for name, st in d.items():
+            self._states[name] = {
+                "amax_history": jnp.asarray(st["amax_history"],
+                                            jnp.float32),
+                "scale": jnp.asarray(st["scale"], jnp.float32)}

@@ -261,6 +261,45 @@ serving_mesh = [_int_or_zero(os.environ.get("FLAGS_serving_mesh", "0"))]
 prefix_cache = [_truthy(os.environ.get("FLAGS_prefix_cache", "0"))]
 
 
+# FLAGS_autotune (ISSUE 17): shape-keyed Pallas block autotuning — at the
+# first compile of a kernel family for a concrete (kernel, shape, dtype,
+# backend) key, time a handful of legal block configs and persist the
+# winner to tools/autotune_cache.json (ops/autotune.py); later compiles
+# consult the cache. Default OFF; unset, every kernel keeps its
+# hand-picked `_auto_block` defaults bit-for-bit. Kernel modules mirror
+# the cell via `autotune_watchers` so no jit-reachable code reads it.
+autotune = [_truthy(os.environ.get("FLAGS_autotune", "0"))]
+autotune_watchers: list = []
+
+# FLAGS_fp8_matmul (ISSUE 17): fp8 (e4m3) matmul path for the block
+# projections — delayed-scaling amax history through paddle_tpu.amp.fp8,
+# dequant fused into the kernel epilogue (ops/fp8_matmul.py, the int8
+# epilogue pattern). Default OFF; the bf16 path is pinned bit-for-bit
+# while unset. `GPTConfig(fp8=True)` opts a model in explicitly.
+fp8_matmul = [_truthy(os.environ.get("FLAGS_fp8_matmul", "0"))]
+fp8_matmul_watchers: list = []
+
+# FLAGS_ragged_decode (ISSUE 17): ragged paged-attention decode — the
+# paged kernel's K/V index map clamps dead table iterations (past the
+# slot's live length) to the last live block, so consecutive grid steps
+# re-reference the same block and the DMA is elided; decode cost tracks
+# live tokens instead of padded table width. Compute is already guarded
+# per-iteration, so ON is bit-identical to OFF by construction; default
+# OFF keeps the PR-7 index map verbatim. Mirrored via watchers
+# (ragged_decode_watchers) — the decode wrapper is jit-reachable.
+ragged_decode = [_truthy(os.environ.get("FLAGS_ragged_decode", "0"))]
+ragged_decode_watchers: list = []
+
+# FLAGS_overlap_zero2 (ISSUE 17): extend FLAGS_overlap_grads' in-backward
+# gradient collective from pmean to the ZeRO-2 reduce-scatter — sharded
+# grad buckets issue psum_scatter INSIDE the backward so the scatter of
+# layer N overlaps the backward compute of layers < N, and each device
+# only ever materializes its grad shard. Requires FLAGS_overlap_grads=1
+# and zero level >= 2. Default OFF; the post-backward GSPMD
+# reduce-scatter path is pinned bit-for-bit while unset.
+overlap_zero2 = [_truthy(os.environ.get("FLAGS_overlap_zero2", "0"))]
+
+
 def set_flag(name: str, value) -> None:
     if name.endswith("check_nan_inf"):
         check_nan_inf[0] = _truthy(value)
@@ -297,6 +336,20 @@ def set_flag(name: str, value) -> None:
         serving_mesh[0] = _int_or_zero(value)
     elif name.endswith("prefix_cache"):
         prefix_cache[0] = _truthy(value)
+    elif name.endswith("autotune"):
+        autotune[0] = _truthy(value)
+        for watcher in autotune_watchers:
+            watcher(autotune[0])
+    elif name.endswith("fp8_matmul"):
+        fp8_matmul[0] = _truthy(value)
+        for watcher in fp8_matmul_watchers:
+            watcher(fp8_matmul[0])
+    elif name.endswith("ragged_decode"):
+        ragged_decode[0] = _truthy(value)
+        for watcher in ragged_decode_watchers:
+            watcher(ragged_decode[0])
+    elif name.endswith("overlap_zero2"):
+        overlap_zero2[0] = _truthy(value)
     if _lib is not None:
         _lib.ptpu_flag_set(name.encode(), str(value).encode())
     else:

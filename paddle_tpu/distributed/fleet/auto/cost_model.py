@@ -220,9 +220,19 @@ def enumerate_plans(n_devices: int, global_batch: int,
 
 
 def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
-             hw: HardwareSpec) -> PlanCandidate:
+             hw: HardwareSpec,
+             hidden_comm_frac: float = None) -> PlanCandidate:
     """Fill the candidate's HBM/bubble/collective estimates and rank score
-    (see module docstring for the formulas). Returns the same object."""
+    (see module docstring for the formulas). Returns the same object.
+
+    ``hidden_comm_frac``: measured fraction of the grad collective hidden
+    inside the backward (``DistributedTrainStep.measure_overlap()``'s
+    ``hidden_frac``). None keeps the historical assumption (0.5 credit on
+    the dp all-reduce, none on the sharding collective). A measured value
+    replaces the dp credit, and — because FLAGS_overlap_zero2 issues the
+    ZeRO-2 reduce-scatter in-backward too — credits the reduce-scatter
+    HALF of the sharding collective at zero >= 2 (the update-boundary
+    all-gather half still cannot hide)."""
     edge_bytes = stats.param_bytes - stats.layer_bytes
     tp_frac = stats.tp_bytes / stats.param_bytes if stats.param_bytes else 0.0
 
@@ -286,17 +296,28 @@ def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
 
     # collective bytes per step (per device)
     replica_grad = split(stats.n_params * stats.grad_dtype_bytes)
+    # visible (non-hidden) fraction of the in-backward grad collective:
+    # 0.5 assumed historically; a MEASURED hidden_comm_frac (ISSUE 17,
+    # measure_overlap) replaces the assumption
+    visible = (0.5 if hidden_comm_frac is None
+               else 1.0 - max(0.0, min(1.0, float(hidden_comm_frac))))
     coll = 0.0
     if c.dp > 1:
-        # ring all-reduce; half counted as hidden — the dp gradient
-        # reduction overlaps the remaining backward (FLAGS_overlap_grads,
-        # PR-6 measured hidden_comm_frac ~0.5+), which ZeRO's
-        # reduce-scatter/all-gather pair at the update boundary cannot
-        coll += 0.5 * 2.0 * replica_grad * (c.dp - 1) / c.dp
+        # ring all-reduce; the hidden share overlaps the remaining
+        # backward (FLAGS_overlap_grads; PR-6 measured ~0.5+), which
+        # ZeRO's update-boundary all-gather cannot
+        coll += visible * 2.0 * replica_grad * (c.dp - 1) / c.dp
     if c.sharding > 1:
         # ZeRO-0/1 all-reduce over the sharding group; 2/3 reduce-scatter
-        # + param all-gather (same wire bytes, half the HBM traffic)
-        coll += 2.0 * replica_grad * (c.sharding - 1) / c.sharding
+        # + param all-gather (same wire bytes, half the HBM traffic).
+        # With a MEASURED overlap and zero >= 2 (FLAGS_overlap_zero2
+        # issues the reduce-scatter in-backward), the scatter half earns
+        # the same hidden credit; the all-gather half never does.
+        shard_bytes = 2.0 * replica_grad * (c.sharding - 1) / c.sharding
+        if hidden_comm_frac is not None and c.zero >= 2:
+            coll += shard_bytes * (0.5 * visible + 0.5)
+        else:
+            coll += shard_bytes
         if c.zero >= 3:
             coll += split(stats.param_bytes) * (c.sharding - 1) / c.sharding
     if c.mp > 1 and stats.hidden:

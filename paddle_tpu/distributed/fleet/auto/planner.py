@@ -129,9 +129,15 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
          allow_mp: Optional[bool] = None,
          zero_levels=(0, 1, 2, 3), max_micro: int = 64,
          constraints: Optional[Dict[str, int]] = None,
-         schedule: str = "1f1b") -> ParallelPlan:
+         schedule: str = "1f1b",
+         hidden_comm_frac: Optional[float] = None) -> ParallelPlan:
     """Enumerate legal candidates, estimate each, pick the fastest that
     fits per-chip HBM.
+
+    ``hidden_comm_frac``: measured grad-collective overlap fraction from
+    ``DistributedTrainStep.measure_overlap()["hidden_frac"]`` — feeds the
+    cost model's overlap credit (see :func:`cost_model.estimate`) so the
+    plan score uses the MEASURED value instead of the assumed 0.5.
 
     Raises ``ValueError`` when NO candidate fits (the error carries the
     closest candidate's shortfall — the actionable number).
@@ -166,7 +172,8 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
             f"{n_devices} devices / global_batch={global_batch} / "
             f"layers={stats.layers} (constraints={constraints})")
     for c in cands:
-        estimate(c, stats, global_batch, hw)
+        estimate(c, stats, global_batch, hw,
+                 hidden_comm_frac=hidden_comm_frac)
     # fastest fitting plan first. Scores are bucketed at 2% of the best —
     # the model's resolution ends well before that — and ties within a
     # bucket resolve to the simpler topology (less pipe, less tp, less

@@ -42,6 +42,12 @@ __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
            "gpt_prefill_prefix", "gpt_verify_step", "gpt_verify_step_paged",
            "quantize_gpt_weights"]
 
+# Module-local mirror of FLAGS_fp8_matmul (no core.native subscript in
+# jit-reachable code); set_flags syncs it through the watcher list.
+_fp8 = [bool(_native.fp8_matmul[0])]
+_native.fp8_matmul_watchers.append(
+    lambda v: _fp8.__setitem__(0, bool(v)))
+
 
 @dataclasses.dataclass
 class GPTConfig:
@@ -71,6 +77,11 @@ class GPTConfig:
     # FLAGS_fused_kernels at trace time; off-TPU the fused entry runs the
     # identical composed math, so this is numerics-neutral on CPU.
     fused_mlp: Optional[bool] = None
+    # fp8 (e4m3) MLP matmuls (ops/fp8_matmul.py kernel, amp/fp8.py
+    # just-in-time per-tensor scaling, STE gradients). None = follow
+    # FLAGS_fp8_matmul at trace time. NOT numerics-neutral (that is the
+    # point); takes the unfused MLP path when both fp8 and fused are on.
+    fp8: Optional[bool] = None
 
     @property
     def head_dim(self):
@@ -252,7 +263,15 @@ def _block_kv(cfg: GPTConfig, p, x):
 
     fused = (cfg.fused_mlp if cfg.fused_mlp is not None
              else _native.fused_kernels[0])
-    if fused:
+    fp8 = cfg.fp8 if cfg.fp8 is not None else _fp8[0]
+    if fp8:
+        from ..amp.fp8 import fp8_linear
+
+        h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+        h = jax.nn.gelu(fp8_linear(h, p["fc_w"].astype(cd),
+                                   p["fc_b"].astype(cd)))
+        x = x + fp8_linear(h, p["out_w"].astype(cd), p["out_b"].astype(cd))
+    elif fused:
         from ..ops.fused_kernels import fused_ln_mlp
 
         x = fused_ln_mlp(x, p["fc_w"].astype(cd), p["fc_b"].astype(cd),

@@ -357,6 +357,12 @@ DEFAULT_STATS = (
     "embedding_unique_ratio",    # gauge: unique/total ids in the last batch, ppm
     "embedding_exchange_bytes",  # all-to-all bytes moved by sharded lookups
     "sparse_rows_touched",       # table rows updated by sparse optimizer steps
+    # kernel autotuner + fp8 path (ISSUE 17)
+    "autotune_hits",          # block configs served from the autotune cache
+    "autotune_misses",        # cache misses that triggered a trial sweep
+    "autotune_trials_ms",     # cumulative wall ms spent timing trial configs
+    "fused_kernel_fallbacks",  # Pallas entries that fell back to composed jnp
+    "fp8_matmul_calls",       # fp8 (e4m3) matmul dispatches
 )
 
 for _n in DEFAULT_STATS:
@@ -437,6 +443,11 @@ EMBEDDING_LOOKUP_IDS = _registry.get_stat("embedding_lookup_ids")
 EMBEDDING_UNIQUE_RATIO = _registry.get_stat("embedding_unique_ratio")
 EMBEDDING_EXCHANGE_BYTES = _registry.get_stat("embedding_exchange_bytes")
 SPARSE_ROWS_TOUCHED = _registry.get_stat("sparse_rows_touched")
+AUTOTUNE_HITS = _registry.get_stat("autotune_hits")
+AUTOTUNE_MISSES = _registry.get_stat("autotune_misses")
+AUTOTUNE_TRIALS_MS = _registry.get_stat("autotune_trials_ms")
+FUSED_KERNEL_FALLBACKS = _registry.get_stat("fused_kernel_fallbacks")
+FP8_MATMUL_CALLS = _registry.get_stat("fp8_matmul_calls")
 
 
 # -- pre-registered latency histograms (ISSUE 15) ---------------------------

@@ -1,0 +1,331 @@
+"""Shape-keyed Pallas block-config autotuner (ISSUE 17).
+
+Every kernel in the library ships hand-picked block sizes (`_auto_block`,
+`_pick_block_b`, `_pick`, `_block_rows`). Those defaults are right at the
+shapes they were tuned on and wrong elsewhere — the S=2048 flash cliff is
+a single degenerate whole-sequence block chosen by `_auto_block`. This
+module makes the choice measured instead of guessed:
+
+- at the FIRST compile of a kernel family for a concrete
+  ``(kernel, shape, dtype, backend)`` key, time 3-5 legal block configs
+  on synthetic inputs and keep the winner;
+- persist winners to a JSON cache (``tools/autotune_cache.json`` by
+  default) keyed like the graftlint fingerprints
+  (``kernel:shape:dtype:backend`` — line-free, host-portable,
+  committable);
+- consult the cache on every later compile (an O(1) dict hit at trace
+  time).
+
+Gated by ``FLAGS_autotune`` (default OFF: every kernel keeps its
+hand-picked defaults bit-for-bit). The flag cell is mirrored here
+through ``core.native.autotune_watchers`` so no jit-reachable function
+reads the native cell directly (GL002). Trials run once per key on the
+host at trace time, never inside a compiled program; timing therefore
+uses a bare ``perf_counter`` and blocks only on locally-built synthetic
+arrays.
+
+Gauges: ``autotune_hits`` / ``autotune_misses`` / ``autotune_trials_ms``.
+CLI: ``python -m tools.autotune`` (inspect / pre-populate / --check).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from time import perf_counter
+from typing import Callable, Dict, List, Optional
+
+from ..core import native as _native
+from ..monitor import stats as _mstats
+
+__all__ = ["enabled", "make_key", "get_config", "register_family",
+           "families", "tune", "cache_entries", "stale_entries",
+           "set_cache_path", "cache_path", "reset", "note_fallback"]
+
+_DEFAULT_CACHE = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools",
+    "autotune_cache.json"))
+
+_lock = threading.RLock()
+_cache_path = [os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE", _DEFAULT_CACHE)]
+_cache: list = [None]          # lazy {key: entry}; None = not loaded yet
+_warned: set = set()           # corrupt keys already warned about
+
+# Mirror of the FLAGS_autotune cell: module-local so jit-reachable
+# consumers never subscript a core.native cell (GL002); set_flags keeps
+# it in sync through the watcher list.
+_enabled = [bool(_native.autotune[0])]
+_native.autotune_watchers.append(
+    lambda v: _enabled.__setitem__(0, bool(v)))
+
+# kernel family -> {"candidates": fn(shape, dtype) -> [config, ...],
+#                   "bench": fn(shape, dtype, config) -> None (one run,
+#                            blocked on completion)}
+_FAMILIES: Dict[str, dict] = {}
+
+
+def enabled() -> bool:
+    return _enabled[0]
+
+
+def cache_path() -> str:
+    return _cache_path[0]
+
+
+def set_cache_path(path: str) -> None:
+    """Point the autotuner at a different cache file (tests, CLI)."""
+    with _lock:
+        _cache_path[0] = path
+        _cache[0] = None
+        _warned.clear()
+
+
+def reset() -> None:
+    """Drop the in-memory cache so the next consult re-reads the file
+    (simulates a process restart for the round-trip tests)."""
+    with _lock:
+        _cache[0] = None
+        _warned.clear()
+
+
+def register_family(name: str,
+                    candidates: Callable[[tuple, str], List[dict]],
+                    bench: Callable[[tuple, str, dict], None]) -> None:
+    """Register a kernel family. ``candidates`` maps a concrete (shape,
+    dtype) to the legal block configs worth trying (the hand-picked
+    default should be among them); ``bench`` runs the kernel once with a
+    given config on synthetic inputs and blocks until done."""
+    _FAMILIES[name] = {"candidates": candidates, "bench": bench}
+
+
+def families() -> List[str]:
+    return sorted(_FAMILIES)
+
+
+def _backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — keyless host (CLI inspect)
+        return "unknown"
+
+
+def make_key(kernel: str, shape, dtype: str,
+             backend: Optional[str] = None) -> str:
+    """Cache key, graftlint-fingerprint style: kernel:shape:dtype:backend
+    (e.g. ``flash:16x2048x2048x128:bfloat16:tpu``)."""
+    dims = "x".join(str(int(d)) for d in shape)
+    return "%s:%s:%s:%s" % (kernel, dims, dtype,
+                            backend or _backend())
+
+
+def parse_key(key: str):
+    """Inverse of :func:`make_key`; raises ValueError on malformed keys."""
+    kernel, dims, dtype, backend = key.split(":")
+    shape = tuple(int(d) for d in dims.split("x"))
+    return kernel, shape, dtype, backend
+
+
+def _load() -> dict:
+    if _cache[0] is not None:
+        return _cache[0]
+    entries: dict = {}
+    path = _cache_path[0]
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            entries = dict(raw.get("entries", {}))
+        except (OSError, ValueError) as e:
+            warnings.warn("autotune cache %s unreadable (%s) — starting "
+                          "empty" % (path, e), stacklevel=2)
+    _cache[0] = entries
+    return entries
+
+
+def _save() -> None:
+    path = _cache_path[0]
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "entries": _cache[0]}, f, indent=1,
+                      sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        warnings.warn("autotune cache %s not writable (%s) — winners kept "
+                      "in-memory only" % (path, e), stacklevel=2)
+
+
+def cache_entries() -> dict:
+    with _lock:
+        return dict(_load())
+
+
+def _entry_config(key: str, entry) -> Optional[dict]:
+    """Validate a cache entry; corrupt ones are skipped with a one-shot
+    warning (the trial sweep then repairs the key)."""
+    if isinstance(entry, dict) and isinstance(entry.get("config"), dict):
+        return dict(entry["config"])
+    if key not in _warned:
+        _warned.add(key)
+        warnings.warn("autotune cache entry %r is corrupt (%r) — ignoring "
+                      "it and re-tuning" % (key, entry), stacklevel=3)
+    return None
+
+
+def _trial(bench: Callable, shape, dtype: str, config: dict,
+           reps: int = 2) -> float:
+    """Best-of-``reps`` wall ms for one config (first call warms the
+    compile and is not timed)."""
+    bench(shape, dtype, config)
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = perf_counter()
+        bench(shape, dtype, config)
+        best = min(best, perf_counter() - t0)
+    return best * 1e3
+
+
+def tune(kernel: str, shape, dtype: str, max_trials: int = 5,
+         reps: int = 2) -> Optional[dict]:
+    """Run the trial sweep for one key regardless of FLAGS_autotune and
+    persist the winner (the CLI's pre-populate path). Returns the winning
+    config, or None when the family is unknown or has no candidates."""
+    fam = _FAMILIES.get(kernel)
+    if fam is None:
+        return None
+    cands = list(fam["candidates"](tuple(shape), dtype))[:max_trials]
+    if not cands:
+        return None
+    key = make_key(kernel, shape, dtype)
+    trials: dict = {}
+    t_begin = perf_counter()
+    if len(cands) == 1:
+        winner = dict(cands[0])
+    else:
+        winner, best_ms, t_spent = None, float("inf"), 0.0
+        for config in cands:
+            try:
+                ms = _trial(fam["bench"], tuple(shape), dtype, config,
+                            reps=reps)
+            except Exception as e:  # noqa: BLE001 — an illegal candidate
+                trials[_cfg_tag(config)] = "error: %s" % type(e).__name__
+                continue
+            t_spent += ms * (reps + 1)
+            trials[_cfg_tag(config)] = round(ms, 4)
+            if ms < best_ms:
+                winner, best_ms = dict(config), ms
+        _mstats.AUTOTUNE_TRIALS_MS.add(int(t_spent))
+        if winner is None:
+            return None
+    with _lock:
+        entries = _load()
+        entries[key] = {"config": winner, "trials": trials}
+        _save()
+    from ..monitor import trace as _trace
+
+    if _trace.is_tracing():
+        # one span per trial sweep: the timeline shows WHERE compile time
+        # went when FLAGS_autotune pays its one-time cost
+        _trace.get_writer().add_complete(
+            "autotune.tune", t_begin, perf_counter() - t_begin,
+            cat="autotune",
+            args={"key": key, "winner": _cfg_tag(winner),
+                  "trials": trials})
+    return winner
+
+
+def get_config(kernel: str, shape, dtype: str, default: dict) -> dict:
+    """The kernel-side entry: hand back the cached winner for this
+    concrete key, trial-and-cache on a miss, or the hand-picked
+    ``default`` untouched while FLAGS_autotune is off. Called at trace
+    time (block sizes are static args), so the hot path is one dict
+    lookup."""
+    if not _enabled[0]:
+        return default
+    key = make_key(kernel, shape, dtype)
+    with _lock:
+        entries = _load()
+        cached = entries.get(key)
+    if cached is not None:
+        config = _entry_config(key, cached)
+        if config is not None:
+            _mstats.AUTOTUNE_HITS.add()
+            return config
+    _mstats.AUTOTUNE_MISSES.add()
+    winner = tune(kernel, shape, dtype)
+    return winner if winner is not None else default
+
+
+def _cfg_tag(config: dict) -> str:
+    return "_".join("%s%s" % (k, v) for k, v in sorted(config.items()))
+
+
+# -- fallback accounting (ISSUE 17 satellite) -------------------------------
+# The kernel entries' untileable-shape escape hatches used to drop to
+# composed jnp with NO signal — a model quietly losing its kernels looked
+# identical to one using them. Every such branch now calls note_fallback.
+
+_fallback_warned: set = set()
+
+
+def note_fallback(kernel: str, shape, detail: str) -> None:
+    """Count (``fused_kernel_fallbacks`` gauge) and warn ONCE per
+    (kernel, shape) when a Pallas entry falls back to composed jnp,
+    naming the kernel and the offending dimension. Called at trace time
+    — once per compile, not per step."""
+    _mstats.FUSED_KERNEL_FALLBACKS.add()
+    from ..monitor import trace as _trace
+
+    if _trace.is_tracing():
+        _trace.get_writer().add_complete(
+            "kernel.fallback", perf_counter(), 0.0, cat="autotune",
+            args={"kernel": kernel,
+                  "shape": "x".join(str(int(x)) for x in shape),
+                  "detail": detail})
+    key = (kernel, tuple(int(x) for x in shape))
+    if key in _fallback_warned:
+        return
+    _fallback_warned.add(key)
+    warnings.warn(
+        "paddle_tpu.ops: %s falls back to composed jnp for shape %s — %s"
+        % (kernel, tuple(int(x) for x in shape), detail), stacklevel=3)
+
+
+def stale_entries() -> List[tuple]:
+    """(key, reason) for every committed cache entry that no longer
+    matches a legal config — unknown family, unparseable key, corrupt
+    payload, or a config outside the family's current candidate set.
+    ``python -m tools.autotune --check`` exits non-zero on any (the
+    stale-fingerprint contract graftlint's baseline follows)."""
+    out = []
+    with _lock:
+        entries = dict(_load())
+    for key, entry in sorted(entries.items()):
+        try:
+            kernel, shape, dtype, _backend_name = parse_key(key)
+        except (ValueError, TypeError):
+            out.append((key, "unparseable key"))
+            continue
+        if not (isinstance(entry, dict)
+                and isinstance(entry.get("config"), dict)):
+            out.append((key, "corrupt entry payload"))
+            continue
+        fam = _FAMILIES.get(kernel)
+        if fam is None:
+            out.append((key, "unknown kernel family %r" % kernel))
+            continue
+        try:
+            cands = [dict(c) for c in fam["candidates"](shape, dtype)]
+        except Exception as e:  # noqa: BLE001 — shape no longer legal
+            out.append((key, "shape rejected by family (%s)"
+                        % type(e).__name__))
+            continue
+        if dict(entry["config"]) not in cands:
+            out.append((key, "config %r no longer legal (candidates: %s)"
+                        % (entry["config"],
+                           [_cfg_tag(c) for c in cands] or "none")))
+    return out

@@ -29,6 +29,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from . import autotune as _autotune
+
 NEG_INF = -1e30
 
 
@@ -150,9 +152,22 @@ def _flash_forward(q, k, v, causal=False, scale=None, block_q=512,
     qr = q.reshape(bh, sq, d)
     kr = k.reshape(bh, sk, d)
     vr = v.reshape(bh, sk, d)
+    off = sk - sq
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, n_kv=n_kv,
-                               off=sk - sq)
+                               block_q=bq, block_k=bk, n_kv=n_kv, off=off)
+    if causal:
+        # FlashAttention-2-style DMA clamp: kv blocks strictly above the
+        # q block's diagonal are pl.when-skipped in the kernel, but the
+        # plain (i, kk, 0) map still DMAs them. Clamping dead kk to the
+        # last LIVE kv block makes consecutive dead steps re-reference
+        # the same block, so the pipeline elides their copies — the
+        # compute (and output) is bit-identical, only dead traffic goes.
+        def _kv_idx(i, j, kk):
+            return (i, jnp.minimum(
+                kk, jnp.clip((j * bq + bq - 1 + off) // bk, 0, n_kv - 1)), 0)
+    else:
+        def _kv_idx(i, j, kk):
+            return (i, kk, 0)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -160,8 +175,8 @@ def _flash_forward(q, k, v, causal=False, scale=None, block_q=512,
         grid=(bh // bb, n_q, n_kv),
         in_specs=[
             pl.BlockSpec((bb, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((bb, bk, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((bb, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((bb, bk, d), _kv_idx),
+            pl.BlockSpec((bb, bk, d), _kv_idx),
         ],
         out_specs=(pl.BlockSpec((bb, bq, d), lambda i, j, kk: (i, j, 0)),
                    pl.BlockSpec((bb, bq, 1), lambda i, j, kk: (i, j, 0))),
@@ -257,20 +272,34 @@ def _flash_backward(q, k, v, o, lse, g, causal=False, scale=None,
                     axis=-1).reshape(bh, sq, 1)
     dqp_dtype = q.dtype if n_kv == 1 else jnp.float32
 
+    off = sk - sq
+    if causal:
+        # mirror of the forward DMA clamp: with q innermost, the dead
+        # iterations are q blocks strictly BELOW this kv block's
+        # diagonal (j < first live block ceil((kk*bk - off - bq + 1)/bq)
+        # = (kk*bk - off) // bq); pin them to that first live block so
+        # their q/do/lse/delta copies elide. Dead steps only write the
+        # zero dqp block, so the outputs are bit-identical.
+        def _q_idx(i, kk, j):
+            return (i, jnp.maximum(
+                j, jnp.clip((kk * bk - off) // bq, 0, n_q - 1)), 0)
+    else:
+        def _q_idx(i, kk, j):
+            return (i, j, 0)
     dk, dv, dqp = pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_q=n_q, off=sk - sq),
+                          block_q=bq, block_k=bk, n_q=n_q, off=off),
         out_shape=(jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
                    jax.ShapeDtypeStruct((n_kv, bh, sq, d), dqp_dtype)),
         grid=(bh // bb, n_kv, n_q),
         in_specs=[
-            pl.BlockSpec((bb, bq, d), lambda i, kk, j: (i, j, 0)),
+            pl.BlockSpec((bb, bq, d), _q_idx),
             pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
             pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
-            pl.BlockSpec((bb, bq, d), lambda i, kk, j: (i, j, 0)),
-            pl.BlockSpec((bb, bq, 1), lambda i, kk, j: (i, j, 0)),
-            pl.BlockSpec((bb, bq, 1), lambda i, kk, j: (i, j, 0)),
+            pl.BlockSpec((bb, bq, d), _q_idx),
+            pl.BlockSpec((bb, bq, 1), _q_idx),
+            pl.BlockSpec((bb, bq, 1), _q_idx),
         ],
         out_specs=(pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
                    pl.BlockSpec((bb, bk, d), lambda i, kk, j: (i, kk, 0)),
@@ -336,6 +365,7 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=None,
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    auto = block_q is None and block_k is None and block_b is None
     if block_q is None:
         block_q = _auto_block(q.shape[2])
     if block_k is None:
@@ -347,17 +377,36 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None, block_q=None,
     sq, sk = q.shape[2], k.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
     if not (sq % bq == 0 and sk % bk == 0 and sq >= 128 and sk >= 128):
+        _autotune.note_fallback(
+            "flash", q.shape,
+            "seq_q=%d/seq_k=%d not tileable by block %dx%d (needs seq >= "
+            "128 and block-divisible)" % (sq, sk, bq, bk))
         return _attention_reference(q, k, v, causal, scale)
     if d % 128 != 0 and d != 64:
         dp = -(-d // 128) * 128
         pad = ((0, 0), (0, 0), (0, 0), (0, dp - d))
         out = flash_attention_arrays(
             jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad), causal=causal,
-            scale=scale, block_q=block_q, block_k=block_k, block_b=block_b,
+            scale=scale,
+            block_q=None if auto else block_q,
+            block_k=None if auto else block_k,
+            block_b=None if auto else block_b,
             interpret=interpret)
         return out[..., :d]
-    return _flash(q, k, v, bool(causal), float(scale), int(block_q),
-                  int(block_k), block_b and int(block_b), bool(interpret))
+    if auto and _autotune.enabled():
+        bh = q.shape[0] * q.shape[1]
+        cfg = _autotune.get_config(
+            "flash.causal" if causal else "flash", (bh, sq, sk, d),
+            str(jnp.dtype(q.dtype)),
+            {"block_q": bq, "block_k": bk,
+             "block_b": _pick_block_b(bh, bq, bk)})
+        tq, tk = int(cfg.get("block_q", bq)), int(cfg.get("block_k", bk))
+        if sq % tq == 0 and sk % tk == 0:   # never trust a cache into
+            bq, bk = tq, tk                  # an untileable config
+            tb = cfg.get("block_b")
+            block_b = int(tb) if tb and bh % int(tb) == 0 else None
+    return _flash(q, k, v, bool(causal), float(scale), int(bq),
+                  int(bk), block_b and int(block_b), bool(interpret))
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -380,3 +429,46 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 def _flash_entry(q, k, v, causal):
     return flash_attention_arrays(q, k, v, causal=causal)
+
+
+# -- autotune family (ISSUE 17) --------------------------------------------
+# Candidates walk the power-of-two block ladder the hand policy picks
+# from, so the hand-picked default is always in the trial set and the
+# S=2048 whole-sequence degenerate block has to EARN its slot.
+
+def _flash_candidates(shape, dtype):
+    bh, sq, sk, d = shape
+    out, seen = [], set()
+    for cap in (2048, 1024, 512, 256, 128):
+        bq = min(_auto_block(sq, cap), sq)
+        bk = min(_auto_block(sk, cap), sk)
+        if sq % bq or sk % bk or (bq, bk) in seen:
+            continue
+        seen.add((bq, bk))
+        out.append({"block_q": bq, "block_k": bk,
+                    "block_b": _pick_block_b(bh, bq, bk)})
+    return out[:5]
+
+
+def _flash_bench(causal):
+    def bench(shape, dtype, config):
+        import numpy as np
+
+        bh, sq, sk, d = shape
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(dtype)
+        q = jnp.asarray(rng.standard_normal((1, bh, sq, d)), dt)
+        k = jnp.asarray(rng.standard_normal((1, bh, sk, d)), dt)
+        v = jnp.asarray(rng.standard_normal((1, bh, sk, d)), dt)
+        out, _ = _flash_forward(
+            q, k, v, causal=causal, scale=1.0 / math.sqrt(d),
+            block_q=int(config["block_q"]), block_k=int(config["block_k"]),
+            block_b=int(config.get("block_b") or 0) or None,
+            interpret=not _on_tpu())
+        jax.block_until_ready(out)
+    return bench
+
+
+_autotune.register_family("flash", _flash_candidates, _flash_bench(False))
+_autotune.register_family("flash.causal", _flash_candidates,
+                          _flash_bench(True))

@@ -30,6 +30,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from . import autotune as _autotune
 from .flash_attention import _compiler_params, _on_tpu
 
 __all__ = ["fused_ln_mlp", "fused_add_layernorm"]
@@ -470,10 +471,22 @@ def fused_ln_mlp(x, w1, b1, w2, b2, *, ln_scale=None, ln_bias=None,
     R = 1
     for d in lead:
         R *= int(d)
-    tiles = _tileable(R, H, w1.shape[1], x.dtype)
+    M = w1.shape[1]
+    tiles = _tileable(R, H, M, x.dtype)
     if tiles is None:
+        _autotune.note_fallback(
+            "fused_ln_mlp", (R, H, M),
+            "rows=%d / mlp=%d not tileable or hidden=%d %% 128 != 0"
+            % (R, M, H))
         return ref()
     br, bj = tiles
+    if _autotune.enabled():
+        cfg = _autotune.get_config(
+            "fused_ln_mlp", (R, H, M), str(jnp.dtype(x.dtype)),
+            {"br": br, "bj": bj})
+        tr, tj = int(cfg.get("br", br)), int(cfg.get("bj", bj))
+        if R % tr == 0 and M % tj == 0:
+            br, bj = tr, tj
     y = _fmlp(x.reshape(R, H), lns, lnb, w1, b1.reshape(1, -1), w2,
               b2.reshape(1, -1), wg, bg.reshape(1, -1), act,
               bool(residual), has_ln, float(eps), br, bj, bool(interpret))
@@ -614,9 +627,78 @@ def fused_add_layernorm(x, y, scale, bias, eps=1e-5, interpret=None):
         R *= int(d)
     br = _pick(R, (256, 128, 64, 32, 16, 8))
     if br is None or H % 128 != 0:
+        _autotune.note_fallback(
+            "fused_add_ln", (R, H),
+            "rows=%d has no legal row block or hidden=%d %% 128 != 0"
+            % (R, H))
         return ref()
+    if _autotune.enabled():
+        cfg = _autotune.get_config("fused_add_ln", (R, H),
+                                   str(jnp.dtype(x.dtype)), {"br": br})
+        tr = int(cfg.get("br", br))
+        if R % tr == 0:
+            br = tr
     out = _addln(x.reshape(R, H), y.reshape(R, H),
                  jnp.asarray(scale, jnp.float32).reshape(1, H),
                  jnp.asarray(bias, jnp.float32).reshape(1, H),
                  float(eps), br, bool(interpret))
     return out.reshape(*lead, H)
+
+
+# -- autotune families (ISSUE 17) ------------------------------------------
+
+def _fmlp_candidates(shape, dtype):
+    R, H, M = shape
+    if _tileable(R, H, M, jnp.dtype(dtype)) is None:
+        return []
+    row_cands = ((256, 128, 64, 32, 16)
+                 if jnp.dtype(dtype).itemsize < 4
+                 else (256, 128, 64, 32, 16, 8))
+    brs = [c for c in row_cands if R % c == 0][:2]
+    bjs = [c for c in (512, 256, 128) if M % c == 0][:2]
+    return [{"br": br, "bj": bj} for br in brs for bj in bjs][:5]
+
+
+def _fmlp_bench(shape, dtype, config):
+    import numpy as np
+
+    R, H, M = shape
+    rng = np.random.default_rng(0)
+    dt = jnp.dtype(dtype)
+    x = jnp.asarray(rng.standard_normal((R, H)), dt)
+    ones = jnp.ones((1, H), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((H, M)) * 0.05, dt)
+    w2 = jnp.asarray(rng.standard_normal((M, H)) * 0.05, dt)
+    zb1 = jnp.zeros((1, M), dt)
+    zb2 = jnp.zeros((1, H), dt)
+    y, _, _ = _fmlp_forward(
+        x, ones, jnp.zeros((1, H), jnp.float32), w1, zb1, w2, zb2,
+        jnp.zeros_like(w1), zb1, "gelu", True, True, 1e-5,
+        int(config["br"]), int(config["bj"]), not _on_tpu())
+    jax.block_until_ready(y)
+
+
+def _addln_candidates(shape, dtype):
+    R, H = shape
+    if H % 128 != 0:
+        return []
+    return [{"br": c} for c in (256, 128, 64, 32, 16, 8)
+            if R % c == 0][:4]
+
+
+def _addln_bench(shape, dtype, config):
+    import numpy as np
+
+    R, H = shape
+    rng = np.random.default_rng(0)
+    dt = jnp.dtype(dtype)
+    x = jnp.asarray(rng.standard_normal((R, H)), dt)
+    y = jnp.asarray(rng.standard_normal((R, H)), dt)
+    out, _, _ = _addln_forward(
+        x, y, jnp.ones((1, H), jnp.float32), jnp.zeros((1, H), jnp.float32),
+        1e-5, int(config["br"]), not _on_tpu())
+    jax.block_until_ready(out)
+
+
+_autotune.register_family("fused_ln_mlp", _fmlp_candidates, _fmlp_bench)
+_autotune.register_family("fused_add_ln", _addln_candidates, _addln_bench)

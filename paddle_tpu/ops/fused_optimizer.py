@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from ..monitor import benchmark as _bench
 from ..monitor.stats import FUSED_OPTIMIZER_STEPS
 from ..monitor.trace import span as _trace_span
+from . import autotune as _autotune
 from .flash_attention import _compiler_params, _on_tpu
 
 __all__ = ["adamw_flat", "lamb_moments_flat", "fused_adamw_update",
@@ -173,6 +174,12 @@ def adamw_flat(p, g, m, v, lr, bc1, bc2, *, b1=0.9, b2=0.999, eps=1e-8,
     v2, _ = _pad_2d(v)
     rows = p2.shape[0]
     bb = _block_rows(rows)
+    if _autotune.enabled():
+        cfg = _autotune.get_config("fused_adamw", (rows,),
+                                   str(jnp.dtype(p.dtype)), {"bb": bb})
+        tb = int(cfg.get("bb", 0) or 0)
+        if tb and rows % tb == 0:
+            bb = tb
     sc = jnp.stack([jnp.asarray(lr, jnp.float32),
                     jnp.asarray(bc1, jnp.float32),
                     jnp.asarray(bc2, jnp.float32)])
@@ -598,3 +605,54 @@ def fused_eager_step(opt, params_grads, lr) -> bool:
     FUSED_OPTIMIZER_STEPS.add()
     opt._slots_stale = True
     return True
+
+
+# -- autotune family (ISSUE 17) ---------------------------------------------
+
+def _adamw_candidates(shape, dtype):
+    rows = int(shape[0])
+    cands = [{"bb": c} for c in (512, 256, 128, 64, 32, 16)
+             if rows % c == 0]
+    return (cands or [{"bb": rows}])[:4]
+
+
+def _adamw_bench(shape, dtype, config):
+    import numpy as np
+
+    rows = int(shape[0])
+    n = rows * _LANE
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.standard_normal(n).astype(dtype))
+    g = jnp.asarray(rng.standard_normal(n).astype(dtype) * 0.01)
+    m = jnp.zeros((n,), dtype)
+    v = jnp.zeros((n,), dtype)
+    # bench through the padded 2-D kernel body directly at this block
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p2, _ = _pad_2d(p)
+    g2, _ = _pad_2d(g)
+    m2, _ = _pad_2d(m)
+    v2, _ = _pad_2d(v)
+    bb = int(config["bb"])
+    sc = jnp.stack([jnp.float32(1e-3), jnp.float32(0.9),
+                    jnp.float32(0.999)])
+    blk = lambda: pl.BlockSpec((bb, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_adamw_kernel, b1=0.9, b2=0.999, eps=1e-8,
+                          wd=0.0, l2=0.0, eager_form=False),
+        out_shape=(jax.ShapeDtypeStruct(p2.shape, p.dtype),
+                   jax.ShapeDtypeStruct(m2.shape, m.dtype),
+                   jax.ShapeDtypeStruct(v2.shape, v.dtype)),
+        grid=(rows // bb,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk(), blk()],
+        out_specs=(blk(), blk(), blk()),
+        compiler_params=_compiler_params(
+            pltpu, vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=not _on_tpu(),
+    )(sc, p2, g2, m2, v2)
+    jax.block_until_ready(out)
+
+
+_autotune.register_family("fused_adamw", _adamw_candidates, _adamw_bench)

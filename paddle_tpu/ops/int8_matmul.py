@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..monitor.stats import INT8_MATMUL_CALLS
+from . import autotune as _autotune
 from .flash_attention import _compiler_params, _on_tpu
 
 __all__ = ["int8_matmul_arrays", "dynamic_int8_matmul"]
@@ -68,9 +69,10 @@ def _pick(n, cands):
     return None
 
 
-@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret",
+                                             "bm", "bn", "bk"))
 def _int8_matmul_2d(xq, wq, wscale, xscale, bias, out_dtype,
-                    interpret=False):
+                    interpret=False, bm=None, bn=None, bk=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -80,9 +82,9 @@ def _int8_matmul_2d(xq, wq, wscale, xscale, bias, out_dtype,
     Mp = -(-M // 32) * 32
     if Mp != M:
         xq = jnp.pad(xq, ((0, Mp - M), (0, 0)))
-    bm = _pick(Mp, (256, 128, 64, 32))
-    bn = _pick(N, (512, 256, 128))
-    bk = _pick(K, (512, 256, 128))
+    bm = bm or _pick(Mp, (256, 128, 64, 32))
+    bn = bn or _pick(N, (512, 256, 128))
+    bk = bk or _pick(K, (512, 256, 128))
     ws2 = wscale.reshape(1, N).astype(jnp.float32)
     b2 = (bias.reshape(1, N).astype(jnp.float32) if bias is not None
           else jnp.zeros((1, N), jnp.float32))
@@ -125,16 +127,32 @@ def int8_matmul_arrays(xq, wq, wscale, xscale, bias=None,
     M = 1
     for d in lead:
         M *= int(d)
-    if (xscale.size != 1
-            or _pick(N, (512, 256, 128)) is None
+    if xscale.size != 1:
+        # per-row activation scales: a design choice, not a fallback
+        return _int8_matmul_ref(xq, wq, wscale, xscale, bias, out_dtype)
+    if (_pick(N, (512, 256, 128)) is None
             or _pick(K, (512, 256, 128)) is None):
-        # per-row activation scales or untileable shapes: XLA path
+        _autotune.note_fallback(
+            "int8_matmul", (M, K, N),
+            "K=%d or N=%d has no 128-divisible block" % (K, N))
         return _int8_matmul_ref(xq, wq, wscale, xscale, bias, out_dtype)
     if not isinstance(xq, jax.core.Tracer):
         INT8_MATMUL_CALLS.add()
+    blocks = {}
+    if _autotune.enabled():
+        Mp = -(-M // 32) * 32
+        cfg = _autotune.get_config(
+            "int8_matmul", (M, K, N), "int8",
+            {"bm": _pick(Mp, (256, 128, 64, 32)),
+             "bn": _pick(N, (512, 256, 128)),
+             "bk": _pick(K, (512, 256, 128))})
+        tm, tn, tk = (int(cfg.get(k, 0) or 0) for k in ("bm", "bn", "bk"))
+        if (tm and Mp % tm == 0 and tn and N % tn == 0
+                and tk and K % tk == 0):
+            blocks = {"bm": tm, "bn": tn, "bk": tk}
     out = _int8_matmul_2d(xq.reshape(M, K), wq, wscale, xscale, bias,
                           out_dtype=jnp.dtype(out_dtype).name,
-                          interpret=interpret)
+                          interpret=interpret, **blocks)
     return out.reshape(*lead, N)
 
 
@@ -149,3 +167,37 @@ def dynamic_int8_matmul(x, wq, wscale, bias=None, interpret=None):
                   -127, 127).astype(jnp.int8)
     return int8_matmul_arrays(xq, wq, wscale, xscale, bias=bias,
                               out_dtype=x.dtype, interpret=interpret)
+
+
+# -- autotune family (ISSUE 17) ---------------------------------------------
+
+def _int8_candidates(shape, dtype):
+    M, K, N = shape
+    Mp = -(-int(M) // 32) * 32
+    bms = [c for c in (256, 128, 64, 32) if Mp % c == 0][:2]
+    bns = [c for c in (512, 256, 128) if int(N) % c == 0][:2]
+    bk = _pick(int(K), (512, 256, 128))
+    if not bms or not bns or bk is None:
+        return []
+    out = []
+    for bm in bms:
+        for bn in bns:
+            out.append({"bm": bm, "bn": bn, "bk": bk})
+    return out[:5]
+
+
+def _int8_bench(shape, dtype, config):
+    import numpy as np
+
+    M, K, N = (int(d) for d in shape)
+    rng = np.random.default_rng(0)
+    xq = jnp.asarray(rng.integers(-127, 128, (M, K), dtype=np.int8))
+    wq = jnp.asarray(rng.integers(-127, 128, (K, N), dtype=np.int8))
+    ws = jnp.full((N,), 0.01, jnp.float32)
+    xs = jnp.asarray(0.01, jnp.float32)
+    out = _int8_matmul_2d(xq, wq, ws, xs, None, out_dtype="float32",
+                          interpret=not _on_tpu(), **config)
+    jax.block_until_ready(out)
+
+
+_autotune.register_family("int8_matmul", _int8_candidates, _int8_bench)

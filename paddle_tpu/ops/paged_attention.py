@@ -23,6 +23,16 @@ Kernel design (mirrors the flash forward):
 - blocks past a slot's length are skipped with ``pl.when`` (their table
   entries point at reserved garbage block 0, so the dead DMA is safe);
 - scores/softmax statistics in f32, accumulator f32, output cast back.
+
+Ragged decode (ISSUE 17, ``FLAGS_ragged_decode``): the compute guard
+skips dead blocks, but the K/V DMAs still sweep the PADDED table width —
+a slot with 1 live block in a W=64 table pays 64 block fetches. With the
+flag on, the K/V index map clamps dead iterations to the slot's LAST
+live block (``tbl[b, min(i, max((len-1)//bs, 0))]``); consecutive grid
+steps that name the same block elide the DMA on TPU, so HBM traffic
+tracks live tokens instead of table width. Output is bit-identical: the
+clamp only changes which block dead (compute-guarded) iterations would
+have fetched, never what is computed.
 """
 from __future__ import annotations
 
@@ -32,9 +42,17 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..core import native as _native
+from . import autotune as _autotune
 from .flash_attention import NEG_INF, _compiler_params, _on_tpu
 
 __all__ = ["paged_attention_arrays"]
+
+# Module-local mirror of FLAGS_ragged_decode (no core.native subscript in
+# jit-reachable code); set_flags syncs it through the watcher list.
+_ragged = [bool(_native.ragged_decode[0])]
+_native.ragged_decode_watchers.append(
+    lambda v: _ragged.__setitem__(0, bool(v)))
 
 
 def _paged_attention_reference(q, kb, vb, tables, lengths, scale):
@@ -99,23 +117,36 @@ def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
-def _paged_decode(q, kb, vb, tables, lengths, scale, interpret=False):
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "ragged"))
+def _paged_decode(q, kb, vb, tables, lengths, scale, interpret=False,
+                  ragged=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, nh, hd = q.shape
     bs = kb.shape[2]
     W = tables.shape[1]
+    if ragged:
+        # Clamp dead sweep iterations to the slot's last LIVE block: the
+        # index map then repeats that block index for every i past the
+        # live range, and repeated consecutive indices elide the DMA —
+        # decode HBM traffic tracks live tokens, not padded table width.
+        # Compute stays guarded by pl.when(i*bs < len), so which block a
+        # dead iteration names never affects the output.
+        def _kv_idx(b, i, tbl, ln):
+            last = jnp.maximum((ln[b] - 1) // bs, 0)
+            return (tbl[b, jnp.minimum(i, last)], 0, 0, 0)
+    else:
+        def _kv_idx(b, i, tbl, ln):
+            return (tbl[b, i], 0, 0, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, W),
         in_specs=[
             pl.BlockSpec((1, nh, hd), lambda b, i, tbl, ln: (b, 0, 0)),
-            pl.BlockSpec((1, nh, bs, hd),
-                         lambda b, i, tbl, ln: (tbl[b, i], 0, 0, 0)),
-            pl.BlockSpec((1, nh, bs, hd),
-                         lambda b, i, tbl, ln: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, nh, bs, hd), _kv_idx),
+            pl.BlockSpec((1, nh, bs, hd), _kv_idx),
         ],
         out_specs=pl.BlockSpec((1, nh, hd), lambda b, i, tbl, ln: (b, 0, 0)),
         scratch_shapes=[
@@ -137,13 +168,17 @@ def _paged_decode(q, kb, vb, tables, lengths, scale, interpret=False):
 
 
 def paged_attention_arrays(q, kb, vb, tables, lengths, scale=None,
-                           interpret=None):
+                           interpret=None, ragged=None):
     """Single-token paged attention over a block pool (routed entry).
 
     q (B, nh, hd) — one query per slot; kb/vb (n_blocks, nh, bs, hd) —
     one LAYER's slice of the pool; tables (B, W) int32 block tables
     (entries past a slot's live blocks must point at a safe block, the
     engine reserves pool block 0); lengths (B,) int32 live tokens.
+
+    ``ragged=None`` follows ``FLAGS_ragged_decode``; True/False forces
+    the live-length-clamped (resp. full-width) K/V sweep. Either way the
+    result is bit-identical — ragged only changes DMA traffic.
 
     Same contract as flash_attention_arrays: off-TPU (unless
     ``interpret=True`` is forced) and on untileable shapes this returns
@@ -153,6 +188,8 @@ def paged_attention_arrays(q, kb, vb, tables, lengths, scale=None,
     bs = kb.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
+    if ragged is None:
+        ragged = _ragged[0]
     if interpret is None:
         interpret = False
         if not _on_tpu():
@@ -160,7 +197,45 @@ def paged_attention_arrays(q, kb, vb, tables, lengths, scale=None,
                                               scale)
     if not interpret and ((hd % 128 != 0 and hd != 64) or bs % 8 != 0
                           or nh % 8 != 0):
+        _autotune.note_fallback(
+            "paged_attention", (B, nh, hd),
+            "head_dim=%d (needs 64 or a multiple of 128) or "
+            "block_size=%d / n_heads=%d not a multiple of 8"
+            % (hd, bs, nh))
         return _paged_attention_reference(q, kb, vb, tables, lengths, scale)
     return _paged_decode(q, kb, vb, jnp.asarray(tables, jnp.int32),
                          jnp.asarray(lengths, jnp.int32), float(scale),
-                         interpret=bool(interpret))
+                         interpret=bool(interpret), ragged=bool(ragged))
+
+
+# -- autotune family (ISSUE 17) ---------------------------------------------
+# Single-candidate: the decode kernel has no free block knob (block_size
+# is fixed by the pool layout). Registered so ``python -m tools.autotune``
+# can pre-warm the key and --check covers committed entries.
+
+def _paged_candidates(shape, dtype):
+    return [{}]
+
+
+def _paged_bench(shape, dtype, config):
+    import numpy as np
+
+    B, nh, hd, bs, W = (int(d) for d in shape)
+    rng = np.random.default_rng(0)
+    n_blocks = B * W + 1
+    q = jnp.asarray(rng.standard_normal((B, nh, hd)).astype(dtype))
+    kb = jnp.asarray(
+        rng.standard_normal((n_blocks, nh, bs, hd)).astype(dtype))
+    vb = jnp.asarray(
+        rng.standard_normal((n_blocks, nh, bs, hd)).astype(dtype))
+    tables = jnp.asarray(
+        1 + np.arange(B * W, dtype=np.int32).reshape(B, W))
+    lengths = jnp.full((B,), W * bs, jnp.int32)
+    out = _paged_decode(q, kb, vb, tables, lengths,
+                        1.0 / math.sqrt(hd), interpret=not _on_tpu(),
+                        ragged=bool(_ragged[0]))
+    jax.block_until_ready(out)
+
+
+_autotune.register_family("paged_attention", _paged_candidates,
+                          _paged_bench)

@@ -276,6 +276,52 @@ def _pmean_in_bwd(axes):
     return ident
 
 
+def _spec_shard_dim(spec, axis="sharding"):
+    """Index of the dim ``axis`` shards in a PartitionSpec, else None."""
+    if not isinstance(spec, P):
+        return None
+    for d, e in enumerate(tuple(spec)):
+        if e == axis or (isinstance(e, (tuple, list)) and axis in e):
+            return d
+    return None
+
+
+def _rs_in_bwd(data_axes, shard_axis, dim, deg):
+    """Identity whose BACKWARD reduce-scatters the cotangent over
+    ``shard_axis`` (and pmeans over ``data_axes``) — the ZeRO-2 form of
+    :func:`_pmean_in_bwd` (FLAGS_overlap_zero2): each device keeps only
+    ITS 1/deg shard of the bucket's grad, issued in-backward so the
+    scatter overlaps remaining backward compute, and the full-size
+    reduced gradient never materializes. The cotangent must match the
+    primal (full) shape inside shard_map, so the shard lands in a zero
+    buffer at this device's offset; the caller slices it back out before
+    the shard_map boundary."""
+
+    @jax.custom_vjp
+    def ident(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        shard = jax.lax.psum_scatter(g, shard_axis, scatter_dimension=dim,
+                                     tiled=True)
+        if data_axes:
+            shard = jax.lax.pmean(shard, data_axes)
+        # psum_scatter SUMS over the shard group; match pmean semantics
+        shard = shard / deg
+        size = shard.shape[dim]
+        idx = jax.lax.axis_index(shard_axis)
+        buf = jnp.zeros_like(g)
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, shard, idx * size,
+                                                  dim)
+        return (buf,)
+
+    ident.defvjp(fwd, bwd)
+    return ident
+
+
 class DistributedTrainStep:
     """jit(value_and_grad(loss) + clip + optimizer) with Fleet shardings.
 
@@ -465,6 +511,20 @@ class DistributedTrainStep:
                     a for a in ("data", "sharding") if shape.get(a, 1) > 0)
                 n_buckets = len(jax.tree_util.tree_leaves(params))
                 _mstats.GRAD_OVERLAP_BUCKETS.add(n_buckets)
+        # FLAGS_overlap_zero2 (ISSUE 17): at ZeRO-2+ the in-backward
+        # pmean becomes an in-backward reduce-scatter over "sharding" —
+        # each bucket's grad leaves the backward already 1/Nth-sharded
+        # (the layout ZeRO-2 pins grads to) and the scatter overlaps the
+        # remaining backward compute. Off, the overlap path keeps the
+        # full pmean exactly as before.
+        self._overlap_zero2 = bool(
+            _native.overlap_zero2[0] and self._overlap_axes is not None
+            and zero_level >= 2 and shard_deg > 1)
+        self._shard_deg = shard_deg
+        # zspec leaves aligned with the params-tree leaf order (zspecs is
+        # built by tree_map over param_specs, so orders agree)
+        self._zspec_leaves = jax.tree_util.tree_leaves(
+            zspecs, is_leaf=lambda x: isinstance(x, P))
 
         def step(params, opt_state, aux, batch, lr, scaler_state,
                  sent_state):
@@ -474,24 +534,56 @@ class DistributedTrainStep:
             if self._overlap_axes is not None:
                 axes = self._overlap_axes
                 ident = _pmean_in_bwd(axes)
+                rs2 = self._overlap_zero2
+                deg = self._shard_deg
+                data_axes = tuple(a for a in axes if a != "sharding")
+                zleaves = self._zspec_leaves
+
+                def leaf_ident(spec):
+                    d = _spec_shard_dim(spec)
+                    if rs2 and d is not None:
+                        return _rs_in_bwd(data_axes, "sharding", d, deg)
+                    return ident
 
                 def local_step(p, b, sc):
                     def run_local(pp):
-                        # per-bucket in-backward pmean: each leaf's grad
-                        # all-reduce launches as soon as the backward
-                        # produces it
-                        pp = jax.tree_util.tree_map(ident, pp)
+                        # per-bucket in-backward collective: each leaf's
+                        # grad pmean (or, under FLAGS_overlap_zero2, its
+                        # reduce-scatter) launches as soon as the
+                        # backward produces it
+                        flat, td = jax.tree_util.tree_flatten(pp)
+                        flat = [leaf_ident(s)(x)
+                                for x, s in zip(flat, zleaves)]
+                        pp = jax.tree_util.tree_unflatten(td, flat)
                         loss = self._loss_fn(pp, b)
                         return loss * sc.astype(loss.dtype), loss
 
                     (_, loss), g = jax.value_and_grad(
                         run_local, has_aux=True)(p)
+                    if rs2:
+                        # keep only this device's shard of each sharded
+                        # bucket (the rest of the zero buffer is dead);
+                        # the zspec out_specs reassemble the global grad
+                        # in the ZeRO-2 sharded layout
+                        idx = jax.lax.axis_index("sharding")
+                        flat, td = jax.tree_util.tree_flatten(g)
+                        out = []
+                        for x, s in zip(flat, zleaves):
+                            d = _spec_shard_dim(s)
+                            if d is None:
+                                out.append(x)
+                            else:
+                                size = x.shape[d] // deg
+                                out.append(jax.lax.dynamic_slice_in_dim(
+                                    x, idx * size, size, d))
+                        g = jax.tree_util.tree_unflatten(td, out)
                     return jax.lax.pmean(loss, axes), g
 
+                g_specs = self._zspecs if rs2 else P()
                 loss, grads = _shard_map_call(
                     local_step, self.mesh,
                     in_specs=(P(), self._batch_spec, P()),
-                    out_specs=(P(), P()))(params, batch, scale)
+                    out_specs=(P(), g_specs))(params, batch, scale)
                 new_aux = aux
             else:
                 def run_loss(p):
@@ -726,9 +818,32 @@ class DistributedTrainStep:
                 sum(jnp.sum(jnp.abs(t.astype(jnp.float32)))
                     for t in jax.tree_util.tree_leaves(g)), axes)
 
+        rs2 = getattr(self, "_overlap_zero2", False)
+        deg = getattr(self, "_shard_deg", 1)
+        data_axes = tuple(a for a in axes if a != "sharding")
+        zleaves = getattr(self, "_zspec_leaves", None)
+
         def comm_only(g):
-            return jax.tree_util.tree_map(
-                lambda t: jax.lax.pmean(t, axes), g)
+            if not rs2:
+                return jax.tree_util.tree_map(
+                    lambda t: jax.lax.pmean(t, axes), g)
+            # the EXACT collectives the ZeRO-2 overlap backward issues:
+            # reduce-scatter for sharded buckets, pmean for the rest;
+            # reduced to a replicated scalar so shapes stay uniform
+            flat, _ = jax.tree_util.tree_flatten(g)
+            acc = jnp.float32(0.0)
+            for x, s in zip(flat, zleaves):
+                d = _spec_shard_dim(s)
+                if d is None:
+                    r = jax.lax.pmean(x, axes)
+                else:
+                    r = jax.lax.psum_scatter(
+                        x, "sharding", scatter_dimension=d, tiled=True)
+                    if data_axes:
+                        r = jax.lax.pmean(r, data_axes)
+                    r = r / deg
+                acc += jnp.sum(jnp.abs(r.astype(jnp.float32)))
+            return jax.lax.pmean(acc, axes)
 
         param_sh = self._param_sh
         full_j = jax.jit(full, in_shardings=(param_sh, self._batch_sh),

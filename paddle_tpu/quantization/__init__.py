@@ -35,7 +35,8 @@ from ..nn.layer.layers import Layer
 
 __all__ = [
     "fake_quant", "quant_absmax_scale", "quantize_weight",
-    "quantized_linear", "QuantizedLinear", "ImperativeQuantAware",
+    "quantized_linear", "quantize_weight_fp8", "fp8_quantized_linear",
+    "QuantizedLinear", "ImperativeQuantAware",
     "PostTrainingQuantization",
 ]
 
@@ -118,6 +119,40 @@ def quantized_linear(x, wq, wscale, xscale, bias=None):
                         *args)
     return apply_op(lambda a, w, ws, xs: _int8_linear(a, w, ws, xs, None),
                     *args)
+
+
+# -- real fp8 (e4m3 weight storage, ISSUE 17) -------------------------------
+
+def quantize_weight_fp8(w):
+    """fp weight → (e4m3 weight, f32 per-tensor scale). The fp8 analog
+    of :func:`quantize_weight`; dequant is ``wq.astype(f) * scale``."""
+    from ..amp.fp8 import E4M3_MAX, quantize_fp8
+
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    scale = jnp.maximum(jnp.max(jnp.abs(arr.astype(jnp.float32))),
+                        1e-12) / E4M3_MAX
+    return quantize_fp8(arr, scale), scale.astype(jnp.float32)
+
+
+def _fp8_linear(x, wq, wscale, bias):
+    # dynamic per-tensor activation scaling, then the fused-dequant fp8
+    # kernel (ops/fp8_matmul.py) — same routing contract as _int8_linear.
+    from ..amp.fp8 import E4M3_MAX, quantize_fp8
+    from ..ops.fp8_matmul import fp8_matmul_arrays
+
+    xscale = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32))),
+                         1e-12) / E4M3_MAX
+    xq = quantize_fp8(x, xscale)
+    return fp8_matmul_arrays(xq, wq, xscale, wscale, bias=bias,
+                             out_dtype=x.dtype)
+
+
+def fp8_quantized_linear(x, wq, wscale, bias=None):
+    """y = dequant(e4m3(x) @ e4m3 W) — fp8 storage, bf16-exact dot."""
+    args = (x, wq, wscale) + ((bias,) if bias is not None else ())
+    if bias is not None:
+        return apply_op(lambda a, w, ws, b: _fp8_linear(a, w, ws, b), *args)
+    return apply_op(lambda a, w, ws: _fp8_linear(a, w, ws, None), *args)
 
 
 # -- QAT layer wrappers -----------------------------------------------------

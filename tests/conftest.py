@@ -46,6 +46,12 @@ def pytest_configure(config):
         "recsys: recommender-stack tests (paddle_tpu.sparse sharded "
         "embeddings, DLRM, serving rank path) — select with `pytest -m "
         "recsys` after touching sparse/ or models/dlrm.py")
+    config.addinivalue_line(
+        "markers",
+        "tuning: shape-keyed autotuner tests (trial sweeps, cache "
+        "round-trips, `tools/autotune --check` staleness) — select with "
+        "`pytest -m tuning` after touching ops/autotune.py or a kernel "
+        "family registration")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,221 @@
+"""Shape-keyed kernel autotuner (ISSUE 17): key round-trips, trial-sweep
+determinism, cache persistence across a simulated restart, corrupt-entry
+self-repair, the hits-gauge pin on the second compile, the flag-off
+bit-identical contract, fallback accounting, and the ``tools/autotune``
+CLI (--tune/--check). Everything runs on CPU: the flash consults happen
+under ``interpret=True`` (the Pallas path), tiny shapes keep the trial
+sweeps to seconds."""
+import json
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.monitor import stats as _st
+from paddle_tpu.ops import autotune as at
+from paddle_tpu.ops.flash_attention import flash_attention_arrays
+
+pytestmark = [pytest.mark.tuning, pytest.mark.kernels]
+
+RNG = np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _tmp_cache(tmp_path):
+    """Every test gets its own cache file; the repo cache is untouched."""
+    old = at.cache_path()
+    at.set_cache_path(str(tmp_path / "autotune_cache.json"))
+    paddle.set_flags({"FLAGS_autotune": 0})
+    yield
+    paddle.set_flags({"FLAGS_autotune": 0})
+    at.set_cache_path(old)
+
+
+def _qkv(B=1, H=2, S=128, D=64):
+    q = jnp.asarray(RNG.normal(size=(B, H, S, D)) * 0.1, jnp.float32)
+    k = jnp.asarray(RNG.normal(size=(B, H, S, D)) * 0.1, jnp.float32)
+    v = jnp.asarray(RNG.normal(size=(B, H, S, D)) * 0.1, jnp.float32)
+    return q, k, v
+
+
+class TestKeys:
+    def test_key_roundtrip(self):
+        key = at.make_key("flash", (2, 8, 2048, 64), "bfloat16", "tpu")
+        assert key == "flash:2x8x2048x64:bfloat16:tpu"
+        assert at.parse_key(key) == ("flash", (2, 8, 2048, 64),
+                                     "bfloat16", "tpu")
+
+    def test_parse_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            at.parse_key("flash:2x8")
+
+
+class TestTuneAndCache:
+    def test_trial_sweep_is_deterministic_and_legal(self):
+        """The winner must come from the family's own candidate set, and
+        consulting twice must hand back the SAME config (the cache, not a
+        re-sweep, answers the second time)."""
+        shape, dtype = (1, 2, 128, 64), "float32"
+        w1 = at.tune("flash", shape, dtype)
+        assert w1 is not None
+        fam_cands = [dict(c) for c in
+                     at._FAMILIES["flash"]["candidates"](shape, dtype)]
+        assert dict(w1) in fam_cands
+        paddle.set_flags({"FLAGS_autotune": 1})
+        m0 = _st.AUTOTUNE_MISSES.get()
+        w2 = at.get_config("flash", shape, dtype, {"sentinel": 1})
+        assert w2 == w1
+        assert _st.AUTOTUNE_MISSES.get() == m0  # hit, no re-sweep
+
+    def test_restart_roundtrip(self):
+        """reset() drops the in-memory dict; the next consult must reload
+        the persisted winner from disk (hits gauge moves, no re-tune)."""
+        shape, dtype = (1, 2, 128, 64), "float32"
+        winner = at.tune("flash", shape, dtype)
+        at.reset()                               # simulated process restart
+        paddle.set_flags({"FLAGS_autotune": 1})
+        h0, m0 = _st.AUTOTUNE_HITS.get(), _st.AUTOTUNE_MISSES.get()
+        got = at.get_config("flash", shape, dtype, {"sentinel": 1})
+        assert got == winner
+        assert _st.AUTOTUNE_HITS.get() == h0 + 1
+        assert _st.AUTOTUNE_MISSES.get() == m0
+
+    def test_cache_file_shape(self):
+        # (bh, sq, sk, d) = (2, 256, 256, 64): two legal block-ladder
+        # rungs, so the sweep actually times candidates
+        at.tune("flash", (2, 256, 256, 64), "float32")
+        with open(at.cache_path()) as f:
+            raw = json.load(f)
+        assert raw["version"] == 1
+        (key, entry), = raw["entries"].items()
+        assert key.startswith("flash:2x256x256x64:float32:")
+        assert isinstance(entry["config"], dict)
+        assert entry["trials"]                  # per-candidate timings kept
+
+    def test_corrupt_entry_warns_once_and_repairs(self):
+        shape, dtype = (1, 2, 128, 64), "float32"
+        key = at.make_key("flash", shape, dtype)
+        with open(at.cache_path(), "w") as f:
+            json.dump({"version": 1, "entries": {key: "garbage"}}, f)
+        at.reset()
+        paddle.set_flags({"FLAGS_autotune": 1})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = at.get_config("flash", shape, dtype, {"sentinel": 1})
+        assert "corrupt" in "".join(str(x.message) for x in w)
+        assert got != {"sentinel": 1}           # re-tuned, not defaulted
+        with open(at.cache_path()) as f:        # repaired on disk
+            entry = json.load(f)["entries"][key]
+        assert isinstance(entry["config"], dict)
+
+    def test_flag_off_returns_default_untouched(self):
+        d = {"block_q": 512, "block_k": 1024}
+        assert at.get_config("flash", (1, 2, 128, 64), "float32", d) is d
+
+
+class TestEndToEnd:
+    def test_second_compile_hits_cache(self):
+        """The acceptance pin: with FLAGS_autotune on, the SECOND compile
+        of the same (kernel, shape, dtype) key is a cache HIT — the trial
+        sweep ran once and autotune_hits moved by at least 1."""
+        q, k, v = _qkv()
+        paddle.set_flags({"FLAGS_autotune": 1})
+        out1 = flash_attention_arrays(q, k, v, causal=True, interpret=True)
+        h0 = _st.AUTOTUNE_HITS.get()
+        at.reset()                              # drop memory, keep disk
+        out2 = flash_attention_arrays(q, k, v, causal=True, interpret=True)
+        assert _st.AUTOTUNE_HITS.get() >= h0 + 1
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_flag_off_bit_identical(self):
+        """Autotune OFF must leave every kernel's output bit-for-bit what
+        it was before this module existed (hand-picked blocks); ON may
+        change block shapes but not the math."""
+        q, k, v = _qkv()
+        off = flash_attention_arrays(q, k, v, causal=True, interpret=True)
+        paddle.set_flags({"FLAGS_autotune": 1})
+        on = flash_attention_arrays(q, k, v, causal=True, interpret=True)
+        paddle.set_flags({"FLAGS_autotune": 0})
+        off2 = flash_attention_arrays(q, k, v, causal=True, interpret=True)
+        np.testing.assert_array_equal(np.asarray(off), np.asarray(off2))
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_families_registered(self):
+        import importlib
+
+        for mod in ("flash_attention", "fused_kernels", "int8_matmul",
+                    "fused_optimizer", "paged_attention", "fp8_matmul"):
+            importlib.import_module("paddle_tpu.ops.%s" % mod)
+        fams = at.families()
+        for name in ("flash", "flash.causal", "fused_ln_mlp",
+                     "fused_add_ln", "int8_matmul", "fused_adamw",
+                     "paged_attention", "fp8_matmul"):
+            assert name in fams, name
+
+
+class TestFallbackAccounting:
+    def test_note_fallback_gauge_and_single_warning(self):
+        g0 = _st.FUSED_KERNEL_FALLBACKS.get()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            at.note_fallback("demo_kernel", (3, 7), "K=7 not 128-divisible")
+            at.note_fallback("demo_kernel", (3, 7), "K=7 not 128-divisible")
+        assert _st.FUSED_KERNEL_FALLBACKS.get() == g0 + 2
+        msgs = [str(x.message) for x in w
+                if "demo_kernel" in str(x.message)]
+        assert len(msgs) == 1                   # once per (kernel, shape)
+        assert "K=7" in msgs[0] and "(3, 7)" in msgs[0]
+
+    def test_untileable_flash_emits_fallback(self):
+        g0 = _st.FUSED_KERNEL_FALLBACKS.get()
+        q = jnp.asarray(RNG.normal(size=(1, 2, 16, 48)), jnp.float32)
+        flash_attention_arrays(q, q, q, interpret=True)  # head_dim 48
+        assert _st.FUSED_KERNEL_FALLBACKS.get() > g0
+
+    def test_fallback_lands_in_trace_report(self):
+        from paddle_tpu.monitor.trace import start_tracing, stop_tracing
+        from tools.trace_report import kernels_report
+
+        w = start_tracing()
+        try:
+            at._fallback_warned.discard(("trace_demo", (5, 9)))
+            at.note_fallback("trace_demo", (5, 9), "N=9 untileable")
+        finally:
+            stop_tracing()
+        rep = kernels_report(w.events(), file=None)
+        assert rep["fallbacks"]["trace_demo"]["count"] == 1
+        assert "DEGRADED" in rep["verdict"]
+
+
+class TestCLI:
+    def test_tune_and_list(self, capsys):
+        from tools.autotune import main
+
+        rc = main(["--cache", at.cache_path(), "--tune",
+                   "flash:1x2x128x64:float32"])
+        assert rc == 0
+        rc = main(["--cache", at.cache_path()])
+        assert rc == 0
+        assert "flash:1x2x128x64:float32" in capsys.readouterr().out
+
+    def test_check_clean_then_stale(self, capsys):
+        from tools.autotune import main
+
+        at.tune("flash", (1, 2, 128, 64), "float32")
+        assert main(["--cache", at.cache_path(), "--check"]) == 0
+        entries = at.cache_entries()
+        entries["nosuch:1x2:float32:cpu"] = {"config": {"bq": 1},
+                                             "trials": {}}
+        with open(at.cache_path(), "w") as f:
+            json.dump({"version": 1, "entries": entries}, f)
+        at.reset()
+        assert main(["--cache", at.cache_path(), "--check"]) == 1
+        assert "STALE" in capsys.readouterr().out
+
+    def test_bad_tune_spec_fails(self):
+        from tools.autotune import main
+
+        assert main(["--cache", at.cache_path(), "--tune", "nonsense"]) == 1

@@ -115,6 +115,66 @@ class TestKernelParity:
             np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+class TestRaggedDecode:
+    """FLAGS_ragged_decode (ISSUE 17): the live-length-clamped K/V index
+    map only changes WHICH blocks are DMA'd (dead iterations re-address
+    the last live block, whose copy the pipeline elides) — the masked
+    compute is untouched, so the output must be bit-identical."""
+
+    def test_ragged_bit_identical_across_lengths(self):
+        nh, hd, bs, W, nb, B = 8, 64, 16, 4, 20, 4
+        kb, vb = _pool(nb, nh, bs, hd)
+        q = jnp.asarray(RNG.normal(size=(B, nh, hd)), jnp.float32)
+        tables = _tables([[5, 2, 9, 14], [1, 7, 3, 11], [4, 8, 6, 13],
+                          [10, 15, 17, 19]], W)
+        # the boundary lengths: 1 token, one-short-of-a-block, exactly
+        # one block, and the full table
+        lengths = jnp.asarray([1, bs - 1, bs, W * bs], jnp.int32)
+        base = _paged_decode(q, kb, vb, tables, lengths, 0.125,
+                             interpret=True, ragged=False)
+        ragged = _paged_decode(q, kb, vb, tables, lengths, 0.125,
+                               interpret=True, ragged=True)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(ragged))
+        want = _paged_attention_reference(q, kb, vb, tables, lengths,
+                                          0.125)
+        np.testing.assert_allclose(np.asarray(ragged), np.asarray(want),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_zero_length_ragged_is_finite(self):
+        nh, hd, bs = 8, 64, 16
+        kb, vb = _pool(4, nh, bs, hd)
+        q = jnp.asarray(RNG.normal(size=(2, nh, hd)), jnp.float32)
+        tables = _tables([[], [1, 2]], 2)
+        lengths = jnp.asarray([0, 20], jnp.int32)
+        base = _paged_decode(q, kb, vb, tables, lengths, 0.125,
+                             interpret=True, ragged=False)
+        ragged = _paged_decode(q, kb, vb, tables, lengths, 0.125,
+                               interpret=True, ragged=True)
+        assert np.isfinite(np.asarray(ragged)).all()
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(ragged))
+
+    def test_flag_routes_and_stays_identical(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops import paged_attention as pa
+
+        nh, hd, bs = 8, 64, 16
+        kb, vb = _pool(6, nh, bs, hd)
+        q = jnp.asarray(RNG.normal(size=(1, nh, hd)), jnp.float32)
+        tables = _tables([[1, 4]], 3)
+        lengths = jnp.asarray([19], jnp.int32)
+        off = paged_attention_arrays(q, kb, vb, tables, lengths,
+                                     interpret=True)
+        paddle.set_flags({"FLAGS_ragged_decode": 1})
+        try:
+            assert pa._ragged[0]
+            on = paged_attention_arrays(q, kb, vb, tables, lengths,
+                                        interpret=True)
+        finally:
+            paddle.set_flags({"FLAGS_ragged_decode": 0})
+        assert not pa._ragged[0]
+        np.testing.assert_array_equal(np.asarray(off), np.asarray(on))
+
+
 class TestPagedDecodeStep:
     def test_paged_decode_step_matches_contiguous(self):
         """gpt_decode_step_paged over a chunk-prefilled block pool must

@@ -1,0 +1,131 @@
+"""autotune — inspect / pre-populate / check the kernel block-config cache.
+
+    python -m tools.autotune                       # list cached entries
+    python -m tools.autotune --families            # registered families
+    python -m tools.autotune --tune flash:2x256x256x64:float32 [...]
+    python -m tools.autotune --check               # stale-entry gate (CI)
+    python -m tools.autotune --cache PATH          # non-default cache file
+
+The cache (``tools/autotune_cache.json`` by default, override with
+``--cache`` or ``PADDLE_TPU_AUTOTUNE_CACHE``) maps
+``kernel:shape:dtype:backend`` keys to measured block-config winners —
+the same committable-fingerprint shape as graftlint's baseline.
+``--tune`` takes ``kernel:DxDxD:dtype`` specs (backend is appended
+automatically for the host running the sweep) and runs the trial sweep
+now, so a fleet can ship pre-warmed winners instead of paying first-step
+trials. ``--check`` exits non-zero when any committed entry went stale
+(unknown family, unparseable key, corrupt payload, or a config the
+family no longer considers legal) — wire it next to graftlint in CI.
+
+Exit codes: 0 clean, 1 stale entries (--check) or failed --tune spec.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_families():
+    """Import every module that registers an autotune family."""
+    import importlib
+
+    from paddle_tpu.ops import autotune
+
+    for mod in ("flash_attention", "fused_kernels", "int8_matmul",
+                "fused_optimizer", "paged_attention", "fp8_matmul"):
+        importlib.import_module("paddle_tpu.ops.%s" % mod)
+    return autotune
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="autotune", description=__doc__.splitlines()[0])
+    ap.add_argument("--cache", default=None,
+                    help="cache file (default: tools/autotune_cache.json "
+                         "or $PADDLE_TPU_AUTOTUNE_CACHE)")
+    ap.add_argument("--tune", nargs="+", default=None, metavar="SPEC",
+                    help="kernel:DxDxD:dtype specs to trial-sweep now")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any cached entry is stale")
+    ap.add_argument("--families", action="store_true",
+                    help="list registered kernel families and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    at = _load_families()
+    if args.cache:
+        at.set_cache_path(args.cache)
+
+    if args.families:
+        for name in at.families():
+            print(name)
+        return 0
+
+    if args.tune:
+        rc = 0
+        for spec in args.tune:
+            parts = spec.split(":")
+            if len(parts) != 3:
+                print("bad spec %r (want kernel:DxDxD:dtype)" % spec)
+                rc = 1
+                continue
+            kernel, dims, dtype = parts
+            try:
+                shape = tuple(int(d) for d in dims.split("x"))
+            except ValueError:
+                print("bad dims in %r" % spec)
+                rc = 1
+                continue
+            winner = at.tune(kernel, shape, dtype)
+            if winner is None:
+                print("%s: no winner (unknown family or no legal "
+                      "candidates)" % spec)
+                rc = 1
+            else:
+                print("%s -> %s" % (at.make_key(kernel, shape, dtype),
+                                    winner))
+        return rc
+
+    if args.check:
+        stale = at.stale_entries()
+        if args.as_json:
+            print(json.dumps([{"key": k, "reason": r} for k, r in stale],
+                             indent=1))
+        else:
+            for key, reason in stale:
+                print("STALE %s: %s" % (key, reason))
+        if stale:
+            print("%d stale autotune cache entr%s in %s"
+                  % (len(stale), "y" if len(stale) == 1 else "ies",
+                     at.cache_path()))
+            return 1
+        print("autotune cache clean (%d entries)"
+              % len(at.cache_entries()))
+        return 0
+
+    entries = at.cache_entries()
+    if args.as_json:
+        print(json.dumps({"path": at.cache_path(), "entries": entries},
+                         indent=1, sort_keys=True))
+        return 0
+    print("cache: %s (%d entries)" % (at.cache_path(), len(entries)))
+    for key in sorted(entries):
+        entry = entries[key]
+        cfg = entry.get("config") if isinstance(entry, dict) else None
+        trials = entry.get("trials") if isinstance(entry, dict) else None
+        line = "  %s -> %s" % (key, cfg)
+        if trials:
+            line += "   trials: %s" % trials
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

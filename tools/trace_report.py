@@ -186,6 +186,57 @@ def overlap_report(rows: list, file=None) -> dict:
     return out
 
 
+def kernels_report(events: list, file=None) -> dict:
+    """Kernel-library health from the autotune/fallback events (ISSUE 17).
+
+    ``paddle_tpu.ops.autotune`` emits one ``autotune.tune`` span per
+    trial sweep (args: cache key, winner, per-candidate ms) and a
+    zero-duration ``kernel.fallback`` event every time a Pallas entry
+    drops to composed jnp (args: kernel, shape, why). The section answers
+    two questions a quiet run hides: where did FLAGS_autotune's one-time
+    trial cost go, and is the model silently running WITHOUT its fused
+    kernels."""
+    tunes = [e for e in events if e.get("name") == "autotune.tune"]
+    falls = [e for e in events if e.get("name") == "kernel.fallback"]
+    if not tunes and not falls:
+        return {}
+    out: dict = {}
+    if tunes:
+        out["tune_sweeps"] = len(tunes)
+        out["tune_total_ms"] = sum(e.get("dur", 0) for e in tunes) / 1e3
+        out["winners"] = {
+            e.get("args", {}).get("key", "?"):
+                e.get("args", {}).get("winner", "?")
+            for e in tunes}
+    if falls:
+        by_kernel: dict = {}
+        for e in falls:
+            a = e.get("args", {})
+            k = a.get("kernel", "?")
+            ent = by_kernel.setdefault(
+                k, {"count": 0, "detail": a.get("detail", "")})
+            ent["count"] += 1
+        out["fallbacks"] = by_kernel
+        out["verdict"] = (
+            "DEGRADED: %d Pallas entr%s fell back to composed jnp — the "
+            "run is not using the fused kernels at those shapes"
+            % (len(falls), "y" if len(falls) == 1 else "ies"))
+    else:
+        out["verdict"] = "all Pallas entries ran their kernels (no " \
+                         "composed-jnp fallbacks in the trace window)"
+    print("\nKernel library (autotune/fallbacks):", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<22}{v:>12.3f}", file=file)
+        elif isinstance(v, dict):
+            print(f"  {k}:", file=file)
+            for kk, vv in sorted(v.items()):
+                print(f"    {kk}: {vv}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def pipeline_report(events: list, file=None) -> dict:
     """Pipeline-bubble verdict from the ``pipeline.tick`` spans (ISSUE 9).
 
@@ -998,6 +1049,7 @@ SECTIONS = {
     "spans": lambda c, f: report(c["rows"], c["top"], file=f),
     "input_pipeline": lambda c, f: input_pipeline_report(c["rows"], file=f),
     "overlap": lambda c, f: overlap_report(c["rows"], file=f),
+    "kernels": lambda c, f: kernels_report(c["events"], file=f),
     "serving": lambda c, f: serving_report(c["rows"], file=f,
                                            events=c["events"]),
     "spec": lambda c, f: spec_report(c["events"], file=f),
