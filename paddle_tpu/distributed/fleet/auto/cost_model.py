@@ -73,6 +73,19 @@ class ModelStats:
     table_dim: int = 0              # embedding width
     table_dtype_bytes: int = 4
     table_lookups_per_sample: int = 0   # ids resolved per sample per step
+    # mixture-of-experts placement term (ISSUE 18, nn/moe.py): expert
+    # weights follow the table precedent — NOT part of param_bytes, they
+    # ride their own fields and either replicate (ep=1) or shard over
+    # the ep slice of the "model" axis. moe_expert_params counts every
+    # expert FFN scalar across all MoE layers; the router (H·E per
+    # layer — noise at this resolution) is not counted anywhere.
+    # Zero experts = dense model.
+    moe_experts: int = 0            # experts per MoE layer (E)
+    moe_expert_params: int = 0      # expert FFN scalars, all MoE layers
+    moe_expert_dtype_bytes: int = 4
+    moe_layers: int = 0             # number of MoE blocks
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def from_params(cls, params, specs=None, layers: Optional[int] = None,
@@ -82,10 +95,30 @@ class ModelStats:
         Layer-stackable bytes: leaves whose leading dim equals ``layers``
         (explicit, or inferred as the most common leading dim > 1 among
         multi-dim leaves — the gpt_init "blocks" layout). TP bytes: leaves
-        whose spec mentions the "model" axis.
+        whose spec mentions the "model" axis. A gpt-layout ``"moe"``
+        subtree (w_in (Lm, E, H, M), …) is pulled OUT of param_bytes into
+        the moe_expert_* fields (expert weights place like the embedding
+        table: their own rules, their own term).
         """
         import jax
         import numpy as np
+
+        moe_kw: Dict[str, Any] = {}
+        if isinstance(params, dict) and isinstance(params.get("moe"), dict) \
+                and hasattr(params["moe"].get("w_in"), "shape"):
+            moe = params["moe"]
+            params = {k: v for k, v in params.items() if k != "moe"}
+            if specs is not None and isinstance(specs, dict):
+                specs = {k: v for k, v in specs.items() if k != "moe"}
+            expert = [v for k, v in moe.items() if k != "router_w"
+                      and hasattr(v, "shape")]
+            moe_kw = dict(
+                moe_experts=int(moe["w_in"].shape[1]),
+                moe_layers=int(moe["w_in"].shape[0]),
+                moe_expert_params=sum(
+                    int(np.prod(v.shape) or 1) for v in expert),
+                moe_expert_dtype_bytes=int(
+                    np.dtype(moe["w_in"].dtype).itemsize))
 
         leaves = [x for x in jax.tree_util.tree_leaves(params)
                   if hasattr(x, "shape")]
@@ -117,7 +150,7 @@ class ModelStats:
         return cls(param_bytes=total, n_params=n_params,
                    layer_bytes=layer_bytes, tp_bytes=tp_bytes,
                    layers=int(layers), hidden=int(hidden),
-                   seq_len=int(seq_len))
+                   seq_len=int(seq_len), **moe_kw)
 
 
 @dataclasses.dataclass
@@ -128,24 +161,35 @@ class PlanCandidate:
     mp: int
     n_micro: int
     zero: int
+    # expert parallelism (ISSUE 18): experts shard over the "model" axis
+    # alongside TP, so the physical axis degree is max(mp, ep) and ep>1
+    # is legal only when mp is 1 or equal to ep (one axis, one degree)
+    ep: int = 1
     remat: bool = True
     # filled by estimate():
     hbm_bytes: int = 0
     hbm_detail: Dict[str, int] = dataclasses.field(default_factory=dict)
     bubble_frac: float = 0.0
     coll_bytes: int = 0
+    a2a_bytes: int = 0              # MoE dispatch AllToAll share of coll
     score: float = float("inf")
     fits: bool = False
     why: str = ""
 
     @property
+    def model_degree(self) -> int:
+        """Physical size of the "model" mesh axis (TP and EP share it)."""
+        return max(self.mp, self.ep)
+
+    @property
     def dims(self) -> Dict[str, int]:
         return {"data": self.dp, "sharding": self.sharding,
-                "pipe": self.pp, "model": self.mp}
+                "pipe": self.pp, "model": self.model_degree}
 
     def describe(self) -> str:
+        ep = f" ep={self.ep}" if self.ep > 1 else ""
         return (f"dp={self.dp} sh={self.sharding} pp={self.pp} "
-                f"mp={self.mp} micro={self.n_micro} zero={self.zero}")
+                f"mp={self.mp}{ep} micro={self.n_micro} zero={self.zero}")
 
 
 def _divisors(n: int) -> List[int]:
@@ -169,8 +213,12 @@ def enumerate_plans(n_devices: int, global_batch: int,
     - global_batch % (dp * sharding * n_micro) == 0 (integral microbatch);
     - n_micro >= pp (fewer microbatches than stages idles the pipe);
     - mp > 1 only with TP-annotated params (allow_mp) and hidden % mp == 0;
+    - ep > 1 only with experts (stats.moe_experts), ep | moe_experts
+      (whole experts per shard), and mp in {1, ep} — TP and EP ride the
+      SAME "model" axis (degree max(mp, ep)), so mixed degrees would
+      need a fifth axis this mesh does not have;
     - zero > 0 only when the "sharding" axis exists (degree > 1).
-    ``constraints`` pins any of dp/sharding/pp/mp/n_micro/zero.
+    ``constraints`` pins any of dp/sharding/pp/mp/ep/n_micro/zero.
     """
     cons = dict(constraints or {})
     out: List[PlanCandidate] = []
@@ -191,32 +239,50 @@ def enumerate_plans(n_devices: int, global_batch: int,
                 continue
             if mp > 1 and stats.table_rows and stats.table_rows < mp:
                 continue  # fewer rows than shards: empty shards
-            rest = n_devices // (pp * mp)
-            for sh in _divisors(rest):
-                if cons.get("sharding", sh) != sh:
+            ep_choices = [1]
+            if stats.moe_experts > 0:
+                ep_choices = [e for e in _divisors(n_devices // pp)
+                              if e == 1 or (stats.moe_experts % e == 0
+                                            and mp in (1, e))]
+            for ep in ep_choices:
+                if cons.get("ep", ep) != ep:
                     continue
-                dp = rest // sh
-                if cons.get("dp", dp) != dp:
+                md = max(mp, ep)
+                if (n_devices // pp) % md != 0:
                     continue
-                if global_batch % (dp * sh) != 0:
-                    continue
-                per_replica = global_batch // (dp * sh)
-                for n_micro in _divisors(min(per_replica, max_micro)):
-                    if cons.get("n_micro", n_micro) != n_micro:
-                        continue
-                    if pp > 1 and n_micro < pp:
-                        continue
-                    if pp == 1 and n_micro > 1:
-                        continue  # microbatching buys nothing without pipe
-                    for zero in zero_levels:
-                        if cons.get("zero", zero) != zero:
-                            continue
-                        if zero > 0 and sh <= 1:
-                            continue
-                        out.append(PlanCandidate(
-                            dp=dp, sharding=sh, pp=pp, mp=mp,
-                            n_micro=n_micro, zero=zero))
+                _emit(out, cons, n_devices, global_batch, stats,
+                      zero_levels, max_micro, pp, mp, ep)
     return out
+
+
+def _emit(out, cons, n_devices, global_batch, stats, zero_levels,
+          max_micro, pp, mp, ep):
+    """Inner dp/sharding/micro/zero loops for one (pp, mp, ep) shape."""
+    rest = n_devices // (pp * max(mp, ep))
+    for sh in _divisors(rest):
+        if cons.get("sharding", sh) != sh:
+            continue
+        dp = rest // sh
+        if cons.get("dp", dp) != dp:
+            continue
+        if global_batch % (dp * sh) != 0:
+            continue
+        per_replica = global_batch // (dp * sh)
+        for n_micro in _divisors(min(per_replica, max_micro)):
+            if cons.get("n_micro", n_micro) != n_micro:
+                continue
+            if pp > 1 and n_micro < pp:
+                continue
+            if pp == 1 and n_micro > 1:
+                continue  # microbatching buys nothing without pipe
+            for zero in zero_levels:
+                if cons.get("zero", zero) != zero:
+                    continue
+                if zero > 0 and sh <= 1:
+                    continue
+                out.append(PlanCandidate(
+                    dp=dp, sharding=sh, pp=pp, mp=mp, ep=ep,
+                    n_micro=n_micro, zero=zero))
 
 
 def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
@@ -281,10 +347,23 @@ def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
         touched = min(batch_ids, stats.table_rows)
         table += touched * stats.table_dim * stats.grad_dtype_bytes
 
-    hbm = int(params + grads + opt + act + table)
+    # mixture-of-experts placement (ISSUE 18): expert weights + their
+    # grads and AdamW moments shard over ep — THE expert-parallel HBM
+    # credit (ep=1 replicates, so expert-heavy models that cannot fit
+    # replicated experts only fit at ep>1). Experts are deliberately
+    # outside the ZeRO terms: the optimizer shards them over "model",
+    # not "sharding" (zero.py composes with ep at the axis level).
+    moe = 0.0
+    if stats.moe_experts and stats.moe_expert_params:
+        per_dev = stats.moe_expert_params / c.ep
+        moe = per_dev * (stats.moe_expert_dtype_bytes
+                         + stats.grad_dtype_bytes
+                         + stats.opt_state_bytes_per_param)
+
+    hbm = int(params + grads + opt + act + table + moe)
     c.hbm_detail = {"params": int(params), "grads": int(grads),
                     "opt_state": int(opt), "activations": int(act),
-                    "table": int(table)}
+                    "table": int(table), "moe_experts": int(moe)}
     c.hbm_bytes = hbm
     budget = int(hw.hbm_bytes * hw.hbm_fudge)
     c.fits = hbm <= budget
@@ -338,6 +417,21 @@ def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
         coll += 2.0 * batch_ids * \
             (4 + stats.table_dim * stats.table_dtype_bytes) * \
             (c.mp - 1) / c.mp
+    a2a = 0.0
+    if c.ep > 1 and stats.moe_layers:
+        # MoE dispatch AllToAll (GShard): 2 dispatches (tokens out,
+        # expert outputs back) × routed rows × d_model per MoE layer.
+        # Routed rows = cf·k·T — the capacity grid ships PADDED, so the
+        # capacity factor IS the imbalance term: a perfectly balanced
+        # router still pays cf·k copies of every token on the wire.
+        # (fwd only: the bwd AllToAll pair overlaps the expert grads
+        # the same way the dp all-reduce hides — coarse, rank-stable.)
+        tokens = stats.seq_len * max(global_batch // (c.dp * c.sharding), 1)
+        routed = stats.moe_capacity_factor * stats.moe_top_k * tokens
+        a2a = 2.0 * stats.moe_layers * routed * max(stats.hidden, 1) \
+            * stats.act_dtype_bytes * (c.ep - 1) / c.ep
+        coll += a2a
+    c.a2a_bytes = int(a2a)
     c.coll_bytes = int(coll)
 
     # mp splits dense compute only when matmuls are TP-annotated; a
@@ -346,6 +440,16 @@ def estimate(c: PlanCandidate, stats: ModelStats, global_batch: int,
     mp_compute = c.mp if stats.tp_bytes else 1
     flops = 6.0 * stats.n_params * (global_batch * stats.seq_len) \
         / (c.dp * c.sharding * mp_compute * c.pp)
+    if stats.moe_experts and stats.moe_expert_params:
+        # expert FFN compute: every routed (capacity-padded) row runs ONE
+        # expert's FFN — 6 · (expert_params_all_layers / E) FLOPs per row
+        # summed over the MoE layers — and ep splits the capacity grid,
+        # so expert compute scales 1/ep exactly like the HBM term
+        routed = stats.moe_capacity_factor * stats.moe_top_k \
+            * (global_batch * stats.seq_len) \
+            / (c.dp * c.sharding * c.ep * c.pp)
+        flops += 6.0 * stats.moe_expert_params \
+            / max(stats.moe_experts, 1) * routed
     t_compute = flops / hw.peak_flops
     t = t_compute / max(1e-9, 1.0 - c.bubble_frac) + coll / hw.ici_bandwidth
     c.score = t

@@ -55,11 +55,17 @@ class ParallelPlan:
     chosen: PlanCandidate
     candidates: List[PlanCandidate]     # ranked, fitting first
     global_batch: int
+    ep: int = 1                         # expert parallelism (ISSUE 18)
+
+    @property
+    def model_degree(self) -> int:
+        """Physical "model" axis size — TP and EP share the axis."""
+        return max(self.mp, self.ep)
 
     @property
     def mesh_dims(self) -> Dict[str, int]:
         return {"data": self.dp, "sharding": self.sharding,
-                "pipe": self.pp, "model": self.mp}
+                "pipe": self.pp, "model": self.model_degree}
 
     def create_mesh(self):
         """Build + install the 4-axis Fleet mesh for this plan and
@@ -68,7 +74,7 @@ class ParallelPlan:
         from ....parallel.mesh import create_mesh
 
         mesh = create_mesh(dp=self.dp, sharding=self.sharding, pp=self.pp,
-                           mp=self.mp)
+                           mp=self.model_degree)
         try:
             from ... import env as _env
             from ..base.fleet_base import fleet as _fleet
@@ -77,7 +83,7 @@ class ParallelPlan:
 
             topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
                                        (self.dp, self.pp, self.sharding,
-                                        self.mp))
+                                        self.model_degree))
             hcg = HybridCommunicateGroup(topo, _env.get_rank())
             _fleet._mesh = mesh
             _fleet._topology = topo
@@ -91,23 +97,31 @@ class ParallelPlan:
     # -- reporting -----------------------------------------------------------
     def table(self, top: int = 10) -> str:
         """Ranked candidate table (the ``explain`` payload)."""
+        moe = any(c.ep > 1 for c in self.candidates)
         hdr = (f"{'rank':<5}{'dp':>4}{'sh':>4}{'pp':>4}{'mp':>4}"
-               f"{'micro':>6}{'zero':>5}{'hbm/dev':>10}{'bubble':>8}"
-               f"{'coll':>10}{'score':>11}  fit")
+               + (f"{'ep':>4}" if moe else "")
+               + f"{'micro':>6}{'zero':>5}{'hbm/dev':>10}{'bubble':>8}"
+               + f"{'coll':>10}"
+               + (f"{'a2a':>10}" if moe else "")
+               + f"{'score':>11}  fit")
         lines = [hdr, "-" * len(hdr)]
         for i, c in enumerate(self.candidates[:top]):
             mark = " <== chosen" if c is self.chosen else ""
             lines.append(
                 f"{i:<5}{c.dp:>4}{c.sharding:>4}{c.pp:>4}{c.mp:>4}"
-                f"{c.n_micro:>6}{c.zero:>5}"
-                f"{_fmt_bytes(c.hbm_bytes):>10}{c.bubble_frac:>8.3f}"
-                f"{_fmt_bytes(c.coll_bytes):>10}{c.score * 1e3:>9.4f}ms"
-                f"  {'yes' if c.fits else 'NO (' + c.why + ')'}{mark}")
+                + (f"{c.ep:>4}" if moe else "")
+                + f"{c.n_micro:>6}{c.zero:>5}"
+                + f"{_fmt_bytes(c.hbm_bytes):>10}{c.bubble_frac:>8.3f}"
+                + f"{_fmt_bytes(c.coll_bytes):>10}"
+                + (f"{_fmt_bytes(c.a2a_bytes):>10}" if moe else "")
+                + f"{c.score * 1e3:>9.4f}ms"
+                + f"  {'yes' if c.fits else 'NO (' + c.why + ')'}{mark}")
         return "\n".join(lines)
 
     def explain(self, top: int = 10, file=None) -> str:
         budget = int(self.hardware.hbm_bytes * self.hardware.hbm_fudge)
-        head = (f"fleet.auto plan over {self.dp * self.sharding * self.pp * self.mp} "
+        head = (f"fleet.auto plan over "
+                f"{self.dp * self.sharding * self.pp * self.model_degree} "
                 f"device(s), global_batch={self.global_batch}, "
                 f"params={_fmt_bytes(self.stats.param_bytes)}, "
                 f"HBM budget={_fmt_bytes(budget)}/device\n"
@@ -126,6 +140,9 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
          seq_len: int = 1, hidden: int = 0,
          table_rows: int = 0, table_dim: int = 0,
          table_lookups_per_sample: int = 0,
+         moe_experts: int = 0, moe_expert_params: int = 0,
+         moe_layers: int = 0, moe_top_k: int = 2,
+         moe_capacity_factor: float = 1.25,
          allow_mp: Optional[bool] = None,
          zero_levels=(0, 1, 2, 3), max_micro: int = 64,
          constraints: Optional[Dict[str, int]] = None,
@@ -156,6 +173,14 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
         stats = dataclasses.replace(
             stats, table_rows=int(table_rows), table_dim=int(table_dim),
             table_lookups_per_sample=int(table_lookups_per_sample))
+    if moe_experts:
+        # expert placement term (ISSUE 18, nn/moe.py): same pattern —
+        # expert weights ride their own fields, legalising the ep search
+        stats = dataclasses.replace(
+            stats, moe_experts=int(moe_experts),
+            moe_expert_params=int(moe_expert_params),
+            moe_layers=int(moe_layers), moe_top_k=int(moe_top_k),
+            moe_capacity_factor=float(moe_capacity_factor))
     if n_devices is None:
         n_devices = len(jax.devices())
     hw = hardware or HardwareSpec()
@@ -186,7 +211,7 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
     def key(c):
         rank = (int(c.score / eps) if eps > 0 else 0) if c.fits \
             else c.hbm_bytes
-        return (not c.fits, rank, c.pp, c.mp, c.sharding, -c.dp)
+        return (not c.fits, rank, c.pp, c.mp, c.ep, c.sharding, -c.dp)
 
     cands.sort(key=key)
     chosen = cands[0]
@@ -200,6 +225,7 @@ def plan(params=None, *, stats: Optional[ModelStats] = None,
 
     p = ParallelPlan(
         dp=chosen.dp, sharding=chosen.sharding, pp=chosen.pp, mp=chosen.mp,
+        ep=chosen.ep,
         n_micro=chosen.n_micro, zero=chosen.zero, remat=chosen.remat,
         schedule=schedule if chosen.pp > 1 else "none",
         stats=stats, hardware=hw, chosen=chosen, candidates=cands,

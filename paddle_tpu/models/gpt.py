@@ -82,6 +82,26 @@ class GPTConfig:
     # FLAGS_fp8_matmul at trace time. NOT numerics-neutral (that is the
     # point); takes the unfused MLP path when both fp8 and fused are on.
     fp8: Optional[bool] = None
+    # mixture of experts (ISSUE 18): moe_experts=E routes every
+    # moe_every-th block's MLP through an E-expert top-k MoE (nn/moe.py)
+    # — ~moe_every·E/(moe_every-1+E)x the MLP parameters at near-dense
+    # step FLOPs. The default moe_experts=0 keeps the dense model
+    # BIT-IDENTICAL: params, forward, loss and every serving path take
+    # the exact pre-MoE code (pinned by tests/test_moe.py).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    # training dispatch capacity (C = ceil(cf·k·T/E), overflow dropped
+    # with residual passthrough); inference paths are always DROPLESS
+    # so decode quality never depends on batch composition
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2     # load-balance loss weight in gpt_loss
+    moe_z_weight: float = 1e-3       # router z-loss weight in gpt_loss
+    # mesh axis carrying expert parallelism (fleet.auto plans ep onto
+    # "model"). Set → the one-hot einsum dispatch with the expert dim
+    # constraint-pinned there (GSPMD lowers it to an AllToAll pair);
+    # None → the fused Pallas permute kernel (ops/moe_dispatch.py).
+    moe_axis: Optional[str] = None
 
     @property
     def head_dim(self):
@@ -90,6 +110,15 @@ class GPTConfig:
     @property
     def mlp_hidden(self):
         return self.hidden * self.mlp_ratio
+
+    @property
+    def moe_layer_ids(self):
+        """Indices of MoE blocks: every moe_every-th layer (1-based), so
+        moe_every=2 → layers 1, 3, 5, ...; moe_every=1 → all layers."""
+        if self.moe_experts <= 0:
+            return ()
+        n = max(1, int(self.moe_every))
+        return tuple(i for i in range(self.n_layers) if i % n == n - 1)
 
 
 def gpt_tiny(**kw):
@@ -133,6 +162,11 @@ def gpt_truncate(cfg: GPTConfig, params, n_layers: int):
     if not 1 <= n_layers <= cfg.n_layers:
         raise ValueError(
             f"n_layers={n_layers} outside [1, {cfg.n_layers}]")
+    if cfg.moe_layer_ids:
+        raise ValueError(
+            "gpt_truncate does not support MoE configs: the dense-MLP "
+            "and expert subtrees stack over different layer subsets, so "
+            "a [:n_layers] slice has no single meaning")
     draft = dict(params)
     draft["blocks"] = {name: leaf[:n_layers]
                       for name, leaf in params["blocks"].items()}
@@ -152,7 +186,13 @@ def bert_base_config(**kw):
 # --------------------------------------------------------------------------
 
 def gpt_init(cfg: GPTConfig, seed: int = 0) -> Dict[str, Any]:
-    """Init a param pytree; block leaves carry a leading layer dim."""
+    """Init a param pytree; block leaves carry a leading layer dim.
+
+    With ``moe_experts=E``: the dense MLP leaves shrink to the non-MoE
+    layer count and a ``params["moe"]`` subtree (leading MoE-layer dim)
+    holds the router + expert weights — attention/LN leaves keep the
+    full layer stack either way. ``moe_experts=0`` draws the exact
+    pre-MoE tree bit-for-bit (the dense key schedule is untouched)."""
     key = jax.random.key(seed)
     H, L, M, V, S = cfg.hidden, cfg.n_layers, cfg.mlp_hidden, cfg.vocab_size, cfg.seq_len
     pd = cfg.param_dtype
@@ -162,6 +202,9 @@ def gpt_init(cfg: GPTConfig, seed: int = 0) -> Dict[str, Any]:
     def nrm(k, shape, scale=std):
         return (scale * jax.random.normal(k, shape)).astype(pd)
 
+    moe_ids = cfg.moe_layer_ids
+    Ld = L - len(moe_ids)                 # dense-MLP layer count (== L
+    #                                       when MoE is off: bit-identical)
     blocks = {
         "ln1_s": jnp.ones((L, H), pd),
         "ln1_b": jnp.zeros((L, H), pd),
@@ -171,26 +214,42 @@ def gpt_init(cfg: GPTConfig, seed: int = 0) -> Dict[str, Any]:
         "proj_b": jnp.zeros((L, H), pd),
         "ln2_s": jnp.ones((L, H), pd),
         "ln2_b": jnp.zeros((L, H), pd),
-        "fc_w": nrm(ks[2], (L, H, M)),
-        "fc_b": jnp.zeros((L, M), pd),
-        "out_w": nrm(ks[3], (L, M, H), std / math.sqrt(2 * L)),
-        "out_b": jnp.zeros((L, H), pd),
+        "fc_w": nrm(ks[2], (Ld, H, M)),
+        "fc_b": jnp.zeros((Ld, M), pd),
+        "out_w": nrm(ks[3], (Ld, M, H), std / math.sqrt(2 * L)),
+        "out_b": jnp.zeros((Ld, H), pd),
     }
-    return {
+    out = {
         "wte": nrm(ks[4], (V, H)),
         "wpe": nrm(ks[5], (S, H), 0.01),
         "blocks": blocks,
         "lnf_s": jnp.ones((H,), pd),
         "lnf_b": jnp.zeros((H,), pd),
     }
+    if moe_ids:
+        # moe keys derive from ks[6] (dense path never consumes it, so
+        # the dense leaves above match the moe_experts=0 tree exactly)
+        Lm, E = len(moe_ids), cfg.moe_experts
+        mks = jax.random.split(ks[6], 3)
+        out["moe"] = {
+            "router_w": nrm(mks[0], (Lm, H, E)),
+            "w_in": nrm(mks[1], (Lm, E, H, M)),
+            "b_in": jnp.zeros((Lm, E, M), pd),
+            "w_out": nrm(mks[2], (Lm, E, M, H), std / math.sqrt(2 * L)),
+            "b_out": jnp.zeros((Lm, E, H), pd),
+        }
+    return out
 
 
 def gpt_param_specs(cfg: GPTConfig) -> Dict[str, Any]:
     """PartitionSpec table: Megatron-style TP over "model", stages over
-    "pipe". Mirrors what reference mp_layers + PipelineLayer produce."""
+    "pipe". Mirrors what reference mp_layers + PipelineLayer produce.
+    MoE expert leaves shard their EXPERT dim over "model" (expert
+    parallelism — each shard holds E/ep whole experts, the layout the
+    fleet.auto ``ep`` plans and the serving mesh decode assume)."""
     pipe = ("pipe",) if cfg.n_stages > 1 else ()
     b = lambda *rest: P(*(pipe + (None,) + rest))  # (stage?, layer, ...)
-    return {
+    out = {
         "wte": P("model", None),            # vocab-parallel embedding
         "wpe": P(),
         "blocks": {
@@ -207,6 +266,21 @@ def gpt_param_specs(cfg: GPTConfig) -> Dict[str, Any]:
         },
         "lnf_s": P(), "lnf_b": P(),
     }
+    if cfg.moe_layer_ids:
+        if len(cfg.moe_layer_ids) == cfg.n_layers:
+            # every MLP routed: the dense leaves are zero-length stubs
+            # (leading dim 0) and XLA pins zero-sized outputs replicated
+            # — the TP spec would trip the out-sharding check
+            for k in ("fc_w", "fc_b", "out_w", "out_b"):
+                out["blocks"][k] = P()
+        out["moe"] = {
+            "router_w": P(),                       # tiny, replicated
+            "w_in": P(None, "model", None, None),  # expert-parallel
+            "b_in": P(None, "model", None),
+            "w_out": P(None, "model", None, None),
+            "b_out": P(None, "model", None),
+        }
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -244,10 +318,9 @@ def _attention(cfg: GPTConfig, q, k, v):
     return _attention_reference(q, k, v, causal=True, scale=scale)
 
 
-def _block_kv(cfg: GPTConfig, p, x):
-    """One transformer block; p leaves have no layer dim. Also returns the
-    per-head K/V ((B, nh, S, hd) each) so the prefill path can seed a KV
-    cache; gpt_forward discards them (XLA DCEs the dead outputs)."""
+def _attn_half(cfg: GPTConfig, p, x):
+    """Attention half of a block (LN1 → QKV → attention → proj +
+    residual); p leaves have no layer dim. Returns (x, (kh, vh))."""
     B, S, H = x.shape
     nh, hd = cfg.n_heads, cfg.head_dim
     cd = cfg.dtype
@@ -259,8 +332,12 @@ def _block_kv(cfg: GPTConfig, p, x):
     kh, vh = to_heads(k), to_heads(v)
     o = _attention(cfg, to_heads(q), kh, vh)
     o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
-    x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
+    return x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd), (kh, vh)
 
+
+def _mlp_half(cfg: GPTConfig, p, x):
+    """Dense MLP half of a block (LN2 → gelu MLP + residual)."""
+    cd = cfg.dtype
     fused = (cfg.fused_mlp if cfg.fused_mlp is not None
              else _native.fused_kernels[0])
     fp8 = cfg.fp8 if cfg.fp8 is not None else _fp8[0]
@@ -282,7 +359,15 @@ def _block_kv(cfg: GPTConfig, p, x):
         h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
         h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
         x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
-    return x, (kh, vh)
+    return x
+
+
+def _block_kv(cfg: GPTConfig, p, x):
+    """One transformer block; p leaves have no layer dim. Also returns the
+    per-head K/V ((B, nh, S, hd) each) so the prefill path can seed a KV
+    cache; gpt_forward discards them (XLA DCEs the dead outputs)."""
+    x, (kh, vh) = _attn_half(cfg, p, x)
+    return _mlp_half(cfg, p, x), (kh, vh)
 
 
 def _block(cfg: GPTConfig, p, x):
@@ -309,6 +394,75 @@ def _block_stack(cfg: GPTConfig, blocks, x):
         else max(1, min(int(cfg.scan_unroll), n_layers))
     x, _ = jax.lax.scan(step, x, blocks, unroll=unroll)
     return x
+
+
+# -- mixture-of-experts blocks (ISSUE 18) -----------------------------------
+# MoE layers break the homogeneous lax.scan stack (their MLP params live
+# in a separate subtree with a different leading dim), so the MoE forward
+# is a Python loop over per-layer leaves: one compiled body per layer.
+
+_ATTN_KEYS = ("ln1_s", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+              "ln2_s", "ln2_b")
+_MLP_KEYS = ("fc_w", "fc_b", "out_w", "out_b")
+_MOE_KEYS = ("router_w", "w_in", "b_in", "w_out", "b_out")
+
+
+def _layer_params(tree, i, keys):
+    return {k: tree[k][i] for k in keys}
+
+
+def _moe_mlp_half(cfg: GPTConfig, p, pm, x, capacity_factor):
+    """MoE MLP half (LN2 → routed expert FFN + residual). x (B, S, H);
+    returns (x, aux, z, counts (E,), dropped). Dropped assignments
+    contribute nothing to y, so the residual passes those tokens
+    through unchanged."""
+    from ..nn.moe import moe_ffn
+
+    B, S, H = x.shape
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    y, aux, z, counts, dropped = moe_ffn(
+        pm, h.reshape(B * S, H), top_k=cfg.moe_top_k,
+        capacity_factor=capacity_factor, expert_axis=cfg.moe_axis)
+    return x + y.reshape(B, S, H), aux, z, counts, dropped
+
+
+def _block_moe(cfg: GPTConfig, p, pm, x, capacity_factor):
+    """One MoE transformer block (attention half + routed MLP half)."""
+    x, _ = _attn_half(cfg, p, x)
+    return _moe_mlp_half(cfg, p, pm, x, capacity_factor)
+
+
+def _hidden_moe(cfg: GPTConfig, params, x, capacity_factor):
+    """Block stack with MoE layers interleaved (Python loop — see module
+    note above). Returns (x, aux_sum, z_sum, counts, dropped); aux/z
+    are SUMS over the MoE layers, callers average by len(moe_layer_ids).
+    ``capacity_factor=None`` routes droplessly (the inference mode)."""
+    moe_ids = set(cfg.moe_layer_ids)
+    blocks = params["blocks"]
+    aux = jnp.float32(0.0)
+    zl = jnp.float32(0.0)
+    counts = jnp.zeros((cfg.moe_experts,), jnp.int32)
+    dropped = jnp.int32(0)
+    dense = _block
+    moe = _block_moe
+    if cfg.remat:
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        dense = jax.checkpoint(dense, static_argnums=(0,), policy=policy)
+        moe = jax.checkpoint(moe, static_argnums=(0, 4), policy=policy)
+    di = mi = 0
+    for i in range(cfg.n_layers):
+        pa = _layer_params(blocks, i, _ATTN_KEYS)
+        if i in moe_ids:
+            pm = _layer_params(params["moe"], mi, _MOE_KEYS)
+            mi += 1
+            x, a, z, c, d = moe(cfg, pa, pm, x, capacity_factor)
+            aux, zl = aux + a, zl + z
+            counts, dropped = counts + c, dropped + d
+        else:
+            pd = _layer_params(blocks, di, _MLP_KEYS)
+            di += 1
+            x = dense(cfg, {**pa, **pd}, x)
+    return x, aux, zl, counts, dropped
 
 
 def _embed(cfg: GPTConfig, params, tokens):
@@ -338,9 +492,14 @@ def gpt_forward(cfg: GPTConfig, params, tokens):
 
     With cfg.n_stages > 1 the caller is expected to reshape the batch into
     microbatches and use parallel.pipeline_forward (see gpt_loss).
+    MoE blocks route DROPLESSLY here (inference semantics — identical
+    routing to every serving path regardless of batch composition).
     """
     x = _embed(cfg, params, tokens)
-    x = _block_stack(cfg, params["blocks"], x)
+    if cfg.moe_layer_ids:
+        x = _hidden_moe(cfg, params, x, None)[0]
+    else:
+        x = _block_stack(cfg, params["blocks"], x)
     return _head(cfg, params, x)
 
 
@@ -397,9 +556,20 @@ def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1,
     ``loss_chunk``: sequence-chunked CE — peak-memory saver for huge vocab
     or long seq (full (B,S,V) fp32 logits never materialize); measured
     ~10% slower than the fused full-logits path at BERT-base scale, so off
-    by default."""
+    by default.
+
+    MoE configs add the router regularizers to the CE:
+    ``moe_aux_weight · mean-layer aux + moe_z_weight · mean-layer z``,
+    with capacity-factor dispatch (drops + residual passthrough)."""
     tokens, labels = batch
+    moe_ids = cfg.moe_layer_ids
+    aux = zl = None
     if cfg.n_stages > 1:
+        if moe_ids:
+            raise ValueError(
+                "MoE (moe_experts>0) and pipeline stages (n_stages>1) "
+                "are not combinable yet — the MoE subtree has no stage "
+                "stacking")
         if n_micro < cfg.n_stages:
             raise ValueError(
                 f"n_micro={n_micro} must be >= n_stages={cfg.n_stages} "
@@ -407,7 +577,11 @@ def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1,
         x = _pipeline_hidden(cfg, params, tokens, n_micro)
     else:
         x = _embed(cfg, params, tokens)
-        x = _block_stack(cfg, params["blocks"], x)
+        if moe_ids:
+            x, aux, zl, _, _ = _hidden_moe(cfg, params, x,
+                                           cfg.moe_capacity_factor)
+        else:
+            x = _block_stack(cfg, params["blocks"], x)
     x = _layer_norm(x, params["lnf_s"], params["lnf_b"])
     if loss_chunk and tokens.shape[1] > loss_chunk:
         if tokens.shape[1] % loss_chunk != 0:
@@ -415,10 +589,16 @@ def gpt_loss(cfg: GPTConfig, params, batch, n_micro: int = 1,
                 f"loss_chunk={loss_chunk} must divide seq_len="
                 f"{tokens.shape[1]} (the memory saver would otherwise be "
                 "silently disabled)")
-        return _chunked_ce(params, x, labels, loss_chunk)
-    logp = jax.nn.log_softmax(_logits(params, x), axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+        ce = _chunked_ce(params, x, labels, loss_chunk)
+    else:
+        logp = jax.nn.log_softmax(_logits(params, x), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        ce = -jnp.mean(ll)
+    if aux is not None:
+        n = len(moe_ids)
+        ce = ce + cfg.moe_aux_weight * (aux / n) \
+            + cfg.moe_z_weight * (zl / n)
+    return ce
 
 
 # --------------------------------------------------------------------------
@@ -477,13 +657,10 @@ def _dec_mm(x, w, cd):
     return x @ w.astype(cd)
 
 
-def _block_decode(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
-    """One-token block step against one layer's cache slice.
-
-    x (B, 1, H); kc_l/vc_l (B, nh, max_len, hd) — this layer's cache for
-    every slot; positions (B,) int32 — where each slot's incoming token
-    lands. Block weights may be int8-quantized dicts (see
-    quantize_gpt_weights). Returns (x, updated kc_l, updated vc_l)."""
+def _dec_attn(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
+    """Attention half of the one-token block step (cache write + attend
+    + proj residual). x (B, 1, H); kc_l/vc_l (B, nh, max_len, hd);
+    positions (B,) int32. Returns (x, updated kc_l, updated vc_l)."""
     B = x.shape[0]
     nh, hd = cfg.n_heads, cfg.head_dim
     cd = cfg.dtype
@@ -510,10 +687,41 @@ def _block_decode(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
     o = jnp.einsum("bhk,bhkd->bhd", w, vc_l).reshape(B, 1, nh * hd)
 
     x = x + _dec_mm(o, p["proj_w"], cd) + p["proj_b"].astype(cd)
+    return x, kc_l, vc_l
+
+
+def _dec_mlp(cfg: GPTConfig, p, x):
+    """Dense MLP half of the one-token block step (LN2 → gelu MLP +
+    residual; weights may be int8-quantized dicts)."""
+    cd = cfg.dtype
     h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
     h = jax.nn.gelu(_dec_mm(h, p["fc_w"], cd) + p["fc_b"].astype(cd))
-    x = x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
-    return x, kc_l, vc_l
+    return x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
+
+
+def _dec_moe_mlp(cfg: GPTConfig, pa, pm, x):
+    """MoE MLP half of the one-token block step — DROPLESS, so decode
+    quality never depends on which requests share the tick. x (B, 1, H);
+    returns (x, counts (E,) i32, dropped i32)."""
+    from ..nn.moe import moe_ffn
+
+    B = x.shape[0]
+    h = _layer_norm(x, pa["ln2_s"], pa["ln2_b"])
+    y, _, _, counts, dropped = moe_ffn(
+        pm, h.reshape(B, -1), top_k=cfg.moe_top_k, capacity_factor=None,
+        expert_axis=cfg.moe_axis)
+    return x + y.reshape(x.shape), counts, dropped
+
+
+def _block_decode(cfg: GPTConfig, p, x, kc_l, vc_l, positions):
+    """One-token block step against one layer's cache slice.
+
+    x (B, 1, H); kc_l/vc_l (B, nh, max_len, hd) — this layer's cache for
+    every slot; positions (B,) int32 — where each slot's incoming token
+    lands. Block weights may be int8-quantized dicts (see
+    quantize_gpt_weights). Returns (x, updated kc_l, updated vc_l)."""
+    x, kc_l, vc_l = _dec_attn(cfg, p, x, kc_l, vc_l, positions)
+    return _dec_mlp(cfg, p, x), kc_l, vc_l
 
 
 def gpt_prefill(cfg: GPTConfig, params, tokens):
@@ -524,6 +732,29 @@ def gpt_prefill(cfg: GPTConfig, params, tokens):
     whole prompt drops into a KVCache slot with one dynamic_update_slice
     (serving.kv_cache.cache_insert)."""
     x = _embed(cfg, params, tokens)
+
+    if cfg.moe_layer_ids:
+        # MoE stacks are heterogeneous (see _hidden_moe) — Python loop,
+        # dropless routing, K/V collected per layer then stacked
+        moe_ids = set(cfg.moe_layer_ids)
+        blocks = params["blocks"]
+        ks, vs = [], []
+        di = mi = 0
+        for i in range(cfg.n_layers):
+            pa = _layer_params(blocks, i, _ATTN_KEYS)
+            x, (kh, vh) = _attn_half(cfg, pa, x)
+            ks.append(kh)
+            vs.append(vh)
+            if i in moe_ids:
+                pm = _layer_params(params["moe"], mi, _MOE_KEYS)
+                mi += 1
+                x = _moe_mlp_half(cfg, pa, pm, x, None)[0]
+            else:
+                pd = _layer_params(blocks, di, _MLP_KEYS)
+                di += 1
+                x = _mlp_half(cfg, {**pa, **pd}, x)
+        return _head(cfg, params, x), (jnp.stack(ks, axis=1),
+                                       jnp.stack(vs, axis=1))
 
     def step(h, layer_p):
         h, kv = _block_kv(cfg, layer_p, h)
@@ -543,12 +774,40 @@ def gpt_decode_step(cfg: GPTConfig, params, cache, positions, tokens):
     that slot); tokens (B,) int32. Returns (logits (B, V) fp32, new cache)
     with the new tokens' K/V written at ``positions``. Slots whose
     position/token are stale (unoccupied engine slots) compute garbage
-    that later prefills overwrite — callers mask host-side."""
+    that later prefills overwrite — callers mask host-side.
+
+    MoE configs return a THIRD element ``(counts (E,) i32, dropped i32)``
+    — per-tick router load for the serving gauges (dropless routing, so
+    dropped stays 0 by construction; the counter is a guard)."""
     k_cache, v_cache = cache
     cd = cfg.dtype
     L = k_cache.shape[1]
     x = (params["wte"].astype(cd)[tokens]
          + params["wpe"].astype(cd)[positions])[:, None, :]   # (B, 1, H)
+
+    if cfg.moe_layer_ids:
+        moe_ids = set(cfg.moe_layer_ids)
+        blocks = params["blocks"]
+        counts = jnp.zeros((cfg.moe_experts,), jnp.int32)
+        dropped = jnp.int32(0)
+        di = mi = 0
+        for i in range(cfg.n_layers):
+            pa = _layer_params(blocks, i, _ATTN_KEYS)
+            x, kc_l, vc_l = _dec_attn(cfg, pa, x, k_cache[:, i],
+                                      v_cache[:, i], positions)
+            k_cache = k_cache.at[:, i].set(kc_l)
+            v_cache = v_cache.at[:, i].set(vc_l)
+            if i in moe_ids:
+                pm = _layer_params(params["moe"], mi, _MOE_KEYS)
+                mi += 1
+                x, c, d = _dec_moe_mlp(cfg, pa, pm, x)
+                counts, dropped = counts + c, dropped + d
+            else:
+                pd = _layer_params(blocks, di, _MLP_KEYS)
+                di += 1
+                x = _dec_mlp(cfg, {**pa, **pd}, x)
+        return (_head(cfg, params, x)[:, 0], (k_cache, v_cache),
+                (counts, dropped))
 
     def step(carry, inp):
         x, kc, vc = carry
@@ -622,6 +881,10 @@ def gpt_verify_step(cfg: GPTConfig, params, cache, positions, tokens):
     check); rows whose later entries are rejected leave stale K/V past
     the accepted length, which the position mask hides until the next
     step overwrites them."""
+    if cfg.moe_layer_ids:
+        raise ValueError(
+            "gpt_verify_step does not support MoE configs (the engine "
+            "rejects speculative decoding with moe_experts > 0)")
     k_cache, v_cache = cache
     cd = cfg.dtype
     L = k_cache.shape[1]
@@ -656,13 +919,9 @@ def gpt_verify_step(cfg: GPTConfig, params, cache, positions, tokens):
 # point at it, so stale batch lanes scatter their garbage K/V somewhere
 # no live slot ever reads.
 
-def _block_decode_paged(cfg: GPTConfig, p, x, kb_l, vb_l, tables, positions):
-    """One-token block step against one layer's slice of the block pool.
-
-    x (B, 1, H); kb_l/vb_l (n_blocks, nh, block_size, hd); tables (B, W)
-    int32; positions (B,) int32 — where each slot's incoming token
-    lands. Attention routes through ops.paged_attention (Pallas kernel
-    on TPU, identical composed gather elsewhere)."""
+def _dec_attn_paged(cfg: GPTConfig, p, x, kb_l, vb_l, tables, positions):
+    """Attention half of the paged one-token block step (pool write +
+    paged attention + proj residual). Returns (x, kb_l, vb_l)."""
     B = x.shape[0]
     nh, hd = cfg.n_heads, cfg.head_dim
     bs = kb_l.shape[2]
@@ -688,10 +947,19 @@ def _block_decode_paged(cfg: GPTConfig, p, x, kb_l, vb_l, tables, positions):
     o = o.reshape(B, 1, nh * hd)
 
     x = x + _dec_mm(o, p["proj_w"], cd) + p["proj_b"].astype(cd)
-    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
-    h = jax.nn.gelu(_dec_mm(h, p["fc_w"], cd) + p["fc_b"].astype(cd))
-    x = x + _dec_mm(h, p["out_w"], cd) + p["out_b"].astype(cd)
     return x, kb_l, vb_l
+
+
+def _block_decode_paged(cfg: GPTConfig, p, x, kb_l, vb_l, tables, positions):
+    """One-token block step against one layer's slice of the block pool.
+
+    x (B, 1, H); kb_l/vb_l (n_blocks, nh, block_size, hd); tables (B, W)
+    int32; positions (B,) int32 — where each slot's incoming token
+    lands. Attention routes through ops.paged_attention (Pallas kernel
+    on TPU, identical composed gather elsewhere)."""
+    x, kb_l, vb_l = _dec_attn_paged(cfg, p, x, kb_l, vb_l, tables,
+                                    positions)
+    return _dec_mlp(cfg, p, x), kb_l, vb_l
 
 
 def gpt_decode_step_paged(cfg: GPTConfig, params, pool, tables, positions,
@@ -704,12 +972,36 @@ def gpt_decode_step_paged(cfg: GPTConfig, params, pool, tables, positions,
     (logits (B, V) fp32, new pool) with the new tokens' K/V written at
     block ``tables[b, positions[b] // block_size]``, offset
     ``positions[b] % block_size``. Numerics match gpt_decode_step over
-    the same live positions."""
+    the same live positions; MoE configs return the same third
+    ``(counts, dropped)`` element gpt_decode_step does."""
     kb, vb = pool
     cd = cfg.dtype
     L = kb.shape[1]
     x = (params["wte"].astype(cd)[tokens]
          + params["wpe"].astype(cd)[positions])[:, None, :]   # (B, 1, H)
+
+    if cfg.moe_layer_ids:
+        moe_ids = set(cfg.moe_layer_ids)
+        blocks = params["blocks"]
+        counts = jnp.zeros((cfg.moe_experts,), jnp.int32)
+        dropped = jnp.int32(0)
+        di = mi = 0
+        for i in range(cfg.n_layers):
+            pa = _layer_params(blocks, i, _ATTN_KEYS)
+            x, kb_l, vb_l = _dec_attn_paged(cfg, pa, x, kb[:, i], vb[:, i],
+                                            tables, positions)
+            kb = kb.at[:, i].set(kb_l)
+            vb = vb.at[:, i].set(vb_l)
+            if i in moe_ids:
+                pm = _layer_params(params["moe"], mi, _MOE_KEYS)
+                mi += 1
+                x, c, d = _dec_moe_mlp(cfg, pa, pm, x)
+                counts, dropped = counts + c, dropped + d
+            else:
+                pd = _layer_params(blocks, di, _MLP_KEYS)
+                di += 1
+                x = _dec_mlp(cfg, {**pa, **pd}, x)
+        return (_head(cfg, params, x)[:, 0], (kb, vb), (counts, dropped))
 
     def step(carry, inp):
         x, kb, vb = carry
@@ -787,6 +1079,11 @@ def gpt_verify_step_paged(cfg: GPTConfig, params, pool, tables, positions,
     grown each live row's table to cover ``positions + C`` tokens (the
     engine's speculative grow), and stale lanes scatter onto their
     garbage sink exactly like the single-token step."""
+    if cfg.moe_layer_ids:
+        raise ValueError(
+            "gpt_verify_step_paged does not support MoE configs (the "
+            "engine rejects speculative decoding and prefix caching "
+            "with moe_experts > 0)")
     kb, vb = pool
     L = kb.shape[1]
 
@@ -833,16 +1130,9 @@ def gpt_prefill_prefix(cfg: GPTConfig, params, pool, table_row, tokens,
                                  tokens)
 
 
-def _block_chunk(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):
-    """One transformer block over one prefill CHUNK against the pool.
-
-    x (1, C, H) — C is the block_size-padded chunk length; kb_l/vb_l
-    (n_blocks, nh, block_size, hd); table_row (W,) int32 — this slot's
-    table; start — tokens already cached (block-aligned, traced). The
-    chunk's K/V are written into the pool FIRST, then chunk queries
-    attend over every cached position (previous chunks + the chunk
-    itself) under the global causal mask, so the math equals one whole
-    causal pass over the same prefix."""
+def _chunk_attn(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):
+    """Attention half of the chunked-prefill block step (pool write +
+    full-prefix attention + proj residual). Returns (x, kb_l, vb_l)."""
     _, C, H = x.shape
     nh, hd = cfg.n_heads, cfg.head_dim
     bs = kb_l.shape[2]
@@ -874,7 +1164,22 @@ def _block_chunk(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):
     o = jnp.einsum("hqk,hkd->hqd", w, vg.astype(q.dtype))
     o = o.transpose(1, 0, 2).reshape(1, C, H)
 
-    x = x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd)
+    return x + o @ p["proj_w"].astype(cd) + p["proj_b"].astype(cd), \
+        kb_l, vb_l
+
+
+def _block_chunk(cfg: GPTConfig, p, x, kb_l, vb_l, table_row, start):
+    """One transformer block over one prefill CHUNK against the pool.
+
+    x (1, C, H) — C is the block_size-padded chunk length; kb_l/vb_l
+    (n_blocks, nh, block_size, hd); table_row (W,) int32 — this slot's
+    table; start — tokens already cached (block-aligned, traced). The
+    chunk's K/V are written into the pool FIRST, then chunk queries
+    attend over every cached position (previous chunks + the chunk
+    itself) under the global causal mask, so the math equals one whole
+    causal pass over the same prefix."""
+    cd = cfg.dtype
+    x, kb_l, vb_l = _chunk_attn(cfg, p, x, kb_l, vb_l, table_row, start)
     h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
     h = jax.nn.gelu(h @ p["fc_w"].astype(cd) + p["fc_b"].astype(cd))
     x = x + h @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
@@ -903,6 +1208,29 @@ def gpt_prefill_chunk(cfg: GPTConfig, params, pool, table_row, tokens,
     pos_emb = jax.lax.dynamic_slice(
         params["wpe"], (start, 0), (C, params["wpe"].shape[1]))
     x = params["wte"].astype(cd)[tokens] + pos_emb.astype(cd)[None]
+
+    if cfg.moe_layer_ids:
+        moe_ids = set(cfg.moe_layer_ids)
+        blocks = params["blocks"]
+        di = mi = 0
+        for i in range(cfg.n_layers):
+            pa = _layer_params(blocks, i, _ATTN_KEYS)
+            x, kb_l, vb_l = _chunk_attn(cfg, pa, x, kb[:, i], vb[:, i],
+                                        table_row, start)
+            kb = kb.at[:, i].set(kb_l)
+            vb = vb.at[:, i].set(vb_l)
+            if i in moe_ids:
+                pm = _layer_params(params["moe"], mi, _MOE_KEYS)
+                mi += 1
+                x = _moe_mlp_half(cfg, pa, pm, x, None)[0]
+            else:
+                pd = _layer_params(blocks, di, _MLP_KEYS)
+                di += 1
+                h = _layer_norm(x, pa["ln2_s"], pa["ln2_b"])
+                h = jax.nn.gelu(h @ pd["fc_w"].astype(cd)
+                                + pd["fc_b"].astype(cd))
+                x = x + h @ pd["out_w"].astype(cd) + pd["out_b"].astype(cd)
+        return _head(cfg, params, x), (kb, vb)
 
     def step(carry, inp):
         x, kb, vb = carry

@@ -363,6 +363,9 @@ DEFAULT_STATS = (
     "autotune_trials_ms",     # cumulative wall ms spent timing trial configs
     "fused_kernel_fallbacks",  # Pallas entries that fell back to composed jnp
     "fp8_matmul_calls",       # fp8 (e4m3) matmul dispatches
+    # mixture-of-experts serving stats (ISSUE 18)
+    "moe_expert_load",        # gauge: busiest-expert share of routed tokens, ppm
+    "moe_tokens_dropped",     # routed assignments dropped past expert capacity
 )
 
 for _n in DEFAULT_STATS:
@@ -448,6 +451,8 @@ AUTOTUNE_MISSES = _registry.get_stat("autotune_misses")
 AUTOTUNE_TRIALS_MS = _registry.get_stat("autotune_trials_ms")
 FUSED_KERNEL_FALLBACKS = _registry.get_stat("fused_kernel_fallbacks")
 FP8_MATMUL_CALLS = _registry.get_stat("fp8_matmul_calls")
+MOE_EXPERT_LOAD = _registry.get_stat("moe_expert_load")
+MOE_TOKENS_DROPPED = _registry.get_stat("moe_tokens_dropped")
 
 
 # -- pre-registered latency histograms (ISSUE 15) ---------------------------
@@ -470,6 +475,10 @@ DEFAULT_HISTOGRAMS = (
     ("serving_prefill_chunk_ms",
      "prefill work quantum wall latency: one chunk (paged) or one "
      "whole-prompt prefill (fixed) (ms)"),
+    ("moe_expert_share_pct",
+     "per-expert share of routed assignments per decode tick (%) — "
+     "one observation per expert per tick, so the spread IS the "
+     "imbalance (uniform router: all mass at 100/E)"),
 )
 
 HISTOGRAM_HELP = dict(DEFAULT_HISTOGRAMS)
@@ -483,6 +492,7 @@ SERVING_QUEUE_WAIT_MS = _registry.get_histogram("serving_queue_wait_ms")
 SERVING_DECODE_TICK_MS = _registry.get_histogram("serving_decode_tick_ms")
 SERVING_PREFILL_CHUNK_MS = _registry.get_histogram(
     "serving_prefill_chunk_ms")
+MOE_EXPERT_SHARE_PCT = _registry.get_histogram("moe_expert_share_pct")
 
 
 # -- Prometheus text exposition (ISSUE 15 satellite) ------------------------

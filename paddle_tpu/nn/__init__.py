@@ -15,6 +15,7 @@ from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .moe import MoELayer  # noqa: F401
 
 from ..framework.core import Parameter  # noqa: F401
 

@@ -174,6 +174,8 @@ from ..models.gpt import (gpt_decode_step, gpt_decode_step_paged,
                           gpt_verify_step, gpt_verify_step_paged)
 from ..monitor.stats import (CONSTRAINED_FALLBACK_TICKS,
                              CONSTRAINED_REQUESTS, FAULTS_INJECTED,
+                             MOE_EXPERT_LOAD, MOE_EXPERT_SHARE_PCT,
+                             MOE_TOKENS_DROPPED,
                              PREFIX_COW_COPIES, SERVING_DEADLINE_SHEDS,
                              SERVING_DECODE_MS, SERVING_DECODE_TICK_MS,
                              SERVING_EVICTIONS, SERVING_FIRST_TOKEN_MS,
@@ -553,6 +555,30 @@ class InferenceEngine:
             if cfg.n_heads % model_deg != 0:
                 raise ValueError(f"n_heads={cfg.n_heads} not divisible by "
                                  f"the model degree {model_deg}")
+        self._moe = bool(getattr(cfg, "moe_layer_ids", ()))
+        if self._moe:
+            import dataclasses as _dc
+
+            if int8_weights:
+                raise ValueError("int8_weights and MoE are not combinable "
+                                 "(no quantized layout for the expert "
+                                 "pytree)")
+            if draft is not None:
+                raise ValueError("draft= and MoE are not combinable: "
+                                 "speculative verify has no routed-expert "
+                                 "path (gpt_verify_step rejects MoE)")
+            if self._mesh is not None:
+                model_deg = int(self._mesh.shape["model"])
+                if model_deg > 1 and cfg.moe_experts % model_deg != 0:
+                    raise ValueError(
+                        f"moe_experts={cfg.moe_experts} not divisible by "
+                        f"the model degree {model_deg} — experts shard "
+                        "over the \"model\" axis")
+                cfg = _dc.replace(
+                    cfg, moe_axis="model" if model_deg > 1 else None)
+            else:
+                cfg = _dc.replace(cfg, moe_axis=None)
+            self.cfg = cfg
         self._params = self._put_params(cfg, params)
         self.int8_weights = bool(int8_weights)
         if int8_weights:
@@ -605,6 +631,11 @@ class InferenceEngine:
                              "the draft's fixed cache holds no K/V for a "
                              "skipped prefix, so every hit would force a "
                              "full draft prefill")
+        if use_prefix and self._moe:
+            raise ValueError("prefix_cache and MoE are not combinable: "
+                             "prefix reuse verifies through "
+                             "gpt_verify_step_paged, which has no "
+                             "routed-expert path")
         if use_prefix:
             self._prefix = RadixPrefixCache(self.cache)
             self._tail_jit = jax.jit(self._tail_fn, donate_argnums=(1, 2))
@@ -789,15 +820,21 @@ class InferenceEngine:
 
     def _decode_fn(self, params, k, v, positions, tokens, base_key, rids,
                    steps, temps, top_ks, top_ps, mask):
-        logits, (k, v) = gpt_decode_step(self.cfg, params, (k, v),
-                                         positions, tokens)
+        got = gpt_decode_step(self.cfg, params, (k, v), positions, tokens)
+        logits, (k, v) = got[0], got[1]
         toks = self._sample_args(logits, base_key, rids, steps, temps,
                                  top_ks, top_ps, mask)
+        out = (toks,)
         if self._watchdog is not None:
             # per-slot finite verdict — gated at TRACE time, so a
             # watchdog-off engine compiles the exact historical program
-            return toks, logits_finite(logits), k, v
-        return toks, k, v
+            out = out + (logits_finite(logits),)
+        out = out + (k, v)
+        if self._moe:
+            # (counts (E,), dropped) router stats — always LAST so the
+            # tick's unpack can peel them off uniformly
+            out = out + (got[2],)
+        return out
 
     def _prefill_fn(self, params, k, v, tokens, slot, true_len, key, temp,
                     top_k, top_p, mask):
@@ -828,13 +865,18 @@ class InferenceEngine:
     def _decode_paged_fn(self, params, kb, vb, tables, positions, tokens,
                          base_key, rids, steps, temps, top_ks, top_ps,
                          mask):
-        logits, (kb, vb) = gpt_decode_step_paged(
+        got = gpt_decode_step_paged(
             self.cfg, params, (kb, vb), tables, positions, tokens)
+        logits, (kb, vb) = got[0], got[1]
         toks = self._sample_args(logits, base_key, rids, steps, temps,
                                  top_ks, top_ps, mask)
+        out = (toks,)
         if self._watchdog is not None:
-            return toks, logits_finite(logits), kb, vb
-        return toks, kb, vb
+            out = out + (logits_finite(logits),)
+        out = out + (kb, vb)
+        if self._moe:
+            out = out + (got[2],)
+        return out
 
     def _tail_fn(self, params, kb, vb, table_row, tokens, start):
         # prefix-cache tail chunk: continue a prefill from an UNALIGNED
@@ -1876,6 +1918,9 @@ class InferenceEngine:
                         self.cache.vb, tables, positions, tokens,
                         self._base_key, rids, steps, temps, top_ks,
                         top_ps, mask_arg)
+                    moe_stats = None
+                    if self._moe:
+                        *got, moe_stats = got
                     if self._watchdog is not None:
                         out, health, self.cache.kb, self.cache.vb = got
                     else:
@@ -1885,12 +1930,17 @@ class InferenceEngine:
                         self._decode_params, self.cache.k, self.cache.v,
                         positions, tokens, self._base_key, rids, steps,
                         temps, top_ks, top_ps, mask_arg)
+                    moe_stats = None
+                    if self._moe:
+                        *got, moe_stats = got
                     if self._watchdog is not None:
                         out, health, self.cache.k, self.cache.v = got
                     else:
                         out, self.cache.k, self.cache.v = got
                 out = np.asarray(out)
                 n_emit = None
+                if moe_stats is not None:
+                    self._note_moe(moe_stats, span_args)
             else:
                 # reference decode: full recompute per sequence, no cache
                 out = np.zeros(self.n_slots, np.int32)
@@ -2156,6 +2206,28 @@ class InferenceEngine:
             self.cache.update_gauges()
 
     # -- gauges --------------------------------------------------------------
+    def _note_moe(self, moe_stats, span_args=None) -> None:
+        """Publish per-tick router stats: busiest-expert share (ppm
+        gauge + per-expert % histogram — the spread IS the imbalance)
+        and the cumulative dropped-assignment counter. Decode is
+        dropless (C=T), so dropped stays 0 there; the counter exists
+        for parity with training capacity accounting."""
+        counts, dropped = moe_stats
+        counts = np.asarray(counts, np.int64)
+        total = int(counts.sum())
+        if total > 0:
+            shares = counts / total
+            MOE_EXPERT_LOAD.set(int(float(shares.max()) * 1e6))
+            for sh in shares:
+                MOE_EXPERT_SHARE_PCT.observe(float(sh) * 100.0)
+        nd = int(np.asarray(dropped))
+        if nd:
+            MOE_TOKENS_DROPPED.add(nd)
+        if span_args is not None and total > 0:
+            span_args["moe_busiest_pct"] = round(
+                float(counts.max()) / total * 100.0, 2)
+            span_args["moe_dropped"] = nd
+
     def _note_ms(self, gauge, attr: str, ms: float) -> None:
         old = getattr(self, attr)
         new = old + ms

@@ -52,6 +52,12 @@ def pytest_configure(config):
         "round-trips, `tools/autotune --check` staleness) — select with "
         "`pytest -m tuning` after touching ops/autotune.py or a kernel "
         "family registration")
+    config.addinivalue_line(
+        "markers",
+        "moe: mixture-of-experts tests (nn/moe router+dispatch, MoE GPT "
+        "blocks, ep planner, sparse serving decode) — select with "
+        "`pytest -m moe` after touching nn/moe.py, ops/moe_dispatch.py "
+        "or the gpt MoE paths")
 
 
 @pytest.fixture(autouse=True)

@@ -44,6 +44,7 @@ class TestSaveLoad:
 
 
 class TestResumeEquivalence:
+    @pytest.mark.slow  # trains BOTH trajectories; roundtrip pin stays tier-1
     def test_resume_matches_uninterrupted(self, tmp_path):
         """Train 4 steps straight vs train 2 + checkpoint + restore into a
         FRESH step + train 2 — losses must match exactly (the reference's

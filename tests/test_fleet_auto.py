@@ -555,6 +555,7 @@ class TestPlannerStatic:
 
 
 class TestBenchConfig:
+    @pytest.mark.slow  # full bench leg; planner logic is pinned by the unit tests above
     def test_gpt_1p3b_auto_analytic_leg(self):
         import sys
 

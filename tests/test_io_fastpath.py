@@ -87,6 +87,7 @@ def _restore_flags():
 
 
 class TestShmTransport:
+    @pytest.mark.slow  # best-of-3 perf race; byte-identity pin stays tier-1
     def test_shm_beats_pipe_4_workers(self):
         """Acceptance: shm >=1.5x over pipe at 4 workers. Best-of-3 per
         transport damps scheduler noise (single runs vary ~2x)."""

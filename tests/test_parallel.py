@@ -155,6 +155,7 @@ class TestHybridTrainStep:
 
 
 class TestGraftEntry:
+    @pytest.mark.slow  # recompiles the same 8-dev hybrid step TestHybridTrainStep pins
     def test_dryrun_multichip(self):
         import importlib.util
         import pathlib

@@ -329,6 +329,7 @@ class TestEngineRouter:
         assert router.healthy_replicas() == [1]
         assert router.health()[0]["failed_over"]
 
+    @pytest.mark.slow  # same failover machinery as the greedy leg above
     def test_failover_sampled_token_identity(self, engine):
         """Sampled streams survive failover bit-exactly too: the rid
         rides along and replicas share the seed, so the per-request RNG

@@ -157,6 +157,7 @@ class TestSpecAccept:
 
 
 class TestSpeculativeEngine:
+    @pytest.mark.slow  # fixed-cache repeat of the paged identity leg below
     def test_spec_greedy_token_identity_fixed(self, engine):
         """Acceptance: speculative greedy == non-speculative greedy ==
         full-recompute reference, spec gauges move, report verdict."""

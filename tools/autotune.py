@@ -38,7 +38,8 @@ def _load_families():
     from paddle_tpu.ops import autotune
 
     for mod in ("flash_attention", "fused_kernels", "int8_matmul",
-                "fused_optimizer", "paged_attention", "fp8_matmul"):
+                "fused_optimizer", "paged_attention", "fp8_matmul",
+                "moe_dispatch"):
         importlib.import_module("paddle_tpu.ops.%s" % mod)
     return autotune
 

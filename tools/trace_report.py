@@ -1027,6 +1027,50 @@ def embedding_report(events: list, file=None) -> dict:
     return out
 
 
+def moe_report(events: list, file=None) -> dict:
+    """Mixture-of-experts routing verdict (ISSUE 18).
+
+    ``serving.decode_step`` spans from an MoE engine carry
+    ``{moe_busiest_pct, moe_dropped}`` per tick (engine._note_moe).
+    The report answers the one question that decides MoE serving
+    health: is the router balanced?  A uniform router puts 100/E % on
+    the busiest expert; a collapsed router puts ~100 % there, which
+    serialises every token through one expert's FFN and wastes the
+    other E-1 shards."""
+    ticks = [e for e in events if e.get("name") == "serving.decode_step"
+             and "moe_busiest_pct" in (e.get("args") or {})]
+    if not ticks:
+        return {}
+    busiest = [float(e["args"]["moe_busiest_pct"]) for e in ticks]
+    dropped = sum(int(e["args"].get("moe_dropped", 0)) for e in ticks)
+    out: dict = {
+        "ticks": len(ticks),
+        "busiest_expert_pct_avg": sum(busiest) / len(busiest),
+        "busiest_expert_pct_max": max(busiest),
+        "tokens_dropped": dropped,
+    }
+    avg = out["busiest_expert_pct_avg"]
+    # uniform-router baseline is 100/E, but E isn't in the span; grade
+    # on absolute share — >50 % means one expert owns the majority of
+    # every tick regardless of E
+    out["verdict"] = (
+        f"router collapse: busiest expert averages {avg:.1f}% of routed "
+        "tokens — raise moe_aux_weight or re-init the router"
+        if avg > 50.0 else
+        f"imbalanced but working ({avg:.1f}% busiest): aux loss is "
+        "holding the router short of collapse" if avg > 25.0 else
+        f"balanced router ({avg:.1f}% busiest expert)")
+    if dropped:
+        out["verdict"] += f"; {dropped} routed assignments dropped"
+    print("\nMixture of experts:", file=file)
+    for k, v in out.items():
+        if isinstance(v, float):
+            print(f"  {k:<24}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def report(rows: list, top: int = 20, file=None) -> list:
     rows = rows[:top]
     if not rows:
@@ -1065,6 +1109,7 @@ SECTIONS = {
                                            top=c["top"]),
     "flight": lambda c, f: flight_report(c["flights"], file=f),
     "embedding": lambda c, f: embedding_report(c["events"], file=f),
+    "moe": lambda c, f: moe_report(c["events"], file=f),
 }
 
 
