@@ -990,7 +990,9 @@ def bench_serving_chaos(on_accel):
     adds a ReplicaSupervisor: restart-rejoin through the backoff ladder
     under ``spawn_fail``, a brownout-driven scale-up/scale-down cycle,
     and the warm-vs-cold first-token comparison for the re-warmed
-    radix tree — the top-level ``value`` gates BOTH legs' identity.
+    radix tree. The ISSUE-19 host-loss leg (``_fleet_burst``) kills a
+    decode host of a small cross-host fleet abruptly mid-burst — the
+    top-level ``value`` gates ALL three legs' identity.
     """
     import threading
 
@@ -1109,10 +1111,17 @@ def bench_serving_chaos(on_accel):
         "serving_chaos")
     identity = 1.0 if completed and not corrupt else 0.0
     lifecycle = _serving_chaos_lifecycle_leg(cfg, params, rng)
+    # ISSUE 19 chaos extension: host-loss injection — a small cross-host
+    # fleet burst where a decode host dies abruptly mid-burst and every
+    # rerouted stream must stay token-identical
+    fleet_loss = _fleet_burst(cfg, params, rng, n_req=8, max_new=10,
+                              lose_host=True, job="chaos_fleet")
     return {
-        "value": min(identity, lifecycle["identity"]),
+        "value": min(identity, lifecycle["identity"],
+                     fleet_loss["identity"]),
         "overload_leg_identity": identity,
         "lifecycle": lifecycle,
+        "fleet_host_loss": fleet_loss,
         "unit": "healthy-stream token-identity under chaos (1.0 = exact)",
         "completed": len(completed), "corrupt": len(corrupt),
         "deadline_shed": len(shed), "silent_drops": len(silent),
@@ -1134,6 +1143,225 @@ def bench_serving_chaos(on_accel):
                 "fifth request carries a 0.4s deadline; identity = all "
                 "completed streams token-equal to a fault-free engine",
     }
+
+
+def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
+    """ISSUE 19 shared harness: an in-process 3-host fleet (one
+    prefill-role + two decode-role HostAgents over real RPC sockets and
+    a FileKVStore registry) serving a Poisson burst, optionally losing
+    one decode host abruptly mid-burst. Greedy and sampled requests
+    interleave; every completed stream is gated token-identical to a
+    monolithic single-engine oracle — the disaggregated KV stream and
+    the cross-host failover replay must both be invisible in tokens."""
+    import shutil
+    import tempfile
+    import threading
+
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed.elastic import FileKVStore
+    from paddle_tpu.monitor import get_histogram, hist_delta, hist_quantile
+    from paddle_tpu.serving import InferenceEngine
+    from paddle_tpu.serving.pod import HostAgent, connect_fleet
+
+    def factory():
+        return InferenceEngine(cfg, params, n_slots=4, paged=True,
+                               block_size=16, n_blocks=129,
+                               prefill_chunk=64, queue_size=4 * n_req,
+                               prefix_cache=True, seed=0)
+
+    plens = [40, 72, 24, 56]        # 24 < disagg_min=32: stays direct
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            plens[i % len(plens)]).astype(np.int32)
+               for i in range(n_req)]
+    # even requests greedy, odd sampled — identity must hold for both
+    sample_kw = [{} if i % 2 == 0 else {"temperature": 0.7, "top_k": 5}
+                 for i in range(n_req)]
+    gaps = rng.exponential(1 / 24.0, n_req)
+
+    # greedy oracles are rid-independent and precompute; sampled ones
+    # are a pure function of (seed, rid), and each fleet engine assigns
+    # its OWN rid sequence — so sampled requests verify post-run against
+    # a monolithic engine replaying the fleet's actual rid (adoption
+    # preserves rid: the same mechanism failover identity rides on)
+    expected: dict = {}
+    mono = factory()
+    try:
+        for i in range(n_req):
+            if not sample_kw[i]:
+                expected[i] = mono.generate(prompts[i],
+                                            max_new_tokens=max_new)
+    finally:
+        mono.shutdown(drain=False)
+
+    s0 = {k: monitor.stat_get(k) for k in
+          ("fleet_prefill_routed", "fleet_direct_fallbacks",
+           "fleet_kv_transfer_bytes", "fleet_reroutes", "rpc_calls")}
+    kv0 = get_histogram("fleet_kv_transfer_ms").snapshot()
+    root = tempfile.mkdtemp(prefix="fleet_bench_")
+    agents: dict = {}
+    router = None
+    try:
+        store = FileKVStore(root)
+        for host, role in (("prefill0", "prefill"), ("decode0", "decode"),
+                           ("decode1", "decode")):
+            agents[host] = HostAgent(store, job, host, factory, role=role,
+                                     heartbeat_s=0.1)
+        router = connect_fleet(store, job, min_hosts=3, registry_ttl=0.9,
+                               rpc_timeout=60.0, poll_s=0.2,
+                               monitor_poll_s=0.1)
+
+        # role-utilization sampler: decode occupancy vs prefill busy
+        util = {"decode": [], "prefill": []}
+        stop = threading.Event()
+
+        def sample():
+            while not stop.wait(0.05):
+                reps = router.healthy_replicas()
+                occ = sum(router.engine_for(r).occupancy for r in reps)
+                cap = sum(router.engine_for(r).n_slots for r in reps)
+                util["decode"].append(occ / cap if cap else 0.0)
+                util["prefill"].append(
+                    float(any(p.busy for p in router._prefill_pool)))
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+
+        first_t = [None] * n_req
+        sub_t = [None] * n_req
+        results: list = [None] * n_req
+        reqs: list = [None] * n_req
+
+        def consume(i, req):
+            try:
+                toks = []
+                for tok in req.stream(timeout=240):
+                    if first_t[i] is None:
+                        first_t[i] = time.perf_counter()
+                    toks.append(tok)
+                results[i] = toks
+            except (TimeoutError, RuntimeError):
+                results[i] = None
+
+        threads = []
+        lost_host = None
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            sub_t[i] = time.perf_counter()
+            reqs[i] = router.submit(prompts[i], max_new_tokens=max_new,
+                                    **sample_kw[i])
+            th = threading.Thread(target=consume, args=(i, reqs[i]))
+            th.start()
+            threads.append(th)
+            if lose_host and lost_host is None and i == n_req // 2:
+                # kill the decode host serving an in-flight stream: its
+                # open requests MUST reroute token-identically
+                for r in reqs[:i + 1]:
+                    rep = getattr(r, "_replica", None)
+                    if r.finish_reason is None and rep is not None:
+                        host = getattr(router.engine_for(rep), "host",
+                                       None)
+                        if host in agents:
+                            lost_host = host
+                            agents[host].close(abrupt=True)
+                            break
+            if gaps[i] > 0:
+                time.sleep(gaps[i])
+        for th in threads:
+            th.join(timeout=300)
+        wall = time.perf_counter() - t0
+        stop.set()
+        sampler.join(timeout=2.0)
+    finally:
+        if router is not None:
+            router.shutdown(drain=False)
+        for a in agents.values():
+            try:
+                a.close()
+            except Exception:  # noqa: BLE001 — the killed host is gone
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+    from paddle_tpu.serving.engine import GenerationRequest
+
+    oracle = factory()
+    try:
+        for i in range(n_req):
+            if not sample_kw[i] or results[i] is None:
+                continue
+            req = GenerationRequest(prompts[i], max_new,
+                                    sample_kw[i]["temperature"],
+                                    sample_kw[i]["top_k"], 1.0, None, None)
+            req.rid = reqs[i].rid
+            oracle.adopt_request(req)
+            expected[i] = req.result(timeout=120)
+    finally:
+        oracle.shutdown(drain=False)
+
+    completed = [i for i in range(n_req) if results[i] is not None]
+    corrupt = [i for i in completed if results[i] != expected.get(i)]
+    ftl = np.asarray([(first_t[i] - sub_t[i]) * 1e3 for i in range(n_req)
+                      if first_t[i] is not None])
+    kvd = hist_delta(kv0, get_histogram("fleet_kv_transfer_ms").snapshot())
+    s1 = {k: monitor.stat_get(k) - s0[k] for k in s0}
+    routed = s1["fleet_prefill_routed"]
+    disagg_total = routed + s1["fleet_direct_fallbacks"]
+    return {
+        "identity": 1.0 if len(completed) == n_req and not corrupt
+        else 0.0,
+        "completed": len(completed), "corrupt": len(corrupt),
+        "lost_host": lost_host,
+        "rerouted_streams": s1["fleet_reroutes"],
+        "prefill_routed": routed,
+        "direct_fallbacks": s1["fleet_direct_fallbacks"],
+        "disagg_frac": round(routed / disagg_total, 3)
+        if disagg_total else 0.0,
+        "kv_transfer_ms_p50": round(hist_quantile(kvd, 0.50), 3),
+        "kv_transfer_ms_p99": round(hist_quantile(kvd, 0.99), 3),
+        "kv_transfer_mib": round(
+            s1["fleet_kv_transfer_bytes"] / (1 << 20), 3),
+        "first_token_ms_p50": round(float(np.percentile(ftl, 50)), 2)
+        if ftl.size else None,
+        "first_token_ms_p99": round(float(np.percentile(ftl, 99)), 2)
+        if ftl.size else None,
+        "decode_occupancy_mean": round(
+            float(np.mean(util["decode"])), 3) if util["decode"] else 0.0,
+        "prefill_busy_frac": round(
+            float(np.mean(util["prefill"])), 3) if util["prefill"] else 0.0,
+        "rpc_calls": s1["rpc_calls"],
+        "wall_s": round(wall, 2),
+    }
+
+
+def bench_serving_fleet(on_accel):
+    """ISSUE 19: cross-host fleet leg — one prefill-role + two
+    decode-role HostAgents over real loopback RPC and a FileKVStore
+    registry, serving a Poisson burst of mixed greedy/sampled requests
+    with disaggregated prefill->decode KV-block streaming, then losing
+    a decode host abruptly mid-burst. Gates: every stream completes
+    token-identical to a monolithic engine (identity 1.0 — KV splice
+    AND cross-host failover replay both invisible), plus first-token
+    p50/p99, kv-transfer ms, and the prefill/decode utilization split
+    the acceptance bar names."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import gpt_init, gpt_tiny
+
+    cfg = gpt_tiny(seq_len=256,
+                   dtype=jnp.bfloat16 if on_accel else jnp.float32)
+    params = gpt_init(cfg, seed=0)
+    rng = np.random.default_rng(1901)
+    leg = _fleet_burst(cfg, params, rng, n_req=12, max_new=16,
+                       lose_host=True, job="bench_fleet")
+    leg["value"] = leg["identity"]
+    leg["unit"] = "fleet token-identity under host loss (1.0 = exact)"
+    leg["note"] = (
+        "12 req (greedy/sampled interleaved, ~24rps Poisson) through a "
+        "3-host fleet (prefill0 + decode0/decode1, real RPC sockets, "
+        "FileKVStore registry heartbeats); long prompts prefill on the "
+        "prefill host and stream KV blocks to the placed decode "
+        "replica; one decode host is killed abruptly mid-burst — its "
+        "open streams reroute via token-replay failover; identity = "
+        "every stream token-equal to one monolithic engine")
+    return leg
 
 
 def bench_serving_spec(on_accel):
@@ -2215,6 +2443,7 @@ def main():
                      ("serving_spec", bench_serving_spec),
                      ("serving_load", bench_serving_load),
                      ("serving_chaos", bench_serving_chaos),
+                     ("serving_fleet", bench_serving_fleet),
                      ("dlrm_ctr", bench_dlrm_ctr),
                      ("resilience", bench_resilience)):
         if over_budget():

@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..monitor import stats as _mstats
@@ -101,6 +103,55 @@ class FileKVStore:
                 return f.read()
         except FileNotFoundError:
             return None
+
+    # binary-safe framed values (ISSUE 19): KV-block payload manifests and
+    # large registration records carry bytes that must not ride the text
+    # path unguarded — a torn NFS read or a truncated GCS-fuse flush would
+    # otherwise hand the reader silently-corrupt block rows. Frame: magic,
+    # crc32, payload length, payload. The size guard bounds what one
+    # heartbeat-path writer can park on the shared store.
+    BYTES_MAGIC = b"KVB1"
+    MAX_BYTES = 256 * 1024 * 1024
+
+    def put_bytes(self, key: str, value: bytes,
+                  max_bytes: Optional[int] = None) -> None:
+        """Atomic checksummed binary write; retry discipline identical to
+        :meth:`put` (the frame is built once, then rides the same
+        transient-OSError budget)."""
+        value = bytes(value)
+        cap = self.MAX_BYTES if max_bytes is None else int(max_bytes)
+        if len(value) > cap:
+            raise ValueError(
+                f"put_bytes({key!r}): payload {len(value)} bytes exceeds "
+                f"the {cap}-byte size guard")
+        frame = (self.BYTES_MAGIC
+                 + struct.pack("<IQ", zlib.crc32(value) & 0xFFFFFFFF,
+                               len(value))
+                 + value)
+        self.put(key, frame)
+
+    def get_bytes(self, key: str) -> Optional[bytes]:
+        """Read a :meth:`put_bytes` frame back, verifying length and
+        checksum. None when the key is absent; ValueError when the frame
+        is torn or corrupt (the caller retries or treats the record as
+        missing — never consumes garbage)."""
+        raw = self.get(key)
+        if raw is None:
+            return None
+        head = len(self.BYTES_MAGIC) + 12
+        if len(raw) < head or not raw.startswith(self.BYTES_MAGIC):
+            raise ValueError(f"get_bytes({key!r}): not a framed binary "
+                             "record (bad magic)")
+        crc, size = struct.unpack("<IQ", raw[len(self.BYTES_MAGIC):head])
+        payload = raw[head:]
+        if len(payload) != size:
+            raise ValueError(
+                f"get_bytes({key!r}): torn frame — header says {size} "
+                f"bytes, file holds {len(payload)}")
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise ValueError(f"get_bytes({key!r}): checksum mismatch "
+                             "(corrupt payload)")
+        return payload
 
     def delete(self, key: str) -> None:
         _partition_check()

@@ -366,6 +366,18 @@ DEFAULT_STATS = (
     # mixture-of-experts serving stats (ISSUE 18)
     "moe_expert_load",        # gauge: busiest-expert share of routed tokens, ppm
     "moe_tokens_dropped",     # routed assignments dropped past expert capacity
+    # cross-host serving fleet (ISSUE 19)
+    "fleet_hosts",            # gauge: fleet hosts with a fresh heartbeat
+    "fleet_replicas",         # gauge: remote replica proxies attached to the router
+    "fleet_kv_transfer_bytes",  # KV block bytes streamed prefill-host -> decode-host
+    "fleet_kv_exports",       # prefix exports served by prefill-role replicas
+    "fleet_kv_imports",       # prefix imports spliced into decode-role pools
+    "fleet_prefill_routed",   # requests whose prefill ran on a prefill-role host
+    "fleet_direct_fallbacks",  # disaggregated submits that fell back to direct decode
+    "fleet_reroutes",         # host-loss events that re-routed streams to survivors
+    "fleet_prewarms",         # replicas pre-warmed by the arrival-rate forecaster
+    "rpc_calls",              # RPC round trips issued by remote replica proxies
+    "rpc_errors",             # RPC round trips that failed (transport or remote)
 )
 
 for _n in DEFAULT_STATS:
@@ -453,6 +465,17 @@ FUSED_KERNEL_FALLBACKS = _registry.get_stat("fused_kernel_fallbacks")
 FP8_MATMUL_CALLS = _registry.get_stat("fp8_matmul_calls")
 MOE_EXPERT_LOAD = _registry.get_stat("moe_expert_load")
 MOE_TOKENS_DROPPED = _registry.get_stat("moe_tokens_dropped")
+FLEET_HOSTS = _registry.get_stat("fleet_hosts")
+FLEET_REPLICAS = _registry.get_stat("fleet_replicas")
+FLEET_KV_TRANSFER_BYTES = _registry.get_stat("fleet_kv_transfer_bytes")
+FLEET_KV_EXPORTS = _registry.get_stat("fleet_kv_exports")
+FLEET_KV_IMPORTS = _registry.get_stat("fleet_kv_imports")
+FLEET_PREFILL_ROUTED = _registry.get_stat("fleet_prefill_routed")
+FLEET_DIRECT_FALLBACKS = _registry.get_stat("fleet_direct_fallbacks")
+FLEET_REROUTES = _registry.get_stat("fleet_reroutes")
+FLEET_PREWARMS = _registry.get_stat("fleet_prewarms")
+RPC_CALLS = _registry.get_stat("rpc_calls")
+RPC_ERRORS = _registry.get_stat("rpc_errors")
 
 
 # -- pre-registered latency histograms (ISSUE 15) ---------------------------
@@ -479,6 +502,15 @@ DEFAULT_HISTOGRAMS = (
      "per-expert share of routed assignments per decode tick (%) — "
      "one observation per expert per tick, so the spread IS the "
      "imbalance (uniform router: all mass at 100/E)"),
+    ("fleet_kv_transfer_ms",
+     "prefill-host -> decode-host KV block stream wall latency per "
+     "prompt: export + transport + pool splice (ms)"),
+    ("fleet_arrival_gap_ms",
+     "inter-arrival gap between fleet submissions (ms) — the "
+     "arrival-rate series the pre-warm forecaster reads (rps = "
+     "1000/median gap)"),
+    ("rpc_call_ms",
+     "remote-replica RPC round-trip wall latency (ms)"),
 )
 
 HISTOGRAM_HELP = dict(DEFAULT_HISTOGRAMS)
@@ -493,6 +525,9 @@ SERVING_DECODE_TICK_MS = _registry.get_histogram("serving_decode_tick_ms")
 SERVING_PREFILL_CHUNK_MS = _registry.get_histogram(
     "serving_prefill_chunk_ms")
 MOE_EXPERT_SHARE_PCT = _registry.get_histogram("moe_expert_share_pct")
+FLEET_KV_TRANSFER_MS = _registry.get_histogram("fleet_kv_transfer_ms")
+FLEET_ARRIVAL_GAP_MS = _registry.get_histogram("fleet_arrival_gap_ms")
+RPC_CALL_MS = _registry.get_histogram("rpc_call_ms")
 
 
 # -- Prometheus text exposition (ISSUE 15 satellite) ------------------------

@@ -82,6 +82,33 @@ Layers, bottom up:
   ``max_replicas``; sustained rung 0 + low occupancy drains-and-
   shrinks, migrating open streams to survivors token-identically).
   No supervisor = bit-identical to the PR-13 router;
+- :mod:`rpc` — the stdlib cross-host transport (ISSUE 19): one
+  length-prefixed JSON-header + binary-blob frame over TCP
+  (:class:`~rpc.RpcServer` / :class:`~rpc.RpcClient` with a per-client
+  socket pool so parked long-polls never delay health probes), a
+  zero-copy numpy array codec (bfloat16/fp8 via ml_dtypes names), and
+  the two-level error contract — :class:`~rpc.RpcError` (transport:
+  dead peer, torn frame, timeout — the failover signal) vs
+  :class:`~rpc.RpcRemoteError` (the remote handler raised; ``.etype``
+  carries the remote type so ``QueueFull`` maps back);
+- :mod:`pod` — the cross-HOST fleet (ISSUE 19): hosts run a
+  :class:`~pod.HostAgent` (engines + RPC server + registry heartbeat
+  over the elastic :class:`FileKVStore`'s checksummed binary records);
+  clients :func:`~pod.connect_fleet` into a :class:`~pod.FleetRouter`
+  whose :class:`~pod.RemoteReplica` proxies expose the SAME
+  submit/stream/adopt/health surface as an in-process engine — router
+  affinity, token-replay failover, the supervisor ladder and the
+  frontend all compose unchanged across machines. Role-split replicas
+  disaggregate serving: prefill-role hosts run chunked prefill only
+  and stream finished KV blocks to decode-role hosts, which splice
+  them through the refcounted block table (token-identical to
+  monolithic, greedy AND sampled); :class:`~pod.FleetScheduler`
+  assigns roles, sizes pools per phase and pre-warms decode replicas
+  from :class:`~pod.ArrivalRateForecaster` arrival-rate windows ahead
+  of the brownout ladder. Host loss = heartbeat staleness → open
+  streams re-route through the PR-13 failover contract
+  (``tools/trace_report.py fleet_report`` turns the fleet spans into
+  per-host utilization and KV-transfer verdicts);
 - :mod:`frontend` — the network surface (``python -m
   paddle_tpu.serving.frontend``): a stdlib-asyncio HTTP server with
   OpenAI-style ``/v1/completions`` and ``/v1/chat/completions`` (SSE
@@ -117,8 +144,12 @@ from .engine import (GenerationRequest, InferenceEngine, QueueFull,
 from .kv_cache import KVCache, PagedKVCache, cache_insert
 from .lifecycle import ReplicaFailed, ReplicaSupervisor
 from .overload import RUNG_NAMES, OverloadController
+from .pod import (ArrivalRateForecaster, FleetRegistry, FleetRouter,
+                  FleetScheduler, HostAgent, RemoteReplica,
+                  RemoteReplicaError, connect_fleet)
 from .prefix_cache import RadixPrefixCache
 from .router import EngineRouter
+from .rpc import RpcClient, RpcError, RpcRemoteError, RpcServer
 from .sampling import sample_tokens, sample_tokens_streams, spec_accept, \
     stream_keys
 from .tokenizer import ByteTokenizer, StreamDetokenizer
@@ -133,4 +164,8 @@ __all__ = [
     "ByteTokenizer", "StreamDetokenizer",
     "TokenConstraint", "ConstraintCursor", "compile_constraint",
     "compile_regex", "schema_to_regex",
+    "HostAgent", "RemoteReplica", "RemoteReplicaError", "FleetRegistry",
+    "FleetRouter",
+    "FleetScheduler", "ArrivalRateForecaster", "connect_fleet",
+    "RpcServer", "RpcClient", "RpcError", "RpcRemoteError",
 ]

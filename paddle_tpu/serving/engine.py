@@ -392,6 +392,31 @@ class GenerationRequest:
                                       skip_special=True)
 
 
+class _HostCall:
+    """One cross-thread closure parked for the scheduler
+    (:meth:`InferenceEngine.run_on_scheduler`): the result/error slot
+    plus a completion event the submitting thread blocks on."""
+
+    __slots__ = ("fn", "result", "error", "done")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    def run(self, eng) -> None:
+        try:
+            self.result = self.fn(eng)
+        except BaseException as e:  # noqa: BLE001 — a host-call error is
+            self.error = e          # the caller's, never a scheduler crash
+        self.done.set()
+
+    def fail(self, err: BaseException) -> None:
+        self.error = err
+        self.done.set()
+
+
 class _Slot:
     """Host-side state of one occupied cache slot."""
 
@@ -715,6 +740,11 @@ class InferenceEngine:
                 self._ranker = EmbeddingRanker(dict(embedding_tables),
                                                mesh=self._mesh)
         self._last_tick_t = time.monotonic()
+        # cross-host fleet (ISSUE 19): closures parked by other threads
+        # for the scheduler to run between ticks — the KV export/import
+        # path touches the donated pool buffers, which only the
+        # scheduler thread may do (guarded by self._cv)
+        self._host_calls: collections.deque = collections.deque()
         self._thread = threading.Thread(target=self._run,
                                         name="serving-scheduler", daemon=True)
         self._thread.start()
@@ -1160,6 +1190,123 @@ class InferenceEngine:
             self._die_tick = self._ticks + max(1, int(ticks_ahead))
             self._cv.notify_all()
 
+    # -- KV-block streaming (pod disaggregation, serving/pod.py, ISSUE 19) ---
+    def run_on_scheduler(self, fn, timeout: Optional[float] = None):
+        """Run ``fn(engine)`` ON the scheduler thread, between ticks, and
+        return its result (re-raising its exception). This is the only
+        safe way for another thread to touch the donated pool buffers or
+        the radix tree: between ticks no jit call is in flight and the
+        refcount tables are consistent. Called from the scheduler thread
+        itself, runs inline (the warm/export composition)."""
+        if threading.current_thread() is self._thread:
+            return fn(self)
+        call = _HostCall(fn)
+        with self._cv:
+            self._check_open()
+            self._host_calls.append(call)
+            self._cv.notify_all()
+        if not call.done.wait(timeout):
+            raise TimeoutError("scheduler did not service the host call "
+                               f"within {timeout}s")
+        if call.error is not None:
+            raise call.error
+        return call.result
+
+    def export_kv_prefix(self, tokens, timeout: Optional[float] = None):
+        """Serialize the cached KV blocks covering ``tokens`` — the
+        prefill side of disaggregated serving. Matches the radix tree
+        (longest cached prefix, capped at len-1 like every splice) and
+        gathers the matched pool rows to host memory. Returns ``None``
+        when nothing is cached, else a dict with ``matched_len``,
+        ``block_size``, ``dtype``, ``shape`` and host-numpy ``kb``/``vb``
+        of shape (n_blocks, layers, heads, block_size, head_dim). The
+        gather runs on the scheduler thread (:meth:`run_on_scheduler`);
+        the returned arrays are copies, safe to ship over RPC."""
+        if self._prefix is None:
+            raise RuntimeError("export_kv_prefix needs prefix_cache=True")
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+
+        def _export(eng):
+            m_len, blocks, shard = 0, [], 0
+            for d in range(eng.cache.shards):
+                m, bl = eng._prefix.match(d, toks)
+                if m > m_len:
+                    m_len, blocks, shard = m, bl, d
+            if m_len <= 0 or not blocks:
+                return None
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            kb = np.asarray(jax.device_get(eng.cache.kb[idx]))
+            vb = np.asarray(jax.device_get(eng.cache.vb[idx]))
+            return {"matched_len": int(m_len),
+                    "block_size": int(eng.block_size),
+                    "dtype": str(kb.dtype), "shape": list(kb.shape),
+                    "kb": kb, "vb": vb}
+
+        return self.run_on_scheduler(_export, timeout=timeout)
+
+    def import_kv_prefix(self, tokens, kb, vb, matched_len: int,
+                         timeout: Optional[float] = None) -> int:
+        """Splice streamed KV blocks (an :meth:`export_kv_prefix` payload
+        from a prefill-role peer) into this engine's pool and radix tree
+        — the decode side of disaggregated serving. Best-effort: returns
+        the number of tokens now cached for the prefix (0 when the pool
+        has no room), after which a plain ``submit`` of the same prompt
+        hits the radix tree and splices exactly like a local prefix hit
+        — the pinned token-identity guarantee carries over unchanged."""
+        if self._prefix is None:
+            raise RuntimeError("import_kv_prefix needs prefix_cache=True")
+        toks = np.asarray(tokens, np.int32).reshape(-1)[:int(matched_len)]
+        kb = np.asarray(kb)
+        vb = np.asarray(vb)
+        n = int(kb.shape[0])
+        if toks.size <= 0 or n == 0:
+            return 0
+        if n != self.cache.blocks_for(toks.size) or kb.shape != vb.shape:
+            raise ValueError(
+                f"import_kv_prefix: {n} streamed blocks do not cover "
+                f"{toks.size} tokens at block_size {self.block_size}")
+
+        def _import(eng):
+            # already warm (idempotent re-stream)? keep the local copy
+            have = max(eng._prefix.peek(d, toks)
+                       for d in range(eng.cache.shards))
+            if have >= toks.size:
+                return int(have)
+            # target the shard with the most reclaimable room
+            best_d, room = 0, -1
+            for d in range(eng.cache.shards):
+                avail = (eng.cache.free_blocks_of(d)
+                         + eng._prefix.evictable_count(d))
+                if avail > room:
+                    best_d, room = d, avail
+            if room < n:
+                return 0
+            short = n - eng.cache.free_blocks_of(best_d)
+            if short > 0 and eng._prefix.evict(best_d, short) < short:
+                return 0
+            blocks = []
+            for _ in range(n):
+                b = eng.cache.alloc_block(best_d)
+                if b is None:          # lost the race: roll back cleanly
+                    for bb in blocks:
+                        eng.cache.unref_block(bb)
+                    return 0
+                blocks.append(b)
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            dt = eng.cache.kb.dtype
+            eng.cache.kb = eng.cache.kb.at[idx].set(jnp.asarray(kb, dt))
+            eng.cache.vb = eng.cache.vb.at[idx].set(jnp.asarray(vb, dt))
+            eng._prefix.insert(best_d, toks, blocks)
+            # insert() took a tree reference on every chunk it adopted;
+            # drop the alloc-time reference so the tree is sole owner and
+            # duplicates of chunks it already held free immediately
+            for b in blocks:
+                eng.cache.unref_block(b)
+            eng.cache.update_gauges()
+            return int(eng._prefix.peek(best_d, toks))
+
+        return self.run_on_scheduler(_import, timeout=timeout)
+
     # -- health surface (EngineRouter / frontend readyz) ---------------------
     @property
     def alive(self) -> bool:
@@ -1238,10 +1385,23 @@ class InferenceEngine:
                         s is not None for s in self._slots)
                     if self._stop and (not self._drain or not busy):
                         break
-                    if not busy:
+                    # run_on_scheduler closures (ISSUE 19): popped under
+                    # the lock, run outside it — between ticks, so the
+                    # pool buffers are quiescent (no donated jit call in
+                    # flight) and the radix tree is consistent
+                    calls = None
+                    if self._host_calls:
+                        calls = list(self._host_calls)
+                        self._host_calls.clear()
+                    if not busy and not calls:
                         self._cv.wait(0.05)
                         continue
                     die = self._die_tick
+                if calls:
+                    for c in calls:
+                        c.run(self)
+                    if not busy:
+                        continue
                 self._ticks += 1
                 if die is not None and self._ticks >= die:
                     # fail_at_tick (replica_flap chaos / operator kill):
@@ -1278,8 +1438,13 @@ class InferenceEngine:
                 self._stop = True
                 leftovers = list(self._queue)
                 self._queue.clear()
+                stranded = list(self._host_calls)
+                self._host_calls.clear()
                 SERVING_QUEUE_DEPTH.set(0)
                 self._cv.notify_all()
+            for c in stranded:
+                c.fail(RuntimeError(
+                    "engine shut down before the host call ran"))
             for req in leftovers:
                 req._finish(SHUTDOWN)
             for s, st in enumerate(self._slots):

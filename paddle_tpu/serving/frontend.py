@@ -599,6 +599,11 @@ class ServingFrontend:
             sup = getattr(e, "supervisor", None)
             if sup is not None:
                 checks["lifecycle"] = sup.snapshot()
+            # cross-host fleet membership (ISSUE 19): host id, role and
+            # last-heartbeat age per replica, beside the lifecycle view
+            fleet = getattr(e, "fleet_members", None)
+            if callable(fleet):
+                checks["fleet"] = {str(k): v for k, v in fleet().items()}
         else:
             checks["engine_alive"] = bool(e.alive)
             checks["pool_headroom"] = round(e.pool_headroom(), 4)

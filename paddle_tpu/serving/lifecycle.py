@@ -23,7 +23,12 @@ replacement through a backoff/quarantine ladder::
                            the original cause, the slot is marked
                            failed, a lifecycle.give_up span records it
 
-A replica that stays alive ``stable_s`` seconds resets its ladder. The
+A replica that stays alive ``stable_s`` seconds resets its ladder.
+Ladders are keyed by (host, replica id) — in a cross-host fleet
+(serving/pod.py) a healthy host re-offering a replica id after a host
+swap starts from ITS OWN attempt count, not the dead host's, and
+:meth:`ReplicaSupervisor.note_host_offer` makes such a slot immediately
+due instead of serving out the old host's quarantine hold. The
 replacement re-registers under the SAME replica id
 (:meth:`EngineRouter.add_replica` — the failover hook is keyed by
 (id, engine) so a stale incarnation cannot unroute its successor), its
@@ -80,7 +85,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..monitor.flight import dump_flight
 from ..monitor.stats import (FAULTS_INJECTED, PREFIX_WARM_TOKENS,
@@ -102,9 +107,9 @@ class _Slot:
     """Lifecycle state of one replica id."""
 
     __slots__ = ("state", "attempts", "next_try_t", "since_t", "old_rid",
-                 "cause", "drain_since")
+                 "cause", "drain_since", "host")
 
-    def __init__(self):
+    def __init__(self, host=None):
         self.state = "live"     # live|pending|quarantined|draining|failed
         self.attempts = 0       # respawn attempts since the last stable run
         self.next_try_t = 0.0   # monotonic time of the next spawn attempt
@@ -112,6 +117,7 @@ class _Slot:
         self.old_rid = 0        # dead engine's request-id watermark
         self.cause = None       # last death cause (restart-span arg)
         self.drain_since = None  # monotonic drain start (scale-down)
+        self.host = host        # host the current incarnation runs on
 
 
 class ReplicaSupervisor:
@@ -185,8 +191,12 @@ class ReplicaSupervisor:
         self.overload = router.overload     # the shared brownout ladder
         self._cv = threading.Condition()
         self._slots: Dict[int, _Slot] = {
-            rid: _Slot() for rid in
-            (e.replica_id for e in router.engines)}
+            e.replica_id: _Slot(host=getattr(e, "host", None))
+            for e in router.engines}
+        # backoff/quarantine ladders keyed by (host, replica id): a
+        # healthy host re-offering a replica id after a host swap must
+        # not inherit the dead host's attempt count (ISSUE 19)
+        self._ladders: Dict[Tuple[Optional[str], int], int] = {}
         self._target = len(self._slots)
         self._spawn_seq = 0     # factory invocations (spawn_fail space)
         self._rejoin_seq = 0    # completed rejoins (replica_flap space)
@@ -217,13 +227,44 @@ class ReplicaSupervisor:
                 "scale_ups": self._scale_ups,
                 "scale_downs": self._scale_downs,
                 "replicas": {str(rid): {"state": st.state,
-                                        "attempts": st.attempts}
+                                        "attempts": st.attempts,
+                                        "host": st.host}
                              for rid, st in sorted(self._slots.items())},
             }
 
     @property
     def target_replicas(self) -> int:
         return self._target
+
+    def note_host_offer(self, rid: int, host: Optional[str]) -> bool:
+        """A healthy host (re-)offers capacity for replica ``rid``.
+
+        Quarantine is keyed by (host, replica): when the offering host
+        differs from the one whose deaths built the current ladder, the
+        slot switches to the offering host's own attempt count and
+        becomes immediately due — a dead host's quarantine hold must not
+        hostage a healthy host re-offering the same replica id after a
+        host swap (ISSUE 19). Returns True when the offer unblocked the
+        slot. No-op for live/draining/failed slots and same-host offers.
+        """
+        now = time.monotonic()
+        with self._cv:
+            st = self._slots.get(int(rid))
+            if st is None or st.state not in ("pending", "quarantined"):
+                return False
+            if st.host == host:
+                return False
+            self._ladders[(st.host, int(rid))] = st.attempts
+            st.attempts = self._ladders.get((host, int(rid)), 0)
+            st.host = host
+            if st.state == "quarantined":
+                st.state = "pending"
+            st.next_try_t = now      # due on the next scan
+            self._cv.notify_all()
+        with span("lifecycle.host_offer", cat="serving",
+                  args={"replica": int(rid), "host": str(host)}):
+            pass
+        return True
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop the monitor thread (engines/router are the caller's)."""
@@ -275,10 +316,11 @@ class ReplicaSupervisor:
                 self._attempt_respawn(rid, st)
         # ladder reset: a replica that survived stable_s earned it
         with self._cv:
-            for st in self._slots.values():
+            for rid, st in self._slots.items():
                 if st.state == "live" and st.attempts \
                         and now - st.since_t > self.stable_s:
                     st.attempts = 0
+                    self._ladders.pop((st.host, rid), None)
 
     @staticmethod
     def _cause_of(eng) -> str:
@@ -293,11 +335,20 @@ class ReplicaSupervisor:
             # wakes — adoption/orphan parking handles them from there
             eng.evacuate()
         old_rid = int(getattr(eng, "_rid", 0))
+        host = getattr(eng, "host", None)
         self.router.remove_replica(rid)
         now = time.monotonic()
         with self._cv:
             st.old_rid = max(st.old_rid, old_rid)
             st.cause = cause
+            # the ladder belongs to (host, replica), not the bare id:
+            # park the dying host's attempt count under its own key and
+            # resume whatever count THIS host had accrued before
+            if st.host != host:
+                self._ladders[(st.host, rid)] = st.attempts
+                st.attempts = self._ladders.get((host, rid), 0)
+                st.host = host
+            self._ladders[(host, rid)] = st.attempts
             if st.attempts >= self.max_restarts:
                 self._give_up(rid, st)
                 return
@@ -356,6 +407,7 @@ class ReplicaSupervisor:
         attempt = st.attempts
         with self._cv:
             st.attempts += 1
+            self._ladders[(st.host, rid)] = st.attempts
         try:
             eng = self._spawn(st.cause or "dead", rid, attempt)
         except BaseException as e:  # noqa: BLE001 — a failed spawn is a
@@ -384,6 +436,7 @@ class ReplicaSupervisor:
         with self._cv:
             st.state = "live"
             st.since_t = now
+            st.host = getattr(eng, "host", None)
             self._rejoin_seq += 1
             rejoin = self._rejoin_seq
         with span("lifecycle.rejoin", cat="serving",
@@ -514,7 +567,7 @@ class ReplicaSupervisor:
                         "rung": self.overload.rung}):
             pass
         with self._cv:
-            self._slots[rid] = _Slot()
+            self._slots[rid] = _Slot(host=getattr(eng, "host", None))
             self._target = n_live + 1
             self._scale_events += 1
             self._scale_ups += 1

@@ -308,6 +308,25 @@ class EngineRouter:
             }
         return out
 
+    def fleet_members(self) -> Dict:
+        """Per-replica fleet membership (ISSUE 19): which HOST each
+        replica lives on, its fleet role, and how stale that host's
+        heartbeat is. In-process engines report ``host None`` / role
+        ``"mixed"`` with age 0.0 — their heartbeat is the scheduler tick
+        itself, already covered by ``health()``'s tick_age_s. The
+        frontend joins this into ``/readyz`` as ``checks.fleet`` so an
+        operator can see where a replica physically runs."""
+        with self._lock:
+            items = sorted(self._replicas.items())
+        out = {}
+        for i, e in items:
+            age = getattr(e, "heartbeat_age", None)
+            out[i] = {"host": getattr(e, "host", None),
+                      "role": getattr(e, "role", "mixed"),
+                      "heartbeat_age_s": round(float(age()), 3)
+                      if callable(age) else 0.0}
+        return out
+
     # -- placement -----------------------------------------------------------
     def _load(self, replica: int) -> int:
         e = self.engine_for(replica)

@@ -1,0 +1,321 @@
+"""Thin stdlib RPC transport for the cross-host serving fleet (ISSUE 19).
+
+One frame = ``PRPC`` magic + ``<I json_len, Q blob_len>`` + a JSON header
++ an optional binary blob. The header carries the method, scalar params,
+and a manifest describing how the blob splits into named numpy arrays
+(``{"name", "dtype", "shape", "nbytes"}`` each) — KV block rows ride the
+blob raw, never JSON. The same frame shape serves requests and replies.
+
+Design constraints, in order:
+
+- **stdlib only** (socket/struct/json/threading) — the fleet must not
+  grow a dependency the training side doesn't have.
+- **Blocking request/response per connection.** The server runs one
+  thread per connection, so a handler may legitimately block (the
+  long-poll ``wait`` that streams tokens parks in ``req._cv.wait_for``
+  server-side); the client keeps a small connection pool so one parked
+  long-poll never delays a concurrent health probe.
+- **Failure = exception, not hang.** Socket timeouts bound every call;
+  a dead peer surfaces as :class:`RpcError` at the caller, which is the
+  signal the fleet layer (serving/pod.py) turns into replica failover.
+
+Threading notes (GL003/GL004): the server's connection set and the
+client's socket pool are the only cross-thread state, each guarded by
+its own ``_lock``; sockets are checked out under the lock but all I/O
+happens outside it, so no lock is ever held across a blocking call and
+no second lock is ever taken while one is held.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..monitor.stats import RPC_CALLS, RPC_CALL_MS, RPC_ERRORS
+
+__all__ = ["RpcError", "RpcRemoteError", "RpcServer", "RpcClient",
+           "encode_arrays", "decode_arrays"]
+
+_MAGIC = b"PRPC"
+_HEAD = len(_MAGIC) + 12            # magic + <I json_len> + <Q blob_len>
+MAX_HEADER_BYTES = 16 * 1024 * 1024
+MAX_BLOB_BYTES = 512 * 1024 * 1024
+
+
+class RpcError(RuntimeError):
+    """Transport-level failure: dead peer, torn frame, timeout."""
+
+
+class RpcRemoteError(RpcError):
+    """The remote handler raised; ``etype`` names the remote type so the
+    fleet layer can distinguish e.g. a remote QueueFull from a crash."""
+
+    def __init__(self, etype: str, message: str):
+        super().__init__(f"{etype}: {message}")
+        self.etype = etype
+
+
+# -- array codec -------------------------------------------------------------
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes               # bfloat16/fp8 names (jax dep)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_arrays(arrays: Dict[str, Any]) -> Tuple[list, bytes]:
+    """(manifest, blob) for a dict of numpy arrays; order-preserving."""
+    manifest, parts = [], []
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        raw = a.tobytes()
+        manifest.append({"name": str(name), "dtype": a.dtype.name,
+                         "shape": list(a.shape), "nbytes": len(raw)})
+        parts.append(raw)
+    return manifest, b"".join(parts)
+
+
+def decode_arrays(manifest, blob: bytes) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for m in manifest or ():
+        n = int(m["nbytes"])
+        if off + n > len(blob):
+            raise RpcError(f"torn blob: manifest wants {off + n} bytes, "
+                           f"frame carries {len(blob)}")
+        a = np.frombuffer(blob, dtype=_np_dtype(m["dtype"]),
+                          count=n // max(1, _np_dtype(m["dtype"]).itemsize),
+                          offset=off)
+        out[str(m["name"])] = a.reshape([int(s) for s in m["shape"]])
+        off += n
+    if off != len(blob):
+        raise RpcError(f"torn blob: {len(blob) - off} trailing bytes")
+    return out
+
+
+# -- framing -----------------------------------------------------------------
+def _recvall(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, header: dict, blob: bytes = b"") -> None:
+    payload = json.dumps(header, separators=(",", ":")).encode()
+    sock.sendall(_MAGIC + struct.pack("<IQ", len(payload), len(blob))
+                 + payload + blob)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    head = _recvall(sock, _HEAD)
+    if not head.startswith(_MAGIC):
+        raise RpcError(f"bad frame magic {head[:4]!r}")
+    jlen, blen = struct.unpack("<IQ", head[len(_MAGIC):])
+    if jlen > MAX_HEADER_BYTES or blen > MAX_BLOB_BYTES:
+        raise RpcError(f"oversized frame: header {jlen}B, blob {blen}B")
+    header = json.loads(_recvall(sock, jlen))
+    blob = _recvall(sock, blen) if blen else b""
+    return header, blob
+
+
+# -- server ------------------------------------------------------------------
+class RpcServer:
+    """One accept thread + one thread per connection, dispatching to a
+    dict of handlers ``{method: fn(params, arrays) -> result}`` where a
+    handler may return either a JSON-able result or a tuple ``(result,
+    arrays)`` to ship binary payloads back. Handler exceptions become
+    :class:`RpcRemoteError` at the caller; they never kill the server."""
+
+    def __init__(self, handlers: Dict[str, Callable],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handlers = dict(handlers)
+        self._listener = socket.create_server((host, int(port)))
+        self.addr: Tuple[str, int] = self._listener.getsockname()[:2]
+        self._lock = threading.Lock()          # guards _conns
+        self._conns: set = set()
+        self._closed_event = threading.Event()
+        self._accepter = threading.Thread(
+            target=self._accept_loop, name="rpc-accept", daemon=True)
+        self._accepter.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed_event.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:                    # listener closed: shutdown
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                if self._closed_event.is_set():
+                    conn.close()
+                    continue
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="rpc-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed_event.is_set():
+                try:
+                    header, blob = _recv_frame(conn)
+                except (ConnectionError, OSError, RpcError, ValueError):
+                    break                      # peer gone / torn frame
+                resp, rblob = self._dispatch(header, blob)
+                try:
+                    _send_frame(conn, resp, rblob)
+                except (ConnectionError, OSError):
+                    break
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, header: dict, blob: bytes) -> Tuple[dict, bytes]:
+        mid = header.get("id")
+        method = header.get("method", "")
+        fn = self._handlers.get(method)
+        if fn is None:
+            return ({"id": mid, "ok": False, "etype": "KeyError",
+                     "error": f"no such method: {method!r}"}, b"")
+        try:
+            arrays = decode_arrays(header.get("blobs"), blob)
+            out = fn(header.get("params") or {}, arrays)
+        except Exception as e:  # noqa: BLE001 — handler errors go to caller
+            return ({"id": mid, "ok": False, "etype": type(e).__name__,
+                     "error": str(e)}, b"")
+        result, out_arrays = out if isinstance(out, tuple) else (out, None)
+        manifest, rblob = encode_arrays(out_arrays or {})
+        return ({"id": mid, "ok": True, "result": result,
+                 "blobs": manifest}, rblob)
+
+    def close(self) -> None:
+        self._closed_event.set()
+        # a blocked accept() is NOT woken by close() from another thread
+        # on Linux — shutdown() the listener first (wakes it with EINVAL),
+        # with a throwaway self-connect as the portable fallback
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            socket.create_connection(self.addr, timeout=0.2).close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._accepter.join(timeout=2.0)
+
+
+# -- client ------------------------------------------------------------------
+class RpcClient:
+    """Pooled blocking client. ``call`` checks a socket out of the pool
+    (dialing a fresh one when empty), runs one request/response on it
+    outside any lock, and returns it — so concurrent callers (a parked
+    long-poll, a health probe, a KV stream) each get their own
+    connection and never serialize behind each other."""
+
+    POOL_MAX = 4
+
+    def __init__(self, addr, timeout: float = 30.0):
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.timeout = float(timeout)
+        self._lock = threading.Lock()          # guards _pool/_seq/_closed
+        self._pool: list = []
+        self._seq = 0
+        self._closed = False
+
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(self.addr, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, method: str, params: Optional[dict] = None,
+             arrays: Optional[Dict[str, Any]] = None,
+             timeout: Optional[float] = None):
+        """Returns ``(result, arrays)``. Raises :class:`RpcRemoteError`
+        when the handler raised, :class:`RpcError` on transport death
+        (the fleet-failover signal — the socket is discarded, never
+        returned to the pool)."""
+        with self._lock:
+            if self._closed:
+                raise RpcError("client closed")
+            self._seq += 1
+            mid = self._seq
+            sock = self._pool.pop() if self._pool else None
+        t0 = time.monotonic()
+        RPC_CALLS.add()
+        try:
+            if sock is None:
+                sock = self._dial()
+            manifest, blob = encode_arrays(arrays or {})
+            sock.settimeout(self.timeout if timeout is None else timeout)
+            _send_frame(sock, {"id": mid, "method": method,
+                               "params": params or {}, "blobs": manifest},
+                        blob)
+            resp, rblob = _recv_frame(sock)
+        except (ConnectionError, OSError, struct.error,
+                json.JSONDecodeError) as e:
+            RPC_ERRORS.add()
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise RpcError(f"rpc {method!r} to {self.addr[0]}:"
+                           f"{self.addr[1]}: {type(e).__name__}: {e}") from e
+        keep = False
+        with self._lock:
+            if not self._closed and len(self._pool) < self.POOL_MAX:
+                self._pool.append(sock)
+                keep = True
+        if not keep:
+            sock.close()
+        RPC_CALL_MS.observe((time.monotonic() - t0) * 1e3)
+        if resp.get("id") != mid:
+            RPC_ERRORS.add()
+            raise RpcError(f"rpc {method!r}: response id {resp.get('id')} "
+                           f"for request {mid} (desynced stream)")
+        if not resp.get("ok"):
+            RPC_ERRORS.add()
+            raise RpcRemoteError(resp.get("etype", "Exception"),
+                                 resp.get("error", ""))
+        return resp.get("result"), decode_arrays(resp.get("blobs"), rblob)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            pool = list(self._pool)
+            self._pool.clear()
+        for sock in pool:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -34,6 +34,42 @@ class TestFileKVStore:
         with pytest.raises(ValueError):
             kv.put("../escape", b"x")
 
+    def test_bytes_roundtrip_and_missing(self, tmp_path):
+        kv = FileKVStore(str(tmp_path / "kv"))
+        payload = bytes(range(256)) * 3        # every byte value rides
+        kv.put_bytes("blobs/b0", payload)
+        assert kv.get_bytes("blobs/b0") == payload
+        kv.put_bytes("blobs/empty", b"")
+        assert kv.get_bytes("blobs/empty") == b""
+        assert kv.get_bytes("blobs/missing") is None
+
+    def test_bytes_size_guard(self, tmp_path):
+        kv = FileKVStore(str(tmp_path / "kv"))
+        with pytest.raises(ValueError, match="size guard"):
+            kv.put_bytes("blobs/big", b"x" * 17, max_bytes=16)
+        kv.put_bytes("blobs/ok", b"x" * 16, max_bytes=16)
+        assert kv.get_bytes("blobs/ok") == b"x" * 16
+
+    def test_bytes_corruption_detected(self, tmp_path):
+        """A reader must never consume garbage: bit-flips, truncation
+        and unframed text values all raise instead of returning."""
+        kv = FileKVStore(str(tmp_path / "kv"))
+        kv.put_bytes("blobs/b0", b"framed payload bytes")
+        path = tmp_path / "kv" / "blobs" / "b0"
+        raw = bytearray(path.read_bytes())
+        raw[-1] ^= 0xFF                        # flip a payload byte
+        path.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            kv.get_bytes("blobs/b0")
+        kv.put_bytes("blobs/b1", b"will be truncated mid-flush")
+        p1 = tmp_path / "kv" / "blobs" / "b1"
+        p1.write_bytes(p1.read_bytes()[:-5])   # torn write
+        with pytest.raises(ValueError, match="torn frame"):
+            kv.get_bytes("blobs/b1")
+        kv.put("blobs/text", "plain text value")
+        with pytest.raises(ValueError, match="bad magic"):
+            kv.get_bytes("blobs/text")
+
 
 class TestMembership:
     def test_alive_dead_and_ttl(self, tmp_path):

@@ -1071,6 +1071,136 @@ def moe_report(events: list, file=None) -> dict:
     return out
 
 
+def fleet_report(events: list, file=None) -> dict:
+    """Cross-host serving fleet verdict (ISSUE 19).
+
+    Reads the spans ``serving/pod.py`` emits: ``fleet.members``
+    (membership snapshot per change), ``fleet.kv_stream`` (one per
+    disaggregated prefill->decode KV transfer, with bytes/ms/matched),
+    ``fleet.direct`` (disagg fallback, with reason), ``fleet.host_lost``
+    (rerouted stream count) and ``fleet.prewarm``. When the trace is a
+    ``merge_traces`` stitch of per-host flight dumps, the process-name
+    lanes also split prefill vs decode wall time per host."""
+    def _args(e):
+        return e.get("args") or {}
+
+    members = [e for e in events if e.get("name") == "fleet.members"]
+    streams = [e for e in events if e.get("name") == "fleet.kv_stream"]
+    directs = [e for e in events if e.get("name") == "fleet.direct"]
+    lost = [e for e in events if e.get("name") == "fleet.host_lost"]
+    prewarms = [e for e in events if e.get("name") == "fleet.prewarm"]
+    if not (members or streams or directs or lost or prewarms):
+        return {}
+    out: dict = {}
+
+    # -- per-host replica table (last membership snapshot wins) -----------
+    hosts = dict(_args(members[-1]).get("hosts") or {}) if members else {}
+    lost_hosts = sorted({str(_args(e).get("host")) for e in lost})
+    # per-host prefill/decode wall time: merge_traces names each process
+    # lane "<host> pid=N", so pid -> host recovers the split
+    pid_host = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            label = str(_args(e).get("name", ""))
+            if " pid=" in label:
+                pid_host[e.get("pid")] = label.split(" pid=")[0]
+    _PREFILL = ("serving.prefill", "serving.prefill_chunk")
+    util: dict = {}     # host -> [prefill_us, decode_us]
+    marks: dict = {}    # (pid, tid) -> [(name, ts)]
+    for e in events:
+        name, ph = e.get("name", ""), e.get("ph")
+        if name not in _PREFILL and name != "serving.decode_step":
+            continue
+        host = pid_host.get(e.get("pid"), "?")
+        if ph == "X":
+            util.setdefault(host, [0.0, 0.0])[
+                0 if name in _PREFILL else 1] += float(e.get("dur", 0))
+        elif ph == "B":
+            marks.setdefault((e.get("pid"), e.get("tid")), []).append(
+                (name, float(e.get("ts", 0))))
+        elif ph == "E":
+            stack = marks.get((e.get("pid"), e.get("tid")))
+            if stack:
+                bname, bts = stack.pop()
+                util.setdefault(host, [0.0, 0.0])[
+                    0 if bname in _PREFILL else 1] += \
+                    float(e.get("ts", 0)) - bts
+    table = []
+    for h in sorted(set(hosts) | set(util) | set(lost_hosts)):
+        rec = hosts.get(h, {})
+        pf_us, dec_us = util.get(h, (0.0, 0.0))
+        table.append({"host": h, "role": rec.get("role", "?"),
+                      "replicas": rec.get("replicas", "?"),
+                      "lost": h in lost_hosts,
+                      "prefill_ms": pf_us / 1e3, "decode_ms": dec_us / 1e3})
+    out["hosts"] = table
+
+    # -- KV streaming ------------------------------------------------------
+    n_direct = len(directs)
+    if streams:
+        ms = sorted(float(_args(e).get("ms", 0.0)) for e in streams)
+        nbytes = sum(int(_args(e).get("bytes", 0)) for e in streams)
+        out["kv_transfers"] = len(streams)
+        out["kv_bytes"] = nbytes
+        out["kv_tokens_streamed"] = sum(int(_args(e).get("matched", 0))
+                                        for e in streams)
+        out["kv_ms_p50"] = ms[len(ms) // 2]
+        out["kv_ms_max"] = ms[-1]
+        secs = sum(ms) / 1e3
+        out["kv_mib_per_s"] = (nbytes / (1 << 20)) / secs if secs else 0.0
+    out["direct_fallbacks"] = n_direct
+    if n_direct:
+        reasons: dict = {}
+        for e in directs:
+            r = str(_args(e).get("reason", "?"))
+            reasons[r] = reasons.get(r, 0) + 1
+        out["fallback_reasons"] = dict(sorted(reasons.items()))
+    total = len(streams) + n_direct
+    out["disagg_frac"] = len(streams) / total if total else 0.0
+    out["hosts_lost"] = len(lost)
+    out["streams_rerouted"] = sum(int(_args(e).get("rerouted", 0))
+                                  for e in lost)
+    out["replicas_prewarmed"] = sum(int(_args(e).get("added", 0))
+                                    for e in prewarms)
+
+    # -- verdict -----------------------------------------------------------
+    if streams:
+        out["verdict"] = (
+            f"{len(streams)}/{total} long prompts prefilled remotely "
+            f"({out['kv_bytes'] / (1 << 20):.1f} MiB of KV streamed at "
+            f"{out['kv_mib_per_s']:.0f} MiB/s, p50 {out['kv_ms_p50']:.1f} "
+            "ms): disaggregation is carrying prefill off the decode "
+            "hosts" if out["disagg_frac"] >= 0.5 else
+            f"only {len(streams)}/{total} disagg submissions landed — "
+            "check fallback_reasons; decode hosts are still running "
+            "most prefills")
+    elif n_direct:
+        out["verdict"] = (f"no KV stream completed ({n_direct} "
+                          "fallback(s)) — disagg path is configured but "
+                          "never succeeding; see fallback_reasons")
+    else:
+        out["verdict"] = "fleet registered; no disaggregated traffic seen"
+    if lost:
+        out["verdict"] += (f"; {len(lost)} host-loss event(s) rerouted "
+                           f"{out['streams_rerouted']} stream(s)")
+
+    print("\nServing fleet:", file=file)
+    for r in table:
+        flag = "LOST" if r["lost"] else ""
+        print(f"  {str(r['host']):<12}{str(r['role']):<9}"
+              f"replicas={str(r['replicas']):<4}"
+              f"prefill_ms={r['prefill_ms']:<10.1f}"
+              f"decode_ms={r['decode_ms']:<10.1f}{flag}", file=file)
+    for k, v in out.items():
+        if k == "hosts":
+            continue
+        if isinstance(v, float):
+            print(f"  {k:<24}{v:>12.3f}", file=file)
+        else:
+            print(f"  {k}: {v}", file=file)
+    return out
+
+
 def report(rows: list, top: int = 20, file=None) -> list:
     rows = rows[:top]
     if not rows:
@@ -1110,6 +1240,7 @@ SECTIONS = {
     "flight": lambda c, f: flight_report(c["flights"], file=f),
     "embedding": lambda c, f: embedding_report(c["events"], file=f),
     "moe": lambda c, f: moe_report(c["events"], file=f),
+    "fleet": lambda c, f: fleet_report(c["events"], file=f),
 }
 
 
