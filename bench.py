@@ -1116,12 +1116,26 @@ def bench_serving_chaos(on_accel):
     # rerouted stream must stay token-identical
     fleet_loss = _fleet_burst(cfg, params, rng, n_req=8, max_new=10,
                               lose_host=True, job="chaos_fleet")
+    # ISSUE 20 network-chaos legs: a net_partition window between the
+    # router and one decode host mid-burst (open streams reroute, new
+    # submits re-place — token identity must hold), and a prefill host
+    # blackholed mid-KV-stream (decode resumes with a local tail
+    # prefill, greedy AND sampled identity)
+    fleet_partition = _fleet_burst(
+        cfg, params, rng, n_req=8, max_new=10, lose_host=False,
+        job="chaos_partition",
+        fault_spec="net_partition@step=6:secs=1.5:hosts=router|decode0")
+    fleet_resume = _fleet_resume_leg(cfg, params, rng)
     return {
         "value": min(identity, lifecycle["identity"],
-                     fleet_loss["identity"]),
+                     fleet_loss["identity"],
+                     fleet_partition["identity"],
+                     fleet_resume["identity"]),
         "overload_leg_identity": identity,
         "lifecycle": lifecycle,
         "fleet_host_loss": fleet_loss,
+        "fleet_net_partition": fleet_partition,
+        "fleet_kv_resume": fleet_resume,
         "unit": "healthy-stream token-identity under chaos (1.0 = exact)",
         "completed": len(completed), "corrupt": len(corrupt),
         "deadline_shed": len(shed), "silent_drops": len(silent),
@@ -1145,14 +1159,18 @@ def bench_serving_chaos(on_accel):
     }
 
 
-def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
+def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job,
+                 fault_spec=None):
     """ISSUE 19 shared harness: an in-process 3-host fleet (one
     prefill-role + two decode-role HostAgents over real RPC sockets and
     a FileKVStore registry) serving a Poisson burst, optionally losing
     one decode host abruptly mid-burst. Greedy and sampled requests
     interleave; every completed stream is gated token-identical to a
     monolithic single-engine oracle — the disaggregated KV stream and
-    the cross-host failover replay must both be invisible in tokens."""
+    the cross-host failover replay must both be invisible in tokens.
+    ``fault_spec`` (ISSUE 20) arms deterministic network chaos — e.g. a
+    ``net_partition`` window between the router and one decode host —
+    for the duration of the burst."""
     import shutil
     import tempfile
     import threading
@@ -1160,6 +1178,7 @@ def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
     from paddle_tpu import monitor
     from paddle_tpu.distributed.elastic import FileKVStore
     from paddle_tpu.monitor import get_histogram, hist_delta, hist_quantile
+    from paddle_tpu.resilience.faults import configure_faults
     from paddle_tpu.serving import InferenceEngine
     from paddle_tpu.serving.pod import HostAgent, connect_fleet
 
@@ -1195,7 +1214,9 @@ def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
 
     s0 = {k: monitor.stat_get(k) for k in
           ("fleet_prefill_routed", "fleet_direct_fallbacks",
-           "fleet_kv_transfer_bytes", "fleet_reroutes", "rpc_calls")}
+           "fleet_kv_transfer_bytes", "fleet_reroutes", "rpc_calls",
+           "fleet_kv_chunks_streamed", "fleet_kv_resume_tails",
+           "rpc_retries")}
     kv0 = get_histogram("fleet_kv_transfer_ms").snapshot()
     root = tempfile.mkdtemp(prefix="fleet_bench_")
     agents: dict = {}
@@ -1209,6 +1230,9 @@ def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
         router = connect_fleet(store, job, min_hosts=3, registry_ttl=0.9,
                                rpc_timeout=60.0, poll_s=0.2,
                                monitor_poll_s=0.1)
+        if fault_spec:
+            configure_faults(fault_spec)   # after connect: clean per-peer
+                                           # RPC call-index spaces
 
         # role-utilization sampler: decode occupancy vs prefill busy
         util = {"decode": [], "prefill": []}
@@ -1270,7 +1294,10 @@ def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
         wall = time.perf_counter() - t0
         stop.set()
         sampler.join(timeout=2.0)
+        stream_stats = dict(router.last_stream_stats or {})
     finally:
+        if fault_spec:
+            configure_faults("")
         if router is not None:
             router.shutdown(drain=False)
         for a in agents.values():
@@ -1318,6 +1345,13 @@ def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
         "kv_transfer_ms_p99": round(hist_quantile(kvd, 0.99), 3),
         "kv_transfer_mib": round(
             s1["fleet_kv_transfer_bytes"] / (1 << 20), 3),
+        "kv_chunks_streamed": s1["fleet_kv_chunks_streamed"],
+        "kv_resume_tails": s1["fleet_kv_resume_tails"],
+        "rpc_retries": s1["rpc_retries"],
+        "last_stream_first_block_ms": None
+        if stream_stats.get("first_block_ms") is None
+        else round(stream_stats["first_block_ms"], 3),
+        "last_stream_chunks": stream_stats.get("chunks"),
         "first_token_ms_p50": round(float(np.percentile(ftl, 50)), 2)
         if ftl.size else None,
         "first_token_ms_p99": round(float(np.percentile(ftl, 99)), 2)
@@ -1328,6 +1362,114 @@ def _fleet_burst(cfg, params, rng, *, n_req, max_new, lose_host, job):
             float(np.mean(util["prefill"])), 3) if util["prefill"] else 0.0,
         "rpc_calls": s1["rpc_calls"],
         "wall_s": round(wall, 2),
+    }
+
+
+def _fleet_resume_leg(cfg, params, rng):
+    """ISSUE 20 chaos leg: prefill-host death MID-KV-stream. A 2-host
+    fleet (prefill0 + decode0) streams a long prompt's KV blocks in
+    2-block chunks; after the first chunk lands, every further
+    ``export_range`` to the prefill host is blackholed (``rpc_drop``
+    with an unspendable budget — the wire signature of the host dying
+    mid-transfer). The decode replica must keep the received prefix and
+    locally prefill only the missing tail (``fleet_kv_resume_tails``),
+    token-identical to a monolithic oracle — greedy AND sampled."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed.elastic import FileKVStore
+    from paddle_tpu.resilience.faults import configure_faults
+    from paddle_tpu.serving import InferenceEngine
+    from paddle_tpu.serving.engine import GenerationRequest
+    from paddle_tpu.serving.pod import HostAgent, connect_fleet
+
+    def factory():
+        return InferenceEngine(cfg, params, n_slots=4, paged=True,
+                               block_size=16, n_blocks=129,
+                               prefill_chunk=64, prefix_cache=True,
+                               seed=0)
+
+    max_new = 12
+    out = {}
+    for mode, kw in (("greedy", {}),
+                     ("sampled", {"temperature": 0.7, "top_k": 5})):
+        prompt = rng.integers(0, cfg.vocab_size, 120).astype(np.int32)
+        root = tempfile.mkdtemp(prefix="fleet_resume_")
+        agents, router = {}, None
+        r0 = c0 = 0
+        try:
+            store = FileKVStore(root)
+            for host, role in (("prefill0", "prefill"),
+                               ("decode0", "decode")):
+                agents[host] = HostAgent(store, f"resume_{mode}", host,
+                                         factory, role=role,
+                                         heartbeat_s=0.1)
+            router = connect_fleet(store, f"resume_{mode}", min_hosts=2,
+                                   registry_ttl=0.9, rpc_timeout=60.0,
+                                   poll_s=0.2, monitor_poll_s=0.1,
+                                   kv_chunk_blocks=2)
+            # warm the whole disagg path (prefill jit, export, splice)
+            # faults-off, so the measured stream's FIRST export_range
+            # returns a chunk instead of an empty compile-stalled poll
+            # — the fault targets call indices, which must line up
+            warm = rng.integers(0, cfg.vocab_size, 120).astype(np.int32)
+            router.submit(warm, max_new_tokens=2).result(timeout=240)
+            r0 = monitor.stat_get("fleet_kv_resume_tails")
+            c0 = monitor.stat_get("fleet_kv_chunks_streamed")
+            # router->prefill0 call-index space: 1 = prefill_start,
+            # 2 = first export_range (ships chunk 1), 3+ = blackholed
+            configure_faults("rpc_drop@call=3:method=export_range:"
+                             "host=prefill0:repeat=1000")
+            req = router.submit(prompt, max_new_tokens=max_new, **kw)
+            toks = req.result(timeout=240)
+            stream = dict(router.last_stream_stats or {})
+        finally:
+            configure_faults("")
+            if router is not None:
+                router.shutdown(drain=False)
+            for a in agents.values():
+                try:
+                    a.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            shutil.rmtree(root, ignore_errors=True)
+        # sampled output is a pure function of (seed, rid): replay the
+        # fleet's actual rid on a monolithic oracle, as the identity
+        # contract defines it
+        oracle = factory()
+        try:
+            if kw:
+                o = GenerationRequest(prompt, max_new, kw["temperature"],
+                                      kw["top_k"], 1.0, None, None)
+                o.rid = req.rid
+                oracle.adopt_request(o)
+                expected = o.result(timeout=120)
+            else:
+                expected = oracle.generate(prompt, max_new_tokens=max_new)
+        finally:
+            oracle.shutdown(drain=False)
+        resumes = monitor.stat_get("fleet_kv_resume_tails") - r0
+        out[mode] = {
+            # the gate is identity AND an actual mid-stream resume — a
+            # direct-fallback run would be identical but prove nothing
+            "identity": 1.0 if toks == expected and resumes >= 1
+            else 0.0,
+            "token_identical": toks == expected,
+            "resume_tails": resumes,
+            "chunks_before_death":
+                monitor.stat_get("fleet_kv_chunks_streamed") - c0,
+            "acked_tokens": stream.get("acked_tokens"),
+            "target_tokens": stream.get("target_tokens"),
+        }
+    return {
+        "identity": min(out["greedy"]["identity"],
+                        out["sampled"]["identity"]),
+        "greedy": out["greedy"], "sampled": out["sampled"],
+        "note": "prefill0 blackholed after the first 2-block KV chunk; "
+                "decode keeps the received prefix and locally prefills "
+                "the missing tail — gated token-identical vs a "
+                "monolithic oracle, greedy and sampled (rid-replayed)",
     }
 
 
@@ -1345,12 +1487,75 @@ def bench_serving_fleet(on_accel):
 
     from paddle_tpu.models import gpt_init, gpt_tiny
 
+    from paddle_tpu.serving import InferenceEngine
+
     cfg = gpt_tiny(seq_len=256,
                    dtype=jnp.bfloat16 if on_accel else jnp.float32)
     params = gpt_init(cfg, seed=0)
     rng = np.random.default_rng(1901)
     leg = _fleet_burst(cfg, params, rng, n_req=12, max_new=16,
                        lose_host=True, job="bench_fleet")
+
+    # ISSUE 20: streamed first-block latency vs whole-prefix
+    # stop-and-copy, both measured from COLD prefill start on the same
+    # 240-token prompt — chunks ship while the next chunk computes, so
+    # the first spliceable block lands after ONE prefill chunk while a
+    # stop-and-copy export waits for all 15 (prefill_chunk=16 keeps
+    # the per-chunk cost well above timer noise on a warm engine)
+    def eng():
+        return InferenceEngine(cfg, params, n_slots=4, paged=True,
+                               block_size=16, n_blocks=129,
+                               prefill_chunk=16, prefix_cache=True,
+                               seed=0)
+
+    p_warm = rng.integers(0, cfg.vocab_size, 240).astype(np.int32)
+    p = rng.integers(0, cfg.vocab_size, 240).astype(np.int32)
+    src_a, dst_a, src_b, dst_b = eng(), eng(), eng(), eng()
+    first_block_ms = stop_copy_ms = None
+    try:
+        # warmup round (p_warm): amortize per-engine jit compile of the
+        # prefill / export / splice paths so the measured round compares
+        # transfer strategies, not compile noise
+        src_b.warm_prefix(p_warm).result(timeout=240)
+        w = src_b.export_kv_range(p_warm, start_block=0, max_blocks=1)
+        dst_b.import_kv_chunk(p_warm, w["kb"], w["vb"],
+                              int(w["start_block"]),
+                              int(w["covered_tokens"]))
+        src_a.warm_prefix(p_warm).result(timeout=240)
+        w = src_a.export_kv_prefix(p_warm)
+        dst_a.import_kv_prefix(p_warm, w["kb"], w["vb"],
+                               w["matched_len"])
+        # measured round (p): both paths from COLD prefill start
+        t0 = time.perf_counter()
+        wreq = src_b.warm_prefix(p)    # NON-blocking: chunked prefill
+        deadline = t0 + 240            # computes while we stream
+        while time.perf_counter() < deadline:
+            exp1 = src_b.export_kv_range(p, start_block=0, max_blocks=1)
+            if exp1["n_blocks"] > 0:
+                dst_b.import_kv_chunk(p, exp1["kb"], exp1["vb"],
+                                      int(exp1["start_block"]),
+                                      int(exp1["covered_tokens"]))
+                first_block_ms = (time.perf_counter() - t0) * 1e3
+                break
+            time.sleep(0.002)
+        wreq.result(timeout=240)       # quiesce: src_b's tail prefill
+        t0 = time.perf_counter()       # must not tax the stop-copy leg
+        src_a.warm_prefix(p).result(timeout=240)   # the WHOLE prefill
+        exp = src_a.export_kv_prefix(p)
+        dst_a.import_kv_prefix(p, exp["kb"], exp["vb"],
+                               exp["matched_len"])
+        stop_copy_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        for e in (src_a, dst_a, src_b, dst_b):
+            e.shutdown(drain=False)
+    leg["kv_first_block_ms"] = None if first_block_ms is None \
+        else round(first_block_ms, 3)
+    leg["kv_stop_copy_ms"] = None if stop_copy_ms is None \
+        else round(stop_copy_ms, 3)
+    leg["kv_first_block_lt_stop_copy"] = (
+        first_block_ms is not None and stop_copy_ms is not None
+        and first_block_ms < stop_copy_ms)
+
     leg["value"] = leg["identity"]
     leg["unit"] = "fleet token-identity under host loss (1.0 = exact)"
     leg["note"] = (
@@ -1360,7 +1565,10 @@ def bench_serving_fleet(on_accel):
         "prefill host and stream KV blocks to the placed decode "
         "replica; one decode host is killed abruptly mid-burst — its "
         "open streams reroute via token-replay failover; identity = "
-        "every stream token-equal to one monolithic engine")
+        "every stream token-equal to one monolithic engine; "
+        "kv_first_block_ms (cold prefill start -> first streamed block "
+        "spliced) vs kv_stop_copy_ms (cold start -> whole-prefix "
+        "export+import) on the same 240-token prompt")
     return leg
 
 

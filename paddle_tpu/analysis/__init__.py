@@ -64,6 +64,13 @@ entries carrying a fingerprint AND a reason):
   (flight-recorder) traces that are read when something already went
   wrong. Use the ``monitor.trace.span()``/``RecordEvent`` context
   managers, or close in a ``finally:``.
+- **GL012 network-I/O hygiene** (ISSUE 20) — ``socket`` send/recv/
+  connect on a function-local socket with no explicit timeout (a dead
+  peer then parks the thread forever, breaking the fleet's "failure =
+  exception, not hang" contract), and blocking RPC/frame calls issued
+  lexically inside a ``with <lock/cv>:`` block (every thread needing
+  that lock waits out the full network timeout — check state out under
+  the lock, do I/O outside it).
 
 Runtime sanitizers (``FLAGS_sanitize=1``; default 0 is pinned
 bit-for-bit on the fast-step trajectory — the flag-off cost is one list

@@ -53,6 +53,9 @@ RULE_DOCS = {
     "GL011": "span opened imperatively (add_begin/begin) without a "
              "guaranteed exit on exception paths — close in a finally: "
              "or use the span()/RecordEvent context manager",
+    "GL012": "network I/O hygiene: socket send/recv/connect without an "
+             "explicit timeout, or a blocking RPC/frame call issued "
+             "while holding a lock/condition variable",
 }
 
 
@@ -381,9 +384,10 @@ def build_project(paths: Iterable[str], root: Optional[str] = None
 
 
 def _default_rules():
-    from . import hotpath, invariants, races, spans
+    from . import hotpath, invariants, netguard, races, spans
 
-    return [hotpath.check, races.check, invariants.check, spans.check]
+    return [hotpath.check, races.check, invariants.check, spans.check,
+            netguard.check]
 
 
 ALL_RULES = tuple(RULE_DOCS)

@@ -1,0 +1,170 @@
+"""GL012 — network I/O hygiene (ISSUE 20).
+
+The fleet RPC layer's contract is "failure = exception, not hang", and
+two syntactic mistakes quietly break it:
+
+- **Untimed socket I/O** — ``socket.create_connection(addr)`` without a
+  ``timeout=``, or a function-local ``socket.socket()`` driven through
+  ``recv``/``send``/``sendall``/``connect``/``accept`` with no
+  ``settimeout`` in the same function. A dead peer then parks the
+  calling thread forever — a pump thread, a monitor, or the scheduler.
+  (Listeners created in one function and accepted in another are NOT
+  flagged: a dedicated accept thread blocking is the design.)
+- **Blocking RPC under a lock** — an ``RpcClient.call``/frame send/recv
+  issued lexically inside a ``with <lock/cv>:`` block. Every other
+  thread needing that lock (the router placing requests, the supervisor
+  scanning replicas) then waits out the full network timeout; under a
+  partition that is seconds of fleet-wide head-of-line blocking. The
+  module locking rules (pod.py's GL003 note) require checking state out
+  under the lock and doing I/O outside it.
+
+Both are flagged per call site with stable fingerprints (no line
+numbers). The checker is purely lexical within each function — it does
+not follow calls — so helpers that RECEIVE a socket as a parameter are
+the caller's responsibility (the caller created it and set the timeout).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .lint import Finding, Project
+
+__all__ = ["check"]
+
+# blocking primitives on a socket object
+_BLOCKING_SOCK = {"recv", "recv_into", "send", "sendall", "accept",
+                  "connect", "makefile"}
+# blocking RPC entry points (RpcClient.call + the frame helpers)
+_RPC_METHODS = {"call"}
+_RPC_HELPERS = {"_recv_frame", "_send_frame", "_recvall"}
+_LOCKY = ("lock", "cv", "cond", "mutex")
+
+
+def _locky_name(expr) -> Optional[str]:
+    """Lock-ish name when ``expr`` is a bare attr/name used as a `with`
+    context (``self._lock``, ``req._cv``) — calls (``span(...)``,
+    ``open(...)``) are context managers, not locks."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    low = name.lower()
+    return name if any(t in low for t in _LOCKY) else None
+
+
+def _is_socket_ctor(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "socket"
+            and isinstance(f.value, ast.Name) and f.value.id == "socket")
+
+
+def _is_create_connection(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "create_connection":
+        return isinstance(f.value, ast.Name) and f.value.id == "socket"
+    return isinstance(f, ast.Name) and f.id == "create_connection"
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return len(call.args) >= 2          # create_connection(addr, timeout)
+
+
+class _FuncScan(ast.NodeVisitor):
+    """One function body: socket locals, settimeout coverage, lock depth
+    at every call site. Nested defs are scanned separately (their lock
+    context is their own — a closure runs on whatever thread calls it)."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.sock_locals: dict = {}     # name -> line created
+        self.timed: set = set()         # names with a settimeout call
+        self.calls: List[tuple] = []    # (node, lock_stack_tuple)
+        self._locks: List[str] = []
+        self._root = True
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — ast API
+        if self._root:
+            self._root = False
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):         # noqa: N802
+        names = [n for item in node.items
+                 if (n := _locky_name(item.context_expr)) is not None]
+        self._locks.extend(names)
+        self.generic_visit(node)
+        if names:
+            del self._locks[-len(names):]
+
+    def visit_Assign(self, node):       # noqa: N802
+        v = node.value
+        if isinstance(v, ast.Call) and (_is_socket_ctor(v)
+                                        or _is_create_connection(v)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if _is_create_connection(v) and _has_timeout_kw(v):
+                        self.timed.add(t.id)
+                    self.sock_locals[t.id] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Call(self, node):         # noqa: N802
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "settimeout" \
+                and isinstance(f.value, ast.Name):
+            self.timed.add(f.value.id)
+        self.calls.append((node, tuple(self._locks)))
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for (relpath, qual), fi in sorted(project.functions.items()):
+        scan = _FuncScan(fi.node)
+        scan.visit(fi.node)
+        for node, locks in scan.calls:
+            f = node.func
+            # -- untimed create_connection used inline ------------------
+            if isinstance(node, ast.Call) and _is_create_connection(node) \
+                    and not _has_timeout_kw(node):
+                findings.append(Finding(
+                    "GL012", relpath, node.lineno, qual,
+                    "untimed:create_connection",
+                    "socket.create_connection without an explicit "
+                    "timeout= — a dead peer hangs this thread forever"))
+            # -- blocking primitive on an untimed local socket ----------
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _BLOCKING_SOCK \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in scan.sock_locals \
+                    and f.value.id not in scan.timed:
+                findings.append(Finding(
+                    "GL012", relpath, node.lineno, qual,
+                    f"untimed:{f.value.id}.{f.attr}",
+                    f"blocking {f.value.id}.{f.attr}() on a socket "
+                    "created in this function with no settimeout — "
+                    "unbounded wait on a dead peer"))
+            # -- blocking RPC while holding a lock ----------------------
+            if not locks:
+                continue
+            rpc_name = None
+            if isinstance(f, ast.Attribute) and f.attr in _RPC_METHODS:
+                rpc_name = f.attr
+            elif isinstance(f, ast.Name) and f.id in _RPC_HELPERS:
+                rpc_name = f.id
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in _BLOCKING_SOCK:
+                rpc_name = f.attr
+            if rpc_name is not None:
+                findings.append(Finding(
+                    "GL012", relpath, node.lineno, qual,
+                    f"rpc_under_lock:{locks[-1]}:{rpc_name}",
+                    f"blocking network call {rpc_name}() while holding "
+                    f"{locks[-1]} — every thread needing that lock "
+                    "waits out the full network timeout"))
+    return findings

@@ -378,6 +378,13 @@ DEFAULT_STATS = (
     "fleet_prewarms",         # replicas pre-warmed by the arrival-rate forecaster
     "rpc_calls",              # RPC round trips issued by remote replica proxies
     "rpc_errors",             # RPC round trips that failed (transport or remote)
+    # fleet network fault tolerance (ISSUE 20)
+    "rpc_retries",            # idempotent RPC calls re-sent after a transport error
+    "rpc_breaker_state",      # gauge: per-peer circuit breakers currently OPEN
+    "rpc_deadline_sheds",     # frames shed by the receiver: deadline already expired
+    "fleet_kv_chunks_streamed",  # KV chunks shipped by the resumable streaming path
+    "fleet_kv_resume_tails",  # decode-side local tail prefills after a mid-stream loss
+    "flight_collects",        # fleet-wide flight-recorder collection sweeps
 )
 
 for _n in DEFAULT_STATS:
@@ -476,6 +483,12 @@ FLEET_REROUTES = _registry.get_stat("fleet_reroutes")
 FLEET_PREWARMS = _registry.get_stat("fleet_prewarms")
 RPC_CALLS = _registry.get_stat("rpc_calls")
 RPC_ERRORS = _registry.get_stat("rpc_errors")
+RPC_RETRIES = _registry.get_stat("rpc_retries")
+RPC_BREAKER_STATE = _registry.get_stat("rpc_breaker_state")
+RPC_DEADLINE_SHEDS = _registry.get_stat("rpc_deadline_sheds")
+FLEET_KV_CHUNKS_STREAMED = _registry.get_stat("fleet_kv_chunks_streamed")
+FLEET_KV_RESUME_TAILS = _registry.get_stat("fleet_kv_resume_tails")
+FLIGHT_COLLECTS = _registry.get_stat("flight_collects")
 
 
 # -- pre-registered latency histograms (ISSUE 15) ---------------------------

@@ -32,6 +32,23 @@ end's streaming-connection index::
     FLAGS_fault_inject="replica_crash@step=30:replica=0,slow_tick@step=5:secs=0.2:repeat=3"
     FLAGS_fault_inject="conn_drop@step=2"
 
+Fleet network chaos (ISSUE 20) adds four kinds keyed by the RPC CALL
+index — each :class:`~paddle_tpu.serving.rpc.RpcClient` owns a private
+per-peer call counter (bumped only while faults are armed, so flag-unset
+stays bit-identical), and the client hook claims these kinds against it.
+``rpc_drop`` kills the socket before the request frame leaves (a
+mid-call transport death — the retry/breaker path), ``rpc_delay`` makes
+the RECEIVER sleep ``secs`` before dispatch (the deadline-shed path),
+``rpc_corrupt`` flips a byte inside the frame (blob when the call
+carries a crc, JSON header otherwise — crc/torn-frame paths fire), and
+``net_partition`` opens a both-directions block between two host groups
+(``hosts=A|B``; group members joined with ``+``) for ``secs``, consulted
+by every client before dialing::
+
+    FLAGS_fault_inject="rpc_drop@call=3:method=export_range:host=h0:repeat=99"
+    FLAGS_fault_inject="rpc_delay@call=1:secs=0.5,rpc_corrupt@call=2"
+    FLAGS_fault_inject="net_partition@step=0:secs=1:hosts=router|h2"
+
 Lifecycle chaos (ISSUE 14) adds two kinds keyed by the
 :class:`~paddle_tpu.serving.lifecycle.ReplicaSupervisor`'s OWN
 ``restart=`` index spaces (spawn attempts / rejoins — never a train
@@ -85,6 +102,18 @@ replica_flap   the freshly-rejoined replica crashes at     serving/lifecycle.py
                chaos that drives the quarantine ladder)
 input_stall    ``time.sleep(secs)`` in the prefetcher      io/prefetch.py
 ckpt_io_error  raises ``OSError`` during checkpoint save   framework/checkpoint.py
+rpc_drop       client socket dies before the frame is      serving/rpc.py
+               sent (transport death; keyed by the
+               client's per-peer CALL index; ``method=``
+               / ``host=`` filter which calls qualify)
+rpc_delay      receiver sleeps ``secs`` before dispatch    serving/rpc.py
+               (drives the frame-header deadline shed)
+rpc_corrupt    one byte of the frame is flipped in         serving/rpc.py
+               flight (blob if the call carries a crc,
+               JSON header otherwise)
+net_partition  both directions blocked between host        serving/rpc.py
+               groups ``hosts=A|B`` for ``secs``
+               (members joined with ``+``)
 =============  ==========================================  ===============
 
 Train-step hooks live in ``parallel/train_step.py``,
@@ -111,7 +140,8 @@ from ..monitor import stats as _mstats
 
 __all__ = ["FaultSpec", "FaultRegistry", "InjectedCrash", "FAULTS",
            "ENABLED", "configure_faults", "begin_kv_partition",
-           "kv_partition_active"]
+           "kv_partition_active", "begin_net_partition",
+           "net_partition_active", "net_partition_blocks"]
 
 # fast-path gate: hook sites read ENABLED[0] before touching the registry
 ENABLED = [False]
@@ -132,10 +162,23 @@ _CONN_KINDS = ("conn_drop",)
 # index — both counters the supervisor owns, so lifecycle chaos never
 # consumes a train-step budget and rollback replay stays clean
 _RESTART_KINDS = ("spawn_fail", "replica_flap")
+# RPC-CALL-keyed kinds (serving/rpc.py): each RpcClient's private
+# per-peer call counter is the index space, so network chaos never
+# consumes a train-step/tick/restart budget and replays stay clean
+_RPC_KINDS = ("rpc_drop", "rpc_delay", "rpc_corrupt")
+# net_partition ALSO fires off a client call index (``step=N`` counts
+# RPC calls here, like conn_drop's "step" counts connections), but its
+# effect is a module-level window every client consults
+_NET_KINDS = ("net_partition",)
 
 # monotonic deadline of the currently-injected KV-store partition window
 # (0.0 = none). FileKVStore consults kv_partition_active() on every op.
 _PARTITION_UNTIL = [0.0]
+
+# injected NETWORK partition: [deadline, (frozenset_a, frozenset_b)].
+# RpcClient consults net_partition_blocks(local, peer) before dialing —
+# a synchronous RPC blocked at the caller blocks both directions.
+_NET_PARTITION = [0.0, ()]
 
 
 def begin_kv_partition(secs: float) -> None:
@@ -148,6 +191,27 @@ def kv_partition_active() -> bool:
     return ENABLED[0] and time.monotonic() < _PARTITION_UNTIL[0]
 
 
+def begin_net_partition(secs: float, groups) -> None:
+    """Open an injected network partition window between two host
+    groups: every RPC between a host in one group and a host in the
+    other fails fast (both directions) until it closes."""
+    _NET_PARTITION[1] = tuple(frozenset(str(h) for h in g) for g in groups)
+    _NET_PARTITION[0] = time.monotonic() + float(secs)
+
+
+def net_partition_active() -> bool:
+    return ENABLED[0] and time.monotonic() < _NET_PARTITION[0]
+
+
+def net_partition_blocks(a: str, b: str) -> bool:
+    """True when hosts ``a`` and ``b`` sit on opposite sides of the
+    currently-open injected partition."""
+    if not net_partition_active() or len(_NET_PARTITION[1]) != 2:
+        return False
+    ga, gb = _NET_PARTITION[1]
+    return (a in ga and b in gb) or (a in gb and b in ga)
+
+
 class InjectedCrash(RuntimeError):
     """Raised by a ``crash@step=N`` fault — stands in for a worker dying
     mid-step (segfault, OOM-kill, device wedging)."""
@@ -156,20 +220,24 @@ class InjectedCrash(RuntimeError):
 class FaultSpec:
     """One parsed fault clause."""
 
-    __slots__ = ("kind", "step", "p", "restart", "repeat", "secs", "seed",
-                 "host", "replica", "remaining", "_rng")
+    __slots__ = ("kind", "step", "p", "restart", "call", "repeat", "secs",
+                 "seed", "host", "replica", "method", "hosts", "remaining",
+                 "_rng")
 
     def __init__(self, kind: str, step: Optional[int] = None,
                  p: Optional[float] = None, repeat: Optional[int] = None,
                  secs: float = 1.0, seed: int = 0,
                  host: Optional[str] = None,
                  replica: Optional[int] = None,
-                 restart: Optional[int] = None):
-        triggers = sum(t is not None for t in (step, p, restart))
+                 restart: Optional[int] = None,
+                 call: Optional[int] = None,
+                 method: Optional[str] = None,
+                 hosts: Optional[str] = None):
+        triggers = sum(t is not None for t in (step, p, restart, call))
         if triggers != 1:
             raise ValueError(
-                f"fault {kind!r} needs exactly one trigger: step=N, p=F or "
-                "restart=N")
+                f"fault {kind!r} needs exactly one trigger: step=N, p=F, "
+                "restart=N or call=N")
         if restart is not None and kind not in _RESTART_KINDS:
             raise ValueError(
                 f"restart= only triggers lifecycle kinds {_RESTART_KINDS}, "
@@ -177,14 +245,35 @@ class FaultSpec:
         if kind in _RESTART_KINDS and restart is None:
             raise ValueError(f"{kind} needs restart=N (which supervisor "
                              "spawn/rejoin index fires it)")
+        if call is not None and kind not in _RPC_KINDS:
+            raise ValueError(
+                f"call= only triggers rpc kinds {_RPC_KINDS}, not {kind!r}")
+        if kind in _RPC_KINDS and call is None:
+            raise ValueError(f"{kind} needs call=N (which per-peer RPC "
+                             "call index fires it)")
         if kind == "host_loss" and not host:
             raise ValueError("host_loss needs host=H (which simulated host "
                              "dies)")
+        if kind in _NET_KINDS:
+            if step is None:
+                raise ValueError("net_partition needs step=N (the RPC call "
+                                 "index that opens the window)")
+            if not hosts or "|" not in str(hosts):
+                raise ValueError("net_partition needs hosts=A|B (two host "
+                                 "groups; members joined with '+')")
+        elif hosts is not None:
+            raise ValueError(f"hosts= only applies to net_partition, "
+                             f"not {kind!r}")
         self.kind = kind
         self.step = step
         self.p = p
         self.restart = None if restart is None else int(restart)
+        self.call = None if call is None else int(call)
         self.host = host
+        self.method = method
+        self.hosts = None if hosts is None else tuple(
+            frozenset(h for h in g.split("+") if h)
+            for g in str(hosts).split("|"))
         self.replica = None if replica is None else int(replica)
         # step faults default to firing once; p faults to unlimited
         self.repeat = repeat if repeat is not None else (1 if p is None
@@ -206,6 +295,8 @@ class FaultSpec:
             trig = f"step={self.step}"
         elif self.restart is not None:
             trig = f"restart={self.restart}"
+        elif self.call is not None:
+            trig = f"call={self.call}"
         else:
             trig = f"p={self.p}"
         return (f"FaultSpec({self.kind}@{trig}, repeat={self.repeat}, "
@@ -239,7 +330,9 @@ def parse_spec(text: str) -> List[FaultSpec]:
             seed=int(kw.get("seed", 0)),
             host=kw.get("host"),
             replica=int(kw["replica"]) if "replica" in kw else None,
-            restart=int(kw["restart"]) if "restart" in kw else None))
+            restart=int(kw["restart"]) if "restart" in kw else None,
+            call=int(kw["call"]) if "call" in kw else None,
+            method=kw.get("method"), hosts=kw.get("hosts")))
     return out
 
 
@@ -293,6 +386,8 @@ class FaultRegistry:
         self._cur_rid = None
         self._rid_fired = {}
         _PARTITION_UNTIL[0] = 0.0
+        _NET_PARTITION[0] = 0.0
+        _NET_PARTITION[1] = ()
         ENABLED[0] = bool(self.faults)
 
     # -- evaluation ---------------------------------------------------------
@@ -366,6 +461,36 @@ class FaultRegistry:
                 f.consume()
                 return f
         return None
+
+    def take_rpc(self, host: str, method: str, index: int
+                 ) -> Dict[str, FaultSpec]:
+        """Claim every RPC-call-keyed fault due at one client call.
+
+        ``index`` is the calling RpcClient's private per-peer call
+        counter — its own index space, so network chaos never consumes a
+        step/tick/restart budget. ``host``/``method`` filters in the
+        spec (``host=H`` = the PEER host, ``method=M``) restrict which
+        calls a clause can claim. A due ``net_partition`` is consumed
+        here too: it opens the module-level window
+        (:func:`net_partition_blocks`) rather than riding the returned
+        dict."""
+        fired: Dict[str, FaultSpec] = {}
+        for f in self.faults:
+            if f.spent():
+                continue
+            if f.kind in _RPC_KINDS:
+                if f.host is not None and f.host != host:
+                    continue
+                if f.method is not None and f.method != method:
+                    continue
+                if index >= f.call and f.kind not in fired:
+                    f.consume()
+                    fired[f.kind] = f
+            elif f.kind in _NET_KINDS and f.step is not None \
+                    and index >= f.step:
+                f.consume()
+                begin_net_partition(f.secs, f.hosts)
+        return fired
 
     def take_conn(self, index: int) -> Optional[FaultSpec]:
         """Claim a connection-indexed fault (conn_drop) for the front

@@ -1307,6 +1307,144 @@ class InferenceEngine:
 
         return self.run_on_scheduler(_import, timeout=timeout)
 
+    def export_kv_range(self, tokens, start_block: int,
+                        max_blocks: Optional[int] = None,
+                        timeout: Optional[float] = None):
+        """Incremental slice of :meth:`export_kv_prefix` for resumable
+        chunked streaming (ISSUE 20): export only the cached blocks from
+        ``start_block`` onward, so finished prefill chunks ship while
+        the next chunk computes. While the prefill is still running only
+        FULL blocks are exported (a partial tail block would be
+        re-written by the next chunk); once the whole prefix is cached
+        (``done=True``) the partial tail block ships too. Returns a dict
+        with ``matched_len``/``start_block``/``n_blocks``/``done`` plus
+        host-numpy ``kb``/``vb`` (possibly 0-length — poll again)."""
+        if self._prefix is None:
+            raise RuntimeError("export_kv_range needs prefix_cache=True")
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        start = int(start_block)
+
+        def _export(eng):
+            m_len, blocks, shard = 0, [], 0
+            for d in range(eng.cache.shards):
+                m, bl = eng._prefix.match(d, toks)
+                if m > m_len:
+                    m_len, blocks, shard = m, bl, d
+            bs = int(eng.block_size)
+            # match() caps at len-1 by design, so "whole prefix cached"
+            # is m_len >= size-1 — the same terminal every splice uses
+            done = m_len >= toks.size - 1
+            avail = len(blocks) if done else m_len // bs
+            if not done:
+                # mid-prefill visibility: the radix insert only happens
+                # when the WHOLE prompt is cached, so a slot still
+                # prefilling this prompt is invisible to match() — scan
+                # live slots and ship their finished FULL blocks while
+                # the next chunk computes (the partial tail rides the
+                # radix entry once ``done`` flips). Safe: this runs on
+                # the scheduler thread between ticks, and a slot's
+                # prompt blocks are never rewritten once filled.
+                for slot in range(eng.n_slots):
+                    st = eng._slots[slot]
+                    if st is None:
+                        continue
+                    pr = np.asarray(st.req.prompt, np.int32).reshape(-1)
+                    n_full = min(int(st.length), toks.size) // bs
+                    if (n_full > avail and pr.size >= toks.size
+                            and np.array_equal(pr[:toks.size], toks)):
+                        tbl = eng.cache.block_tables[slot]
+                        blocks = [int(b) for b in tbl[:n_full]]
+                        avail, m_len = n_full, n_full * bs
+            lo = min(start, avail)
+            hi = avail if max_blocks is None \
+                else min(avail, lo + int(max_blocks))
+            out = {"matched_len": int(m_len), "start_block": int(lo),
+                   "n_blocks": int(hi - lo), "block_size": bs,
+                   "done": bool(done),
+                   # prefix tokens covered by blocks [0, hi) — the
+                   # n_tokens a receiver passes to import_kv_chunk
+                   "covered_tokens": int(min(m_len, hi * bs))}
+            if hi > lo:
+                idx = jnp.asarray(np.asarray(blocks[lo:hi], np.int32))
+                out["kb"] = np.asarray(jax.device_get(eng.cache.kb[idx]))
+                out["vb"] = np.asarray(jax.device_get(eng.cache.vb[idx]))
+            return out
+
+        return self.run_on_scheduler(_export, timeout=timeout)
+
+    def import_kv_chunk(self, tokens, kb, vb, start_block: int,
+                        n_tokens: int,
+                        timeout: Optional[float] = None) -> int:
+        """Splice ONE streamed chunk (an :meth:`export_kv_range` slice)
+        into the pool + radix tree, extending a prefix whose earlier
+        blocks were imported by previous chunks. Returns the receiver's
+        high-water mark — the number of prefix tokens now cached — which
+        is the ack the sender resumes from: a chunk that arrives out of
+        order (its ``start_block`` is past what this engine holds) is
+        dropped and the current mark returned, so a lost frame rewinds
+        the stream instead of corrupting it. Idempotent on re-delivery."""
+        if self._prefix is None:
+            raise RuntimeError("import_kv_chunk needs prefix_cache=True")
+        n_tok = int(n_tokens)
+        toks = np.asarray(tokens, np.int32).reshape(-1)[:n_tok]
+        kb = np.asarray(kb)
+        vb = np.asarray(vb)
+        n = int(kb.shape[0])
+        start = int(start_block)
+        if toks.size != n_tok or n_tok <= 0:
+            raise ValueError(f"import_kv_chunk: prompt carries {toks.size} "
+                             f"tokens, chunk claims {n_tok}")
+        if n == 0 or kb.shape != vb.shape \
+                or start + n != self.cache.blocks_for(n_tok):
+            raise ValueError(
+                f"import_kv_chunk: {n} blocks at {start} do not land on "
+                f"{n_tok} tokens at block_size {self.block_size}")
+
+        def _import(eng):
+            bs = int(eng.block_size)
+            # the shard holding the deepest copy of this prefix is the
+            # stream target; its peek is the ack high-water mark
+            best_d, have = 0, -1
+            for d in range(eng.cache.shards):
+                p = eng._prefix.peek(d, toks)
+                if p > have:
+                    best_d, have = d, p
+            if have >= n_tok:
+                return int(have)           # idempotent re-delivery
+            if have < start * bs:
+                return int(have)           # gap: sender must rewind
+            _, ex_blocks = eng._prefix.match(best_d, toks)
+            room = (eng.cache.free_blocks_of(best_d)
+                    + eng._prefix.evictable_count(best_d))
+            if room < n:
+                return int(have)
+            short = n - eng.cache.free_blocks_of(best_d)
+            if short > 0 and eng._prefix.evict(best_d, short) < short:
+                return int(have)
+            blocks = []
+            for _ in range(n):
+                b = eng.cache.alloc_block(best_d)
+                if b is None:
+                    for bb in blocks:
+                        eng.cache.unref_block(bb)
+                    return int(have)
+                blocks.append(b)
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            dt = eng.cache.kb.dtype
+            eng.cache.kb = eng.cache.kb.at[idx].set(jnp.asarray(kb, dt))
+            eng.cache.vb = eng.cache.vb.at[idx].set(jnp.asarray(vb, dt))
+            # the first start blocks are the tree's own nodes from the
+            # previous chunks — insert() dedupes them by chunk key and
+            # only adopts (and refs) the new tail
+            eng._prefix.insert(best_d, toks,
+                               list(ex_blocks[:start]) + blocks)
+            for b in blocks:
+                eng.cache.unref_block(b)
+            eng.cache.update_gauges()
+            return int(eng._prefix.peek(best_d, toks))
+
+        return self.run_on_scheduler(_import, timeout=timeout)
+
     # -- health surface (EngineRouter / frontend readyz) ---------------------
     @property
     def alive(self) -> bool:

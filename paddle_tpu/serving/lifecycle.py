@@ -381,6 +381,12 @@ class ReplicaSupervisor:
         dump_flight(f"lifecycle_give_up_r{rid}",
                     extra={"replica": rid, "attempts": st.attempts,
                            "cause": str(st.cause)})
+        # fleet routers also pull every OTHER host's ring (ISSUE 20) —
+        # async, because this thread holds the supervisor cv and the
+        # collection does bounded-per-host RPC
+        collect = getattr(self.router, "collect_flight_async", None)
+        if callable(collect):
+            collect(f"give_up_r{rid}")
         self.router.fail_orphans(ReplicaFailed(
             f"replica {rid} gave up after {st.attempts} restart(s) "
             f"(max_restarts={self.max_restarts}; last cause: {st.cause})"))

@@ -59,21 +59,26 @@ from __future__ import annotations
 import collections
 import json
 import math
+import os
 import threading
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..monitor.flight import dump_flight, get_flight_recorder
 from ..monitor.stats import (FLEET_ARRIVAL_GAP_MS, FLEET_DIRECT_FALLBACKS,
-                             FLEET_HOSTS, FLEET_KV_EXPORTS,
-                             FLEET_KV_IMPORTS, FLEET_KV_TRANSFER_BYTES,
+                             FLEET_HOSTS, FLEET_KV_CHUNKS_STREAMED,
+                             FLEET_KV_EXPORTS, FLEET_KV_IMPORTS,
+                             FLEET_KV_RESUME_TAILS, FLEET_KV_TRANSFER_BYTES,
                              FLEET_KV_TRANSFER_MS, FLEET_PREFILL_ROUTED,
-                             FLEET_PREWARMS, FLEET_REPLICAS, FLEET_REROUTES)
+                             FLEET_PREWARMS, FLEET_REPLICAS, FLEET_REROUTES,
+                             FLIGHT_COLLECTS, stat_snapshot)
 from ..monitor.trace import emit_complete, recording
 from .engine import ERROR, LENGTH, GenerationRequest, QueueFull
 from .router import EngineRouter
-from .rpc import RpcClient, RpcError, RpcRemoteError, RpcServer
+from .rpc import (BREAKER_OPEN, CircuitBreaker, RetryPolicy, RpcClient,
+                  RpcError, RpcRemoteError, RpcServer)
 
 __all__ = ["FleetRegistry", "HostAgent", "RemoteReplica",
            "RemoteReplicaError", "FleetRouter", "FleetScheduler",
@@ -269,6 +274,10 @@ class HostAgent:
                 "warm": self._h_warm,
                 "prefill_export": self._h_prefill_export,
                 "import_kv": self._h_import_kv,
+                "prefill_start": self._h_prefill_start,
+                "export_range": self._h_export_range,
+                "import_chunk": self._h_import_chunk,
+                "collect_flight": self._h_collect_flight,
                 "ensure_replicas": self._h_ensure_replicas,
                 "evacuate": self._h_evacuate,
                 "fail_replica": self._h_fail_replica,
@@ -370,6 +379,70 @@ class HostAgent:
         if cached > 0:
             FLEET_KV_IMPORTS.add(1)
         return {"cached": int(cached)}
+
+    # -- resumable chunked KV streaming (ISSUE 20) ---------------------------
+    def _h_prefill_start(self, p, arrays):
+        """Kick off a NON-blocking radix warm of the prompt so finished
+        chunks can ship (``export_range``) while later chunks compute —
+        the overlap half of resumable streaming. Returns the stream
+        target (``len-1``, the splice cap) and what is already cached."""
+        eng = self._engine(p["idx"])
+        ids = np.asarray(arrays["prompt"], np.int32).reshape(-1)
+        if getattr(eng, "_prefix", None) is None:
+            raise RuntimeError("prefill streaming needs prefix_cache=True")
+        have = eng.run_on_scheduler(
+            lambda e: max(e._prefix.peek(d, ids)
+                          for d in range(e.cache.shards)))
+        if have < ids.size - 1:
+            eng.warm_prefix(ids)           # runs behind this reply
+        return {"target": int(ids.size - 1), "have": int(have)}
+
+    def _h_export_range(self, p, arrays):
+        """One stream chunk: blocks from ``start_block`` onward. Waits
+        server-side (bounded by ``wait_s``) for at least one new block so
+        the client polls the network, not the prefill — a slow chunk
+        costs one parked RPC, not a spin."""
+        eng = self._engine(p["idx"])
+        ids = np.asarray(arrays["prompt"], np.int32).reshape(-1)
+        start = int(p.get("start_block", 0))
+        max_blocks = p.get("max_blocks")
+        deadline = time.monotonic() + float(p.get("wait_s", 1.0))
+        while True:
+            exp = eng.export_kv_range(ids, start, max_blocks=max_blocks)
+            if exp["n_blocks"] > 0 or exp["done"] \
+                    or time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        meta = {k: exp[k] for k in ("matched_len", "start_block",
+                                    "n_blocks", "block_size", "done",
+                                    "covered_tokens")}
+        if exp["n_blocks"] <= 0:
+            return meta
+        FLEET_KV_EXPORTS.add(1)
+        return meta, {"kb": exp["kb"], "vb": exp["vb"]}
+
+    def _h_import_chunk(self, p, arrays):
+        """Splice one streamed chunk; the returned ``have`` is the ack
+        high-water mark the sender resumes from."""
+        eng = self._engine(p["idx"])
+        have = eng.import_kv_chunk(arrays["prompt"], arrays["kb"],
+                                   arrays["vb"], int(p["start_block"]),
+                                   int(p["n_tokens"]))
+        if have > 0:
+            FLEET_KV_IMPORTS.add(1)
+        return {"have": int(have)}
+
+    def _h_collect_flight(self, p, arrays):
+        """Ship this host's FlightRecorder ring + gauge snapshot to the
+        collecting router (fleet-wide post-mortem, ISSUE 20). Unarmed
+        hosts answer honestly instead of erroring — a gap in the merged
+        timeline, never a hang."""
+        rec = get_flight_recorder()
+        if rec is None:
+            return {"armed": False, "host": self.host, "pid": os.getpid()}
+        rec.note_gauges()
+        return {"armed": True, "host": self.host, "pid": rec.pid,
+                "events": rec.events(), "gauges": stat_snapshot()}
 
     def _h_ensure_replicas(self, p, arrays):
         """Pre-warm path: grow this host to ``n`` replicas (never
@@ -507,6 +580,12 @@ class RemoteReplica:
         except RpcRemoteError as e:
             if e.etype == "QueueFull":
                 raise QueueFull(str(e)) from e
+            raise
+        except RpcError as e:
+            # transport death mid-submit: this replica is unroutable
+            # until proven otherwise — mark it lost so open streams
+            # reroute and the router re-places the submit elsewhere
+            self._mark_lost(e)
             raise
         req.rid = int(res["rid"])
         req._failover = self.failover
@@ -694,6 +773,47 @@ class RemoteReplica:
             timeout=self._client.timeout + 60.0)
         return int(res.get("cached", 0))
 
+    def prefill_start(self, tokens, timeout=None) -> dict:
+        """Start a non-blocking remote radix warm for chunk streaming;
+        returns ``{"target", "have"}``."""
+        ids = np.asarray(tokens, np.int32).reshape(-1)
+        res, _ = self._client.call(
+            "prefill_start", {"idx": self.idx, "timeout": timeout},
+            {"prompt": ids}, timeout=self._client.timeout + 30.0)
+        return res
+
+    def export_kv_range(self, tokens, start_block: int, max_blocks=None,
+                        wait_s: float = 1.0, timeout=None) -> dict:
+        ids = np.asarray(tokens, np.int32).reshape(-1)
+        res, arrs = self._client.call(
+            "export_range", {"idx": self.idx,
+                             "start_block": int(start_block),
+                             "max_blocks": max_blocks,
+                             "wait_s": float(wait_s)},
+            {"prompt": ids},
+            timeout=(timeout or self._client.timeout) + float(wait_s))
+        out = dict(res)
+        if arrs:
+            out["kb"], out["vb"] = arrs["kb"], arrs["vb"]
+        return out
+
+    def import_kv_chunk(self, tokens, kb, vb, start_block: int,
+                        n_tokens: int, timeout=None) -> int:
+        """Chunk splice with blob crc armed — a corrupt-in-flight KV
+        chunk fails the call instead of caching wrong rows."""
+        ids = np.asarray(tokens, np.int32).reshape(-1)
+        res, _ = self._client.call(
+            "import_chunk", {"idx": self.idx,
+                             "start_block": int(start_block),
+                             "n_tokens": int(n_tokens)},
+            {"prompt": ids, "kb": np.asarray(kb), "vb": np.asarray(vb)},
+            timeout=(timeout or self._client.timeout) + 60.0, crc=True)
+        return int(res.get("have", 0))
+
+    def collect_flight(self, timeout: float = 2.0) -> dict:
+        res, _ = self._client.call("collect_flight", {}, timeout=timeout)
+        return res
+
     def evacuate(self) -> None:
         try:
             self._client.call("evacuate", {"idx": self.idx})
@@ -843,7 +963,8 @@ class FleetRouter(EngineRouter):
     def __init__(self, engines, prefill=None, registry: Optional[
             FleetRegistry] = None, host_conns: Optional[dict] = None,
             disagg_min_tokens: Optional[int] = None,
-            monitor_poll_s: float = 0.25, **kw):
+            monitor_poll_s: float = 0.25,
+            kv_chunk_blocks: Optional[int] = None, **kw):
         super().__init__(engines, **kw)
         self._prefill_pool: List[RemoteReplica] = list(prefill or [])
         self.registry = registry
@@ -855,11 +976,21 @@ class FleetRouter(EngineRouter):
         if disagg_min_tokens is None and self._prefill_pool:
             disagg_min_tokens = 2 * self._prefill_pool[0].block_size
         self._disagg_min = disagg_min_tokens
+        # cap blocks per streamed chunk (None = all available): smaller
+        # chunks start the decode splice sooner and bound per-frame size
+        self.kv_chunk_blocks = kv_chunk_blocks
         self.monitor_poll_s = float(monitor_poll_s)
         self._fleet_lock = threading.Lock()  # guards _hosts_known/_lost
         self._hosts_known: set = set()
         self._lost_hosts: set = set()
         self._members_sig = None           # last fleet.members span payload
+        # satellite 2: "registry unreachable" is NOT "hosts dead" — track
+        # the partition window so /readyz can report unknowable honestly
+        self._registry_down_t = 0.0
+        self._storm_latched = False        # breaker-storm collect episode
+        self._collect_seq = 0
+        self._collect_last_t = 0.0
+        self.last_stream_stats: Optional[dict] = None
         self._monitor_stop = threading.Event()
         self._monitor = None
         if registry is not None:
@@ -876,19 +1007,64 @@ class FleetRouter(EngineRouter):
             out[f"prefill/{j}"] = {
                 "host": pf.host, "role": pf.role,
                 "heartbeat_age_s": round(pf.heartbeat_age(), 3)}
+        with self._fleet_lock:
+            down_t = self._registry_down_t
+            lost = set(self._lost_hosts)
+        # satellite 2: per-member verdicts distinguish "host dead"
+        # (heartbeat stale while the registry answers) from "registry
+        # unreachable" (partition — nothing about hosts is knowable, so
+        # say that rather than marking the fleet down)
+        for m in out.values():
+            host = m.get("host")
+            if host is None:
+                m["status"] = "ok"         # in-process replica
+            elif down_t:
+                m["status"] = "unknowable"
+            elif host in lost:
+                m["status"] = "dead"
+            else:
+                m["status"] = "ok"
+        out["registry"] = {
+            "reachable": down_t == 0.0,
+            "unreachable_for_s": 0.0 if down_t == 0.0
+            else round(time.monotonic() - down_t, 3)}
         return out
 
     # -- host-loss monitor ---------------------------------------------------
     def _fleet_monitor(self) -> None:
         while not self._monitor_stop.wait(self.monitor_poll_s):
             self.fleet_scan()
+            self._check_breaker_storm()
+
+    def _check_breaker_storm(self) -> None:
+        """Half the fleet's breakers open at once is a NETWORK incident,
+        not a host incident — pull the black boxes while they're hot.
+        Episode-latched: one collection per storm, re-armed only after
+        the breakers recover."""
+        conns = self._host_conns
+        if not conns:
+            return
+        n_open = sum(1 for _, (c, _r) in conns.items()
+                     if c.breaker is not None
+                     and c.breaker.state == BREAKER_OPEN)
+        storm = n_open >= max(1, (len(conns) + 1) // 2)
+        if storm and not self._storm_latched:
+            self._storm_latched = True
+            self.collect_flight_async(f"breaker_storm_{n_open}open")
+        elif not storm:
+            self._storm_latched = False
 
     def fleet_scan(self) -> None:
         """One registry scan: detect lost/returned hosts and act."""
         try:
             alive = self.registry.alive()
         except OSError:
-            return                         # partition: no blind verdicts
+            with self._fleet_lock:         # partition: no blind verdicts,
+                if self._registry_down_t == 0.0:   # but note the window
+                    self._registry_down_t = time.monotonic()
+            return
+        with self._fleet_lock:
+            self._registry_down_t = 0.0
         members = {h: {"role": r.get("role", "mixed"),
                        "replicas": int(r.get("replicas", 0))}
                    for h, r in sorted(alive.items())}
@@ -929,6 +1105,10 @@ class FleetRouter(EngineRouter):
             emit_complete("fleet.host_lost", time.perf_counter(), 0.0,
                           cat="serving",
                           args={"host": host, "rerouted": rerouted})
+        # losing a host is a fleet incident: pull every survivor's black
+        # box while the evidence is still in the rings (never blocks the
+        # monitor — collection runs on its own thread)
+        self.collect_flight_async(f"host_lost_{host}")
 
     def _host_returned(self, host: str) -> None:
         """A host the monitor declared lost is heartbeating again: offer
@@ -1002,7 +1182,22 @@ class FleetRouter(EngineRouter):
             req = self._submit_disagg(ids, kw)
             if req is not None:
                 return req
-        return super().submit(prompt=ids, **kw)
+        # a submit that dies on the wire is re-PLACED on a different
+        # healthy replica — never retried on the same one (submit is not
+        # idempotent: a frame that died after delivery would
+        # double-generate). The failed proxy marks itself lost, so its
+        # open streams reroute and placement stops offering it; worst
+        # case is one orphaned generation on a partitioned-but-alive
+        # host, never a dropped or duplicated stream on ours.
+        last: Optional[BaseException] = None
+        for _ in range(max(2, len(self._host_conns) + 1)):
+            try:
+                return super().submit(prompt=ids, **kw)
+            except RpcRemoteError:
+                raise                  # the handler refused; host is fine
+            except RpcError as e:
+                last = e
+        raise last
 
     def _fallback(self, reason: str) -> None:
         """Disagg bailed out: count it and leave the reason in the
@@ -1012,13 +1207,21 @@ class FleetRouter(EngineRouter):
             emit_complete("fleet.direct", time.perf_counter(), 0.0,
                           cat="serving", args={"reason": reason})
 
-    def _submit_disagg(self, ids: np.ndarray, kw: dict):
-        """Prefill on a prefill-role replica, stream the finished KV
-        blocks into the chosen decode replica's radix tree, then submit
-        there — the submit hits the freshly-spliced prefix, so decode
-        never runs the long prompt's prefill. Any failure falls back to
-        the monolithic path (``fleet_direct_fallbacks``) — disaggregation
-        is an optimization, never a correctness dependency."""
+    def _submit_disagg(self, ids: np.ndarray, kw: dict,
+                       stream_budget_s: float = 120.0):
+        """Prefill on a prefill-role replica, streaming each finished
+        chunk's KV blocks into the chosen decode replica WHILE the next
+        chunk computes (sequence-numbered by start block; the receiver's
+        ack high-water mark drives resume), then submit there — the
+        submit hits the freshly-spliced prefix, so decode never runs the
+        long prompt's prefill. A prefill host dying MID-stream is not a
+        failure: decode keeps the received prefix and its own chunked
+        prefill computes only the missing tail (``fleet_kv_resume_tails``)
+        — token-identical either way, because everything rides the pinned
+        radix-splice guarantee. Only a stream that delivered NOTHING falls
+        back to the monolithic path (``fleet_direct_fallbacks``) —
+        disaggregation is an optimization, never a correctness
+        dependency."""
         pf = self._healthy_prefill()
         target = self.place(ids)
         if pf is None or target is None:
@@ -1032,31 +1235,69 @@ class FleetRouter(EngineRouter):
             return None
         t0 = time.monotonic()
         try:
-            exp = pf.export_kv_prefix(ids)
+            start = pf.prefill_start(ids)
         except (RpcError, RpcRemoteError, RuntimeError):
-            self._fallback("prefill_export_failed")
+            self._fallback("prefill_start_failed")
             return None
-        if not exp:
-            self._fallback("prefill_no_match")
+        bs = int(pf.block_size)
+        stream_target = int(start["target"])
+        deadline = t0 + float(stream_budget_s)
+        ack = chunks = nbytes = 0
+        first_block_ms = None
+        resumed = False
+        while ack < stream_target and time.monotonic() < deadline:
+            try:
+                exp = pf.export_kv_range(ids, start_block=ack // bs,
+                                         max_blocks=self.kv_chunk_blocks,
+                                         wait_s=1.0)
+            except (RpcError, RpcRemoteError):
+                # prefill host died mid-transfer: keep what we have —
+                # decode's own prefill covers only the missing tail
+                resumed = ack > 0
+                break
+            if exp["n_blocks"] <= 0:
+                if exp["done"] and int(exp["matched_len"]) <= ack:
+                    break                  # nothing more will ever come
+                continue                   # server waited; poll again
+            try:
+                got = eng.import_kv_chunk(ids, exp["kb"], exp["vb"],
+                                          int(exp["start_block"]),
+                                          int(exp["covered_tokens"]))
+            except (RpcError, RpcRemoteError, RuntimeError, ValueError):
+                break                      # decode refused: stop streaming
+            chunks += 1
+            FLEET_KV_CHUNKS_STREAMED.add(1)
+            nbytes += int(exp["kb"].nbytes) + int(exp["vb"].nbytes)
+            if first_block_ms is None:
+                first_block_ms = (time.monotonic() - t0) * 1e3
+            if got <= ack:
+                break                      # no progress (pool full): stop
+            ack = got
+            if exp["done"] and ack >= int(exp["matched_len"]):
+                break
+        if ack <= 0:
+            self._fallback("prefill_stream_failed" if chunks == 0
+                           else "decode_import_failed")
             return None
-        try:
-            cached = eng.import_kv_prefix(ids, exp["kb"], exp["vb"],
-                                          exp["matched_len"])
-        except (RpcError, RpcRemoteError, RuntimeError, ValueError):
-            cached = 0
-        if cached <= 0:
-            self._fallback("decode_import_failed")
-            return None
+        if resumed:
+            FLEET_KV_RESUME_TAILS.add(1)
         dt_ms = (time.monotonic() - t0) * 1e3
-        nbytes = int(exp["kb"].nbytes) + int(exp["vb"].nbytes)
         FLEET_KV_TRANSFER_MS.observe(dt_ms)
         FLEET_KV_TRANSFER_BYTES.add(nbytes)
         FLEET_PREFILL_ROUTED.add(1)
+        self.last_stream_stats = {
+            "first_block_ms": first_block_ms, "total_ms": dt_ms,
+            "chunks": chunks, "acked_tokens": int(ack),
+            "target_tokens": stream_target, "resumed": resumed}
         if recording():
             emit_complete("fleet.kv_stream", time.perf_counter(),
                           dt_ms / 1e3, cat="serving",
                           args={"bytes": nbytes, "ms": round(dt_ms, 3),
-                                "matched": int(exp["matched_len"]),
+                                "matched": int(ack), "chunks": chunks,
+                                "first_block_ms": None
+                                if first_block_ms is None
+                                else round(first_block_ms, 3),
+                                "resumed": resumed,
                                 "prefill_host": pf.host,
                                 "decode_replica": int(target)})
         try:
@@ -1067,6 +1308,84 @@ class FleetRouter(EngineRouter):
         req._replica = target
         self._affinity_note(ids, target)
         return req
+
+    # -- fleet-wide flight collection (ISSUE 20) -----------------------------
+    def collect_flight(self, reason: str, trace_dir: Optional[str] = None,
+                       timeout: float = 2.0) -> dict:
+        """Pull every reachable host's FlightRecorder ring over RPC into
+        flight-format files next to the router's own dump, so
+        ``tools/trace_report.py merge_traces`` stitches the incident into
+        one fleet timeline. Unreachable hosts become recorded gaps —
+        collection is bounded by ``timeout`` per host and never hangs on
+        the very failure it is documenting."""
+        rec = get_flight_recorder()
+        d = trace_dir or (rec.trace_dir if rec is not None else None)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in str(reason))[:48] or "collect"
+        paths, hosts_ok, gaps, unarmed = [], [], [], []
+        local = dump_flight(f"fleet_{safe}", trace_dir=d)
+        if local:
+            paths.append(local)
+        with self._fleet_lock:
+            self._collect_seq += 1
+            seq = self._collect_seq
+        for host, (client, _record) in sorted(self._host_conns.items()):
+            try:
+                res, _ = client.call("collect_flight", {}, timeout=timeout)
+            except (RpcError, RpcRemoteError):
+                gaps.append(host)
+                continue
+            if not res.get("armed"):
+                unarmed.append(host)
+                continue
+            hosts_ok.append(host)
+            if not d:
+                continue
+            pid = int(res.get("pid", 0))
+            events = list(res.get("events") or ())
+            payload = {
+                "traceEvents": events + [
+                    {"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"{host} pid={pid}"}}],
+                "displayTimeUnit": "ms",
+                "flight": {"reason": f"fleet_{safe}", "host": host,
+                           "pid": pid, "seq": seq, "events": len(events),
+                           "collected_by": "fleet-router",
+                           "gauges": res.get("gauges", {})},
+            }
+            try:
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"flight_{host}_{pid}_c{seq:03d}_{safe}.json")
+                with open(path, "w") as f:
+                    json.dump(payload, f)
+                paths.append(path)
+            except OSError:
+                gaps.append(host)          # disk trouble ≈ lost dump
+        FLIGHT_COLLECTS.add(1)
+        if recording():
+            emit_complete("fleet.collect", time.perf_counter(), 0.0,
+                          cat="serving",
+                          args={"reason": str(reason),
+                                "hosts_ok": hosts_ok, "gaps": gaps,
+                                "unarmed": unarmed})
+        return {"reason": str(reason), "hosts": hosts_ok, "gaps": gaps,
+                "unarmed": unarmed, "paths": paths}
+
+    def collect_flight_async(self, reason: str,
+                             min_gap_s: float = 5.0) -> bool:
+        """Fire-and-forget :meth:`collect_flight` on a daemon thread —
+        the form every trigger that holds a lock (supervisor give-up,
+        host-loss monitor) must use. Rate-limited so an incident storm
+        produces one collection, not one per symptom."""
+        now = time.monotonic()
+        with self._fleet_lock:
+            if now - self._collect_last_t < float(min_gap_s):
+                return False
+            self._collect_last_t = now
+        threading.Thread(target=lambda: self.collect_flight(reason),
+                         name="fleet-collect", daemon=True).start()
+        return True
 
     # -- lifecycle -----------------------------------------------------------
     def shutdown(self, drain: bool = True, timeout=None) -> None:
@@ -1088,12 +1407,24 @@ class FleetRouter(EngineRouter):
 def connect_fleet(store, job: str, min_hosts: int = 1,
                   timeout: float = 30.0, registry_ttl: float = 2.0,
                   rpc_timeout: float = 30.0, poll_s: float = 1.0,
+                  client_host: str = "router",
+                  retry: Optional[RetryPolicy] = None,
+                  breaker_threshold: int = 3,
+                  breaker_cooldown_s: float = 2.0,
                   **router_kw) -> FleetRouter:
     """Discover the fleet from the shared store and build a
     :class:`FleetRouter` over it: one RPC connection per host, one
     :class:`RemoteReplica` per (host, replica), prefill-role hosts into
     the KV-streaming pool and everyone else into the routable decode
-    set. Blocks until ``min_hosts`` hosts are registered."""
+    set. Blocks until ``min_hosts`` hosts are registered.
+
+    Every host connection is armed with the reliability layer (ISSUE
+    20): ``retry`` (default :class:`RetryPolicy` — idempotent-only,
+    deterministic backoff; pass ``RetryPolicy(max_attempts=1)`` to
+    disable) and a per-peer :class:`CircuitBreaker` (``breaker_threshold``
+    consecutive transport errors open it, half-open probe after
+    ``breaker_cooldown_s``). ``client_host`` names this endpoint for
+    ``net_partition`` fault matching."""
     registry = FleetRegistry(store, job, ttl=registry_ttl)
     deadline = time.monotonic() + timeout
     alive: Dict[str, dict] = {}
@@ -1109,9 +1440,16 @@ def connect_fleet(store, job: str, min_hosts: int = 1,
         raise TimeoutError(
             f"fleet {job!r}: {len(alive)}/{min_hosts} hosts registered "
             f"after {timeout}s")
+    if retry is None:
+        retry = RetryPolicy()
     decode, prefill, conns = [], [], {}
     for host, record in sorted(alive.items()):
-        client = RpcClient(tuple(record["addr"]), timeout=rpc_timeout)
+        client = RpcClient(
+            tuple(record["addr"]), timeout=rpc_timeout, retry=retry,
+            breaker=CircuitBreaker(threshold=breaker_threshold,
+                                   cooldown_s=breaker_cooldown_s,
+                                   peer=host),
+            peer_host=host, local_host=client_host)
         hello, _ = client.call("hello")
         conns[host] = (client, record)
         role = hello.get("role", record.get("role", "mixed"))

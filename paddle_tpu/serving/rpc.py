@@ -1,4 +1,5 @@
-"""Thin stdlib RPC transport for the cross-host serving fleet (ISSUE 19).
+"""Thin stdlib RPC transport for the cross-host serving fleet (ISSUE 19),
+hardened against real network failure (ISSUE 20).
 
 One frame = ``PRPC`` magic + ``<I json_len, Q blob_len>`` + a JSON header
 + an optional binary blob. The header carries the method, scalar params,
@@ -8,8 +9,8 @@ blob raw, never JSON. The same frame shape serves requests and replies.
 
 Design constraints, in order:
 
-- **stdlib only** (socket/struct/json/threading) — the fleet must not
-  grow a dependency the training side doesn't have.
+- **stdlib only** (socket/struct/json/threading/zlib) — the fleet must
+  not grow a dependency the training side doesn't have.
 - **Blocking request/response per connection.** The server runs one
   thread per connection, so a handler may legitimately block (the
   long-poll ``wait`` that streams tokens parks in ``req._cv.wait_for``
@@ -18,6 +19,37 @@ Design constraints, in order:
 - **Failure = exception, not hang.** Socket timeouts bound every call;
   a dead peer surfaces as :class:`RpcError` at the caller, which is the
   signal the fleet layer (serving/pod.py) turns into replica failover.
+
+Reliability layer (ISSUE 20), every piece default-off-path:
+
+- :class:`RetryPolicy` — idempotent-only retries with deterministic
+  exponential backoff and capped attempt/deadline budgets
+  (``rpc_retries``). Non-idempotent methods (``submit``/``adopt``)
+  never retry: a replayed submit would double-decode a request.
+- :class:`CircuitBreaker` — per-peer: ``threshold`` consecutive
+  transport errors open it, every call then fast-fails without dialing
+  until ``cooldown_s`` passes, after which exactly ONE half-open probe
+  is let through (success closes, failure re-opens). A dead host costs
+  one fast-failed call instead of a socket timeout per request.
+  ``rpc_breaker_state`` gauges the breakers currently open;
+  ``rpc.breaker_open`` spans mark each transition.
+- **Deadline riding the frame header** — ``call(deadline_s=...)``
+  stamps the remaining budget as ``deadline_ms``; the receiver sheds a
+  frame whose budget is already gone at dispatch time instead of
+  computing a result nobody will read (``rpc_deadline_sheds``).
+- **Optional blob crc** — ``call(crc=True)`` adds a ``crc`` (zlib
+  crc32 of the blob) the receiver verifies before decoding; a corrupt
+  KV chunk surfaces as ``RpcRemoteError(etype="RpcCorruptFrame")``,
+  never as silently-wrong cache rows.
+- **Pool hygiene** — a socket whose call raised ANYWHERE (transport
+  error, desynced response id, torn reply blob) is closed and dropped;
+  only a fully-validated round trip returns its socket to the pool, so
+  one torn reply can never poison the next call.
+
+With no retry/breaker configured and no deadline passed, the frame
+byte-stream and the call path are identical to ISSUE 19 — the off-path
+cost is one ``is None`` check per call, and the fault hooks guard on
+``faults.ENABLED[0]``.
 
 Threading notes (GL003/GL004): the server's connection set and the
 client's socket pool are the only cross-thread state, each guarded by
@@ -32,14 +64,21 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from ..monitor.stats import RPC_CALLS, RPC_CALL_MS, RPC_ERRORS
+from ..monitor.stats import (RPC_BREAKER_STATE, RPC_CALL_MS, RPC_CALLS,
+                             RPC_DEADLINE_SHEDS, RPC_ERRORS, RPC_RETRIES)
+from ..monitor.trace import emit_complete, recording
+from ..resilience.faults import ENABLED as _FAULTS_ON
+from ..resilience.faults import FAULTS as _FAULTS
+from ..resilience.faults import net_partition_blocks
 
 __all__ = ["RpcError", "RpcRemoteError", "RpcServer", "RpcClient",
-           "encode_arrays", "decode_arrays"]
+           "RetryPolicy", "CircuitBreaker", "encode_arrays",
+           "decode_arrays"]
 
 _MAGIC = b"PRPC"
 _HEAD = len(_MAGIC) + 12            # magic + <I json_len> + <Q blob_len>
@@ -106,15 +145,23 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
-            raise ConnectionError("peer closed mid-frame")
+            if buf:                    # mid-frame death: corruption, not
+                raise RpcError(        # a clean between-frames close
+                    f"truncated frame: peer closed after {len(buf)} of "
+                    f"{n} bytes")
+            raise ConnectionError("peer closed")
         buf += chunk
     return bytes(buf)
 
 
-def _send_frame(sock: socket.socket, header: dict, blob: bytes = b"") -> None:
+def _pack_frame(header: dict, blob: bytes = b"") -> bytes:
     payload = json.dumps(header, separators=(",", ":")).encode()
-    sock.sendall(_MAGIC + struct.pack("<IQ", len(payload), len(blob))
-                 + payload + blob)
+    return (_MAGIC + struct.pack("<IQ", len(payload), len(blob))
+            + payload + blob)
+
+
+def _send_frame(sock: socket.socket, header: dict, blob: bytes = b"") -> None:
+    sock.sendall(_pack_frame(header, blob))
 
 
 def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
@@ -124,9 +171,146 @@ def _recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
     jlen, blen = struct.unpack("<IQ", head[len(_MAGIC):])
     if jlen > MAX_HEADER_BYTES or blen > MAX_BLOB_BYTES:
         raise RpcError(f"oversized frame: header {jlen}B, blob {blen}B")
-    header = json.loads(_recvall(sock, jlen))
+    try:
+        header = json.loads(_recvall(sock, jlen))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise RpcError(f"corrupt frame header: {e}") from e
     blob = _recvall(sock, blen) if blen else b""
     return header, blob
+
+
+def _flip_byte(frame: bytes, jlen: int, blen: int) -> bytes:
+    """Deterministic in-flight corruption (rpc_corrupt): XOR one byte
+    with 0xFF — inside the blob when there is one (the crc path), else
+    inside the JSON header (high bit set = invalid UTF-8, the
+    torn-frame path)."""
+    if blen > 0:
+        off = _HEAD + jlen + blen // 2
+    else:
+        off = _HEAD + jlen // 2
+    b = bytearray(frame)
+    b[off] ^= 0xFF
+    return bytes(b)
+
+
+# -- reliability policy ------------------------------------------------------
+class RetryPolicy:
+    """Deterministic retry budget for IDEMPOTENT methods only.
+
+    Backoff is exponential from ``backoff_s`` doubling per attempt,
+    capped at ``backoff_max_s`` — no jitter, so chaos replays are
+    bit-reproducible. ``submit``/``adopt`` are deliberately absent from
+    the default method set: replaying one would double-decode a request
+    on a peer that actually received the first copy.
+    """
+
+    IDEMPOTENT = frozenset({
+        "hello", "health", "wait", "cancel", "warm", "prefill_export",
+        "prefill_start", "export_range", "import_kv", "import_chunk",
+        "ensure_replicas", "evacuate", "collect_flight",
+    })
+
+    def __init__(self, max_attempts: int = 3, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0, methods=None):
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.methods = frozenset(methods) if methods is not None \
+            else self.IDEMPOTENT
+
+    def retryable(self, method: str) -> bool:
+        return method in self.methods
+
+    def backoff(self, attempt: int) -> float:
+        """Pause before retry ``attempt`` (0-based)."""
+        return min(self.backoff_max_s, self.backoff_s * (2 ** attempt))
+
+
+BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+
+# process-wide count of OPEN breakers behind the rpc_breaker_state gauge
+_OPEN_LOCK = threading.Lock()
+_OPEN_COUNT = [0]
+
+
+def _note_breaker(delta: int) -> None:
+    with _OPEN_LOCK:
+        _OPEN_COUNT[0] = max(0, _OPEN_COUNT[0] + delta)
+        RPC_BREAKER_STATE.set(_OPEN_COUNT[0])
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker: ``threshold`` CONSECUTIVE transport
+    errors open it; while open, :meth:`allow` fast-fails every call
+    until ``cooldown_s`` passes, then admits exactly one half-open
+    probe. The probe's outcome closes or re-opens the breaker."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 2.0,
+                 peer: str = ""):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.peer = str(peer)
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consec = 0
+        self._opened_t = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now? (True also claims the single
+        half-open probe slot when the cooldown has elapsed.)"""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN and not self._probing \
+                    and time.monotonic() - self._opened_t >= self.cooldown_s:
+                self._state = BREAKER_HALF_OPEN
+                self._probing = True
+                return True
+            if self._state == BREAKER_HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def note_ok(self) -> None:
+        with self._lock:
+            was_open = self._state != BREAKER_CLOSED
+            self._state = BREAKER_CLOSED
+            self._consec = 0
+            self._probing = False
+        if was_open:
+            _note_breaker(-1)
+
+    def note_error(self) -> None:
+        opened = False
+        with self._lock:
+            self._consec += 1
+            if self._state == BREAKER_HALF_OPEN:
+                self._state = BREAKER_OPEN      # failed probe: re-open
+                self._opened_t = time.monotonic()
+                self._probing = False
+            elif self._state == BREAKER_CLOSED \
+                    and self._consec >= self.threshold:
+                self._state = BREAKER_OPEN
+                self._opened_t = time.monotonic()
+                opened = True
+        if opened:
+            _note_breaker(+1)
+            if recording():
+                emit_complete("rpc.breaker_open", time.perf_counter(), 0.0,
+                              cat="serving",
+                              args={"peer": self.peer,
+                                    "consec_errors": self._consec})
+
+    def __repr__(self):
+        names = {BREAKER_CLOSED: "closed", BREAKER_HALF_OPEN: "half-open",
+                 BREAKER_OPEN: "open"}
+        return f"CircuitBreaker(peer={self.peer!r}, {names[self.state]})"
 
 
 # -- server ------------------------------------------------------------------
@@ -171,7 +355,8 @@ class RpcServer:
                     header, blob = _recv_frame(conn)
                 except (ConnectionError, OSError, RpcError, ValueError):
                     break                      # peer gone / torn frame
-                resp, rblob = self._dispatch(header, blob)
+                t_recv = time.monotonic()
+                resp, rblob = self._dispatch(header, blob, t_recv)
                 try:
                     _send_frame(conn, resp, rblob)
                 except (ConnectionError, OSError):
@@ -184,9 +369,29 @@ class RpcServer:
             except OSError:
                 pass
 
-    def _dispatch(self, header: dict, blob: bytes) -> Tuple[dict, bytes]:
+    def _dispatch(self, header: dict, blob: bytes,
+                  t_recv: Optional[float] = None) -> Tuple[dict, bytes]:
         mid = header.get("id")
         method = header.get("method", "")
+        if t_recv is None:
+            t_recv = time.monotonic()
+        # injected receiver-side delay (rpc_delay rides the header so
+        # the claim stays in the CLIENT's per-peer call-index space)
+        if _FAULTS_ON[0] and header.get("_inject_delay_s") is not None:
+            time.sleep(float(header["_inject_delay_s"]))
+        # deadline shed: the caller's remaining budget rode the header —
+        # if it is gone by dispatch time, answer without computing
+        dl_ms = header.get("deadline_ms")
+        if dl_ms is not None \
+                and (time.monotonic() - t_recv) * 1e3 >= float(dl_ms):
+            RPC_DEADLINE_SHEDS.add(1)
+            return ({"id": mid, "ok": False, "etype": "DeadlineExpired",
+                     "error": f"frame budget {float(dl_ms):.1f}ms expired "
+                     "before dispatch (shed)"}, b"")
+        crc = header.get("crc")
+        if crc is not None and zlib.crc32(blob) != int(crc):
+            return ({"id": mid, "ok": False, "etype": "RpcCorruptFrame",
+                     "error": "blob crc mismatch (corrupt in flight)"}, b"")
         fn = self._handlers.get(method)
         if fn is None:
             return ({"id": mid, "ok": False, "etype": "KeyError",
@@ -240,17 +445,32 @@ class RpcClient:
     (dialing a fresh one when empty), runs one request/response on it
     outside any lock, and returns it — so concurrent callers (a parked
     long-poll, a health probe, a KV stream) each get their own
-    connection and never serialize behind each other."""
+    connection and never serialize behind each other.
+
+    ``retry=RetryPolicy(...)`` arms idempotent-method retries;
+    ``breaker=CircuitBreaker(...)`` arms per-peer circuit breaking;
+    ``peer_host``/``local_host`` name the endpoints for the network
+    fault hooks (``net_partition`` groups match against them). All
+    default to off/empty — a bare client behaves exactly like ISSUE 19.
+    """
 
     POOL_MAX = 4
 
-    def __init__(self, addr, timeout: float = 30.0):
+    def __init__(self, addr, timeout: float = 30.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 peer_host: str = "", local_host: str = "client"):
         self.addr = (str(addr[0]), int(addr[1]))
         self.timeout = float(timeout)
+        self.retry = retry
+        self.breaker = breaker
+        self.peer_host = str(peer_host)
+        self.local_host = str(local_host)
         self._lock = threading.Lock()          # guards _pool/_seq/_closed
         self._pool: list = []
         self._seq = 0
         self._closed = False
+        self._call_idx = 0   # per-peer fault index (bumped only armed)
 
     def _dial(self) -> socket.socket:
         sock = socket.create_connection(self.addr, timeout=self.timeout)
@@ -259,11 +479,63 @@ class RpcClient:
 
     def call(self, method: str, params: Optional[dict] = None,
              arrays: Optional[Dict[str, Any]] = None,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+             deadline_s: Optional[float] = None, crc: bool = False):
         """Returns ``(result, arrays)``. Raises :class:`RpcRemoteError`
         when the handler raised, :class:`RpcError` on transport death
         (the fleet-failover signal — the socket is discarded, never
-        returned to the pool)."""
+        returned to the pool). With a :class:`RetryPolicy` armed,
+        transport errors on idempotent methods retry with deterministic
+        backoff inside the remaining ``deadline_s`` budget."""
+        deadline = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(method, params, arrays, timeout,
+                                       deadline, crc)
+            except RpcRemoteError:
+                raise                      # transport fine; peer answered
+            except RpcError as e:
+                pol = self.retry
+                if pol is None or not pol.retryable(method) \
+                        or getattr(e, "fast", False):
+                    raise                  # breaker fast-fail: no retry
+                attempt += 1
+                if attempt >= pol.max_attempts:
+                    raise
+                pause = pol.backoff(attempt - 1)
+                if deadline is not None \
+                        and time.monotonic() + pause >= deadline:
+                    raise
+                RPC_RETRIES.add(1)
+                time.sleep(pause)
+
+    def _call_once(self, method, params, arrays, timeout, deadline,
+                   crc: bool):
+        br = self.breaker
+        if br is not None and not br.allow():
+            RPC_CALLS.add()
+            RPC_ERRORS.add()
+            err = RpcError(f"rpc {method!r} to {self.addr[0]}:"
+                           f"{self.addr[1]}: circuit breaker open")
+            err.fast = True
+            raise err
+        drop = delay = corrupt = None
+        if _FAULTS_ON[0]:
+            self._call_idx += 1
+            fired = _FAULTS.take_rpc(self.peer_host, method, self._call_idx)
+            drop = fired.get("rpc_drop")
+            delay = fired.get("rpc_delay")
+            corrupt = fired.get("rpc_corrupt")
+            if net_partition_blocks(self.local_host, self.peer_host):
+                RPC_CALLS.add()
+                RPC_ERRORS.add()
+                if br is not None:
+                    br.note_error()
+                raise RpcError(f"rpc {method!r} to {self.addr[0]}:"
+                               f"{self.addr[1]}: injected net partition "
+                               f"({self.local_host}<->{self.peer_host})")
         with self._lock:
             if self._closed:
                 raise RpcError("client closed")
@@ -272,25 +544,60 @@ class RpcClient:
             sock = self._pool.pop() if self._pool else None
         t0 = time.monotonic()
         RPC_CALLS.add()
+        out = resp = None
         try:
+            if drop is not None:           # injected mid-call transport
+                if sock is not None:       # death, before the frame leaves
+                    sock.close()
+                raise ConnectionError("injected rpc_drop")
             if sock is None:
                 sock = self._dial()
             manifest, blob = encode_arrays(arrays or {})
-            sock.settimeout(self.timeout if timeout is None else timeout)
-            _send_frame(sock, {"id": mid, "method": method,
-                               "params": params or {}, "blobs": manifest},
-                        blob)
+            header = {"id": mid, "method": method, "params": params or {},
+                      "blobs": manifest}
+            if deadline is not None:
+                header["deadline_ms"] = round(
+                    max(0.0, (deadline - time.monotonic()) * 1e3), 3)
+            if crc:
+                header["crc"] = zlib.crc32(blob)
+            if delay is not None:
+                header["_inject_delay_s"] = delay.secs
+            frame = _pack_frame(header, blob)
+            if corrupt is not None:
+                jlen = len(json.dumps(header,
+                                      separators=(",", ":")).encode())
+                frame = _flip_byte(frame, jlen, len(blob))
+            budget = self.timeout if timeout is None else timeout
+            if deadline is not None:
+                budget = min(budget, max(0.01, deadline - time.monotonic()))
+            sock.settimeout(budget)
+            sock.sendall(frame)
             resp, rblob = _recv_frame(sock)
+            if resp.get("id") != mid:
+                raise RpcError(
+                    f"rpc {method!r}: response id {resp.get('id')} for "
+                    f"request {mid} (desynced stream)")
+            if resp.get("ok"):
+                out = (resp.get("result"),
+                       decode_arrays(resp.get("blobs"), rblob))
         except (ConnectionError, OSError, struct.error,
-                json.JSONDecodeError) as e:
+                json.JSONDecodeError, RpcError) as e:
+            # ANY failure mid-call poisons the stream: destroy the
+            # socket, never re-pool it (satellite: pool hygiene)
             RPC_ERRORS.add()
             if sock is not None:
                 try:
                     sock.close()
                 except OSError:
                     pass
+            if br is not None:
+                br.note_error()
+            if isinstance(e, RpcError):
+                raise
             raise RpcError(f"rpc {method!r} to {self.addr[0]}:"
                            f"{self.addr[1]}: {type(e).__name__}: {e}") from e
+        # fully-validated round trip: the stream is aligned — only now
+        # may the socket go back to the pool
         keep = False
         with self._lock:
             if not self._closed and len(self._pool) < self.POOL_MAX:
@@ -299,15 +606,13 @@ class RpcClient:
         if not keep:
             sock.close()
         RPC_CALL_MS.observe((time.monotonic() - t0) * 1e3)
-        if resp.get("id") != mid:
-            RPC_ERRORS.add()
-            raise RpcError(f"rpc {method!r}: response id {resp.get('id')} "
-                           f"for request {mid} (desynced stream)")
-        if not resp.get("ok"):
+        if br is not None:
+            br.note_ok()
+        if out is None:                    # remote handler raised/shed
             RPC_ERRORS.add()
             raise RpcRemoteError(resp.get("etype", "Exception"),
                                  resp.get("error", ""))
-        return resp.get("result"), decode_arrays(resp.get("blobs"), rblob)
+        return out
 
     def close(self) -> None:
         with self._lock:

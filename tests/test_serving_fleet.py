@@ -367,10 +367,14 @@ class TestFleetEndToEnd:
         for e in list(router.engines) + list(router._prefill_pool):
             assert e.alive
         members = router.fleet_members()
+        # the ISSUE-20 registry-reachability entry rides alongside the
+        # per-replica rows
+        assert members.pop("registry")["reachable"] is True
         by_host = {v["host"]: v for v in members.values()}
         assert by_host["dec"]["role"] == "decode"
         assert by_host["pf"]["role"] == "prefill"
         assert all(v["heartbeat_age_s"] < 60 for v in members.values())
+        assert all(v["status"] == "ok" for v in members.values())
         from paddle_tpu.serving.frontend import ServingFrontend, Tenant
 
         fe = ServingFrontend(router, tenants=[
@@ -384,6 +388,7 @@ class TestFleetEndToEnd:
             conn.close()
             assert resp.status == 200
             fleet_checks = obj["checks"]["fleet"]
+            assert fleet_checks.pop("registry")["reachable"] is True
             hosts = {v["host"] for v in fleet_checks.values()}
             assert hosts == {"pf", "dec"}
         finally:

@@ -1089,7 +1089,10 @@ def fleet_report(events: list, file=None) -> dict:
     directs = [e for e in events if e.get("name") == "fleet.direct"]
     lost = [e for e in events if e.get("name") == "fleet.host_lost"]
     prewarms = [e for e in events if e.get("name") == "fleet.prewarm"]
-    if not (members or streams or directs or lost or prewarms):
+    breakers = [e for e in events if e.get("name") == "rpc.breaker_open"]
+    collects = [e for e in events if e.get("name") == "fleet.collect"]
+    if not (members or streams or directs or lost or prewarms
+            or breakers or collects):
         return {}
     out: dict = {}
 
@@ -1148,6 +1151,16 @@ def fleet_report(events: list, file=None) -> dict:
         out["kv_ms_max"] = ms[-1]
         secs = sum(ms) / 1e3
         out["kv_mib_per_s"] = (nbytes / (1 << 20)) / secs if secs else 0.0
+        # ISSUE 20: resumable chunked streaming telemetry
+        out["kv_chunks"] = sum(int(_args(e).get("chunks", 0))
+                               for e in streams)
+        out["kv_resumed_streams"] = sum(
+            1 for e in streams if _args(e).get("resumed"))
+        fb = [float(_args(e)["first_block_ms"]) for e in streams
+              if _args(e).get("first_block_ms") is not None]
+        if fb:
+            fb.sort()
+            out["kv_first_block_ms_p50"] = fb[len(fb) // 2]
     out["direct_fallbacks"] = n_direct
     if n_direct:
         reasons: dict = {}
@@ -1162,6 +1175,23 @@ def fleet_report(events: list, file=None) -> dict:
                                   for e in lost)
     out["replicas_prewarmed"] = sum(int(_args(e).get("added", 0))
                                     for e in prewarms)
+
+    # -- network incidents + fleet postmortem (ISSUE 20) -------------------
+    if breakers:
+        by_peer: dict = {}
+        for e in breakers:
+            p = str(_args(e).get("peer", "?"))
+            by_peer[p] = by_peer.get(p, 0) + 1
+        out["breaker_opens"] = dict(sorted(by_peer.items()))
+    if collects:
+        out["flight_collections"] = []
+        for e in collects:
+            a = _args(e)
+            out["flight_collections"].append(
+                {"reason": str(a.get("reason", "?")),
+                 "hosts_ok": list(a.get("hosts_ok") or ()),
+                 "gaps": list(a.get("gaps") or ()),
+                 "unarmed": list(a.get("unarmed") or ())})
 
     # -- verdict -----------------------------------------------------------
     if streams:
@@ -1183,6 +1213,21 @@ def fleet_report(events: list, file=None) -> dict:
     if lost:
         out["verdict"] += (f"; {len(lost)} host-loss event(s) rerouted "
                            f"{out['streams_rerouted']} stream(s)")
+    if out.get("kv_resumed_streams"):
+        out["verdict"] += (f"; {out['kv_resumed_streams']} stream(s) "
+                           "resumed from received blocks after a "
+                           "mid-transfer prefill loss")
+    if breakers:
+        out["verdict"] += (f"; circuit breakers opened "
+                           f"{len(breakers)} time(s) on "
+                           f"{len(out['breaker_opens'])} peer(s)")
+    if collects:
+        gaps = sorted({h for c in out["flight_collections"]
+                       for h in c["gaps"]})
+        out["verdict"] += (
+            f"; {len(collects)} fleet flight collection(s)"
+            + (f" with unreachable host(s) {gaps} recorded as gaps"
+               if gaps else " covered every host"))
 
     print("\nServing fleet:", file=file)
     for r in table:
